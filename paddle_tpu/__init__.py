@@ -1,0 +1,64 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up rebuild of the capabilities of the reference PaddlePaddle
+(v1.7 Fluid era, /root/reference) designed for TPU hardware: jax/XLA for
+the compute path (MXU-friendly ops, one compiled computation per train
+step), `jax.sharding.Mesh` + shard_map for distribution (ICI collectives
+instead of NCCL), Pallas for fused kernels, and a C++ host runtime for the
+input pipeline.
+
+Top-level API mirrors the reference's `paddle` / `paddle.fluid` surface:
+Tensor, nn.Layer, optimizers, static Program/Executor, fleet, io.
+"""
+__version__ = "0.1.0"
+
+from .tensor import (Tensor, Parameter, to_tensor, set_default_dtype,
+                     get_default_dtype)
+from .random import seed, get_seed
+from . import autograd
+from .autograd import no_grad, enable_grad, grad
+from . import ops
+from .ops import *  # noqa: F401,F403  (functional surface: paddle.add etc.)
+from . import nn
+from . import optimizer
+from .optimizer import lr  # noqa: F401
+from . import initializer
+from . import regularizer
+from . import clip
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm
+# the clip *module* import above shadowed the clip op — rebind the function
+# (the module stays importable as `paddle_tpu.clip` via sys.modules)
+from .ops.math import clip  # noqa: F811
+from .param_attr import ParamAttr, WeightNormParamAttr
+from . import device
+from .device import (CPUPlace, TPUPlace, CUDAPlace, set_device, get_device,
+                     is_compiled_with_cuda, device_count)
+
+# framework-level namespaces filled in by submodules as they land
+from . import jit
+from . import static
+from . import io
+from . import metric
+from . import amp
+from . import parallel
+from . import distributed
+from . import models
+from . import utils
+
+# dygraph/static mode management (reference: fluid.enable_dygraph /
+# paddle.enable_static). Dygraph is the default here (modern surface).
+from .dispatch import in_static_mode as in_static_mode  # noqa
+
+
+def enable_static():
+    from . import static as _static
+    _static.enable_static()
+
+
+def disable_static():
+    from . import static as _static
+    _static.disable_static()
+
+
+def in_dynamic_mode():
+    return not in_static_mode()

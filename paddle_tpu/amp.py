@@ -1,0 +1,147 @@
+"""paddle_tpu.amp — automatic mixed precision.
+
+TPU-native rebuild of reference python/paddle/fluid/contrib/mixed_precision
+(decorate / AutoMixedPrecisionLists / loss scaling). On TPU the native
+16-bit format is bfloat16 — same exponent range as fp32 — so the default
+policy is bf16 compute with NO loss scaling (the fp16 dynamic scaler is
+provided for parity and for float16 experiments).
+
+``auto_cast`` flips a global flag read by the white-listed ops (matmul,
+conv, linear, einsum-based attention): inputs are cast to the compute dtype
+at the op boundary, and params stay fp32 (master weights) — the standard
+TPU recipe, and what the reference's black/white lists approximate.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+_state = {"enabled": False, "dtype": jnp.bfloat16}
+
+
+def is_enabled():
+    return _state["enabled"]
+
+
+def compute_dtype():
+    return _state["dtype"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """reference: fluid.contrib.mixed_precision.decorate → context form."""
+    prev = dict(_state)
+    _state["enabled"] = enable
+    _state["dtype"] = jnp.bfloat16 if dtype == "bfloat16" else jnp.float16
+    try:
+        yield
+    finally:
+        _state.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast(*arrays):
+    """Cast inputs to the AMP compute dtype when autocast is active —
+    called by white-listed ops (matmul/conv/linear)."""
+    if not _state["enabled"]:
+        return arrays
+    dt = _state["dtype"]
+    out = []
+    for a in arrays:
+        if a is not None and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != dt:
+            a = a.astype(dt)
+        out.append(a)
+    return tuple(out)
+
+
+class GradScaler:
+    """reference: mixed_precision loss scaling (incr/decr dynamic scheme).
+    Needed only for float16; bf16 trains unscaled on TPU."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._good = 0
+        self._bad = 0
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import numpy as np
+        inv = 1.0 / self._scale
+        found_inf = False
+        for p in optimizer._params():
+            if p._grad is not None:
+                g = p._grad * inv
+                finite = bool(jax.device_get(jnp.all(jnp.isfinite(g))))
+                if not finite:
+                    found_inf = True
+                p._grad = g
+        self._found_inf = found_inf
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        if not hasattr(self, "_found_inf"):
+            self.unscale_(optimizer)
+        if self._found_inf:
+            self._bad += 1
+            self._good = 0
+            if self._bad >= self._decr_every:
+                self._scale *= self._decr_ratio
+                self._bad = 0
+            optimizer.clear_grad()
+        else:
+            optimizer.step()
+            self._good += 1
+            self._bad = 0
+            if self._good >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good = 0
+        del self._found_inf
+
+    def minimize(self, optimizer, scaled_loss):
+        if scaled_loss is not None and scaled_loss._tape_node is not None:
+            scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        pass
+
+    def state_dict(self):
+        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+
+    def set_state_dict(self, s):
+        self._scale, self._good, self._bad = s["scale"], s["good"], s["bad"]
+
+
+def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16"):
+    """paddle.amp.decorate parity: for O2, cast model params to the compute
+    dtype (pure bf16); for O1 leave params fp32 and rely on auto_cast."""
+    if level == "O2" and models is not None:
+        ms = models if isinstance(models, (list, tuple)) else [models]
+        for m in ms:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers

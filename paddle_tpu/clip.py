@@ -1,0 +1,77 @@
+"""paddle_tpu.clip — gradient clipping.
+
+TPU-native rebuild of reference python/paddle/fluid/clip.py
+(GradientClipByValue, GradientClipByNorm, GradientClipByGlobalNorm). Pure
+functional over jnp arrays so the clip fuses into the compiled update step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    """reference: GradientClipByValue."""
+
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, params_grads):
+        return [(p, None if g is None else jnp.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    """reference: GradientClipByNorm — per-tensor norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, None))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """reference: GradientClipByGlobalNorm — one scale from the global norm
+    of all grads (single fused reduction under jit)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def __call__(self, params_grads):
+        sq = [jnp.sum(jnp.square(g)) for _, g in params_grads
+              if g is not None]
+        if not sq:
+            return params_grads
+        gnorm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [(p, None if g is None else g * scale)
+                for p, g in params_grads]
+
+
+# fluid aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def clip_grad_norm_(parameters, max_norm):
+    """torch-style helper used in some book examples."""
+    grads = [(p, p._grad) for p in parameters if p._grad is not None]
+    clipped = ClipGradByGlobalNorm(max_norm)(grads)
+    for (p, _), (_, g) in zip(grads, clipped):
+        p._grad = g

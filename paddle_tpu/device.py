@@ -1,0 +1,82 @@
+"""paddle_tpu.device — device/place management.
+
+TPU-native rebuild of the reference's Place abstraction
+(reference: python/paddle/fluid/framework.py CPUPlace/CUDAPlace +
+paddle/fluid/platform/place.h). CUDAPlace becomes TPUPlace; a Place wraps a
+jax.Device. `set_device` steers default placement via jax.default_device.
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Place:
+    def __init__(self, device):
+        self.device = device
+
+    def __repr__(self):
+        return f"Place({self.device})"
+
+    def __eq__(self, other):
+        return isinstance(other, Place) and self.device == other.device
+
+
+class CPUPlace(Place):
+    def __init__(self, idx=0):
+        super().__init__(jax.devices("cpu")[idx]
+                         if _has_platform("cpu") else jax.devices()[0])
+
+
+class TPUPlace(Place):
+    def __init__(self, idx=0):
+        devs = jax.devices()
+        super().__init__(devs[idx % len(devs)])
+
+
+# parity alias: code written against the reference uses CUDAPlace for the
+# accelerator
+CUDAPlace = TPUPlace
+
+
+def _has_platform(name):
+    try:
+        jax.devices(name)
+        return True
+    except RuntimeError:
+        return False
+
+
+_current = None
+
+
+def set_device(device):
+    """paddle.set_device('tpu'/'cpu'/'tpu:0')."""
+    global _current
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if name in ("tpu", "gpu", "xpu", "cuda"):
+        place = TPUPlace(idx)
+    else:
+        place = CPUPlace(idx)
+    _current = place
+    jax.config.update("jax_default_device", place.device)
+    return place
+
+
+def get_device():
+    if _current is None:
+        return f"{jax.devices()[0].platform}:0"
+    return f"{_current.device.platform}:{_current.device.id}"
+
+
+def is_compiled_with_cuda():
+    """Parity shim — reports accelerator availability (TPU here)."""
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def is_compiled_with_tpu():
+    return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+def device_count():
+    return jax.device_count()

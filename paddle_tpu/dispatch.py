@@ -1,0 +1,78 @@
+"""paddle_tpu.dispatch — the single op-dispatch point.
+
+TPU-native rebuild of the reference's operator dispatch
+(reference: paddle/fluid/imperative/tracer.cc TraceOp for dygraph;
+python/paddle/fluid/framework.py append_op for static graph). Every
+functional op in paddle_tpu.ops funnels through :func:`apply`:
+
+* **dygraph** (default): run the pure-jax impl eagerly; when grad is
+  required, run it under ``jax.vjp`` and record a TapeNode.
+* **static graph**: append an OpNode carrying the same pure-jax impl to the
+  current Program; the Executor later interprets the whole graph under one
+  ``jax.jit`` (the XLA analogue of the reference's C++ executor loop).
+
+Because impls are pure jax functions, the same code path works on eager
+arrays and on tracers — ``jit.to_static`` simply traces the dygraph path.
+"""
+from __future__ import annotations
+
+import jax
+
+from .tensor import Tensor, as_tensor
+from . import autograd
+from .autograd import TapeNode
+
+# Static-graph hook, installed by paddle_tpu.static to avoid a circular
+# import. When non-None and static mode is on, apply() records graph nodes.
+_static_recorder = None
+_in_static_mode = False
+
+
+def set_static_mode(flag):
+    global _in_static_mode
+    _in_static_mode = flag
+
+
+def in_static_mode():
+    return _in_static_mode
+
+
+def install_static_recorder(fn):
+    global _static_recorder
+    _static_recorder = fn
+
+
+def apply(impl, tensors, attrs=None, nondiff=False, n_out=1, name=""):
+    """Dispatch one op.
+
+    impl: pure function (*jax_arrays, **attrs) -> array | tuple of arrays
+    tensors: the differentiable positional inputs (Tensor or array-likes)
+    attrs: static keyword attrs baked into the op
+    nondiff: output carries no gradient (argmax, comparisons, ...)
+    """
+    attrs = attrs or {}
+    if _in_static_mode and _static_recorder is not None:
+        return _static_recorder(impl, tensors, attrs, nondiff, n_out, name)
+
+    ts = [as_tensor(t) for t in tensors]
+    arrays = [t.data for t in ts]
+
+    need_grad = (not nondiff and autograd.grad_enabled()
+                 and any(not t.stop_gradient for t in ts))
+
+    if need_grad:
+        outs, vjp = jax.vjp(lambda *xs: impl(*xs, **attrs), *arrays)
+    else:
+        outs = impl(*arrays, **attrs)
+
+    single = not isinstance(outs, (tuple, list))
+    outs_seq = (outs,) if single else tuple(outs)
+    out_tensors = tuple(Tensor(o, stop_gradient=not need_grad)
+                        for o in outs_seq)
+
+    if need_grad:
+        node = TapeNode(ts, vjp, list(out_tensors), name=name)
+        for ot in out_tensors:
+            ot._tape_node = node
+
+    return out_tensors[0] if single else out_tensors

@@ -1,0 +1,62 @@
+"""paddle_tpu.distributed — multi-host launch/env.
+
+TPU-native rebuild of reference python/paddle/distributed/launch.py +
+fluid.dygraph parallel init: instead of spawning one proc per GPU and
+wiring NCCL ids, each TPU host runs the same program and
+`jax.distributed.initialize` joins the pod (coordinator from env).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..parallel.env import ParallelEnv
+from ..parallel import collective, fleet as _fleet_mod
+from ..parallel.collective import all_reduce, all_gather, broadcast, barrier
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address=None, num_processes=None,
+                      process_id=None):
+    """reference: paddle.distributed.init_parallel_env / launch.py env
+    wiring. Single-host: no-op (the mesh covers local devices). Multi-host:
+    jax.distributed.initialize with coordinator from args or env
+    (COORDINATOR_ADDRESS / PADDLE_TRAINER_ENDPOINTS[0])."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    addr = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if addr is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        addr = eps.split(",")[0] if eps else None
+    nproc = num_processes or int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = process_id if process_id is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if addr and nproc > 1:
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank():
+    return jax.process_index()
+
+
+def get_world_size():
+    return jax.process_count()
+
+
+def spawn(func, args=(), nprocs=1, **kwargs):
+    """reference: paddle.distributed.spawn. On TPU the runtime is
+    single-controller SPMD — one process drives all local chips — so spawn
+    degenerates to a direct call (parallelism comes from the mesh)."""
+    return func(*args)
+
+
+class launch:
+    """Placeholder namespace mirroring `python -m paddle.distributed.launch`;
+    on TPU pods each host starts the same script (GKE/tpu-vm convention)."""
+    pass

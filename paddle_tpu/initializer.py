@@ -1,0 +1,172 @@
+"""paddle_tpu.initializer — parameter initializers.
+
+TPU-native rebuild of the reference's initializer families
+(reference: python/paddle/fluid/initializer.py — Constant, Uniform, Normal,
+TruncatedNormal, Xavier, MSRA, Bilinear, NumpyArrayInitializer). Instead of
+appending fill ops to a startup Program, each initializer is a pure function
+``(key, shape, dtype) -> jax.Array`` driven by the global threaded PRNG.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import convert_dtype, get_default_dtype
+from . import random as prandom
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None, key=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        if key is None:
+            key = prandom.next_key()
+        return self._init(key, tuple(shape), dtype)
+
+    def _init(self, key, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _init(self, key, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _init(self, key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _init(self, key, shape, dtype):
+        return jax.random.normal(key, shape, dtype) * self.std + self.mean
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _init(self, key, shape, dtype):
+        return jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                           dtype) * self.std + self.mean
+
+
+def _fans(shape):
+    if len(shape) == 2:
+        fan_in, fan_out = shape[0], shape[1]
+    elif len(shape) >= 3:
+        # conv weight OIHW / (out, in, *spatial)
+        receptive = int(np.prod(shape[2:]))
+        fan_in = shape[1] * receptive
+        fan_out = shape[0] * receptive
+    else:
+        fan_in = fan_out = int(np.prod(shape))
+    return fan_in, fan_out
+
+
+class XavierUniform(Initializer):
+    """reference: initializer.py XavierInitializer(uniform=True)"""
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _init(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _init(self, key, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return jax.random.normal(key, shape, dtype) * std
+
+
+class KaimingUniform(Initializer):
+    """reference: MSRAInitializer(uniform=True)"""
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+
+    def _init(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        limit = math.sqrt(6.0 / fi)
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+
+    def _init(self, key, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        std = math.sqrt(2.0 / fi)
+        return jax.random.normal(key, shape, dtype) * std
+
+
+class Bilinear(Initializer):
+    """reference: BilinearInitializer — for conv-transpose upsampling."""
+    def _init(self, key, shape, dtype):
+        weight = np.zeros(shape, dtype=np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a 4-D conv weight")
+        f = math.ceil(shape[3] / 2)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            idx = np.unravel_index(i, shape)
+            weight[idx] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype)
+
+
+class Assign(Initializer):
+    """reference: NumpyArrayInitializer"""
+    def __init__(self, value):
+        self.value = np.asarray(value)
+
+    def _init(self, key, shape, dtype):
+        if tuple(self.value.shape) != tuple(shape):
+            raise ValueError(
+                f"Assign initializer shape {self.value.shape} != {shape}")
+        return jnp.asarray(self.value, dtype)
+
+
+# fluid-style aliases
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+NumpyArrayInitializer = Assign
+
+
+def _resolve(init, default=None):
+    """Accept Initializer instances, None, or numbers (→Constant)."""
+    if init is None:
+        return default
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, (int, float)):
+        return Constant(float(init))
+    raise TypeError(f"cannot interpret initializer: {init!r}")

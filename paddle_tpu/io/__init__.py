@@ -1,0 +1,340 @@
+"""paddle_tpu.io — save/load, DataLoader, datasets.
+
+TPU-native rebuild of the reference's IO stack
+(reference: python/paddle/fluid/io.py save/load_persistables +
+save/load_inference_model; dygraph/checkpoint.py save_dygraph/load_dygraph;
+python/paddle/fluid/reader.py + dataloader/ DataLoader).
+
+Checkpointing: simple pickled-numpy state dicts for parity, plus an
+orbax-backed sharded checkpoint path (paddle_tpu.io.orbax_save/orbax_restore)
+for large distributed state — the TPU equivalent of the reference's
+per-variable persistables files.
+
+DataLoader: index-sampling + batch assembly with background-thread prefetch;
+a C++ native fast path (paddle_tpu/csrc) assembles batches of array datasets
+off the GIL (the reference uses C++ BufferedReader + pin-memory threads).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import queue as _queue
+
+import numpy as np
+import jax
+
+from ..tensor import Tensor, Parameter
+from ..nn.layer import Layer
+
+
+# ---------------------------------------------------------------------------
+# state-dict save/load (reference: save_dygraph / load_dygraph)
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return obj.numpy()
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """paddle.save parity: pickles state dicts (Tensors → numpy)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path):
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_dygraph(state_dict, model_path):
+    """reference: dygraph/checkpoint.py:save_dygraph — model state goes to
+    .pdparams, optimizer state to .pdopt. Optimizer dicts are recognized by
+    their slot-key shape ("param@slot" / "__aux__" / bare "lr")."""
+    suffix = ".pdparams"
+    keys = [k for k in state_dict if isinstance(k, str)]
+    if keys and any("@" in k or k.startswith("__") or k == "lr"
+                    for k in keys):
+        suffix = ".pdopt"
+    save(state_dict, model_path + suffix)
+
+
+def load_dygraph(model_path):
+    """reference: load_dygraph — returns (param_dict, opt_dict)."""
+    params = load(model_path + ".pdparams") if os.path.exists(
+        model_path + ".pdparams") else None
+    opt = load(model_path + ".pdopt") if os.path.exists(
+        model_path + ".pdopt") else None
+    return params, opt
+
+
+# ---------------------------------------------------------------------------
+# inference model (reference: io.py save_inference_model)
+
+def save_inference_model(path_prefix, layer, input_spec=None):
+    """Pickle the whole Layer (structure + weights). The TPU inference
+    engine is `jax.jit` over the restored layer's forward (AOT-compilable
+    via paddle_tpu.inference.Predictor)."""
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    layer.eval()
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump(layer, f, protocol=4)
+    save(layer.state_dict(), path_prefix + ".pdiparams")
+
+
+def load_inference_model(path_prefix):
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        layer = pickle.load(f)
+    params = load(path_prefix + ".pdiparams")
+    layer.set_state_dict(params)
+    layer.eval()
+    return layer
+
+
+# ---------------------------------------------------------------------------
+# orbax sharded checkpointing (reference: fleet checkpoint / persistables —
+# rebuilt over orbax for multi-host sharded state)
+
+def orbax_save(path, state_dict, step=None):
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    tree = _to_numpy_tree(state_dict)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path if step is None else os.path.join(path, str(step)),
+               tree, force=True)
+
+
+def orbax_restore(path, step=None):
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    return ckptr.restore(path if step is None else
+                         os.path.join(path, str(step)))
+
+
+class CheckpointManager:
+    """Train-loop checkpoint/resume helper (keeps last-k, tracks step)."""
+
+    def __init__(self, directory, max_to_keep=3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+
+    def save(self, step, model=None, optimizer=None, extra=None):
+        state = {"step": step}
+        if model is not None:
+            state["model"] = _to_numpy_tree(model.state_dict())
+        if optimizer is not None:
+            state["optimizer"] = _to_numpy_tree(optimizer.state_dict())
+        if extra:
+            state["extra"] = extra
+        save(state, os.path.join(self.directory, f"ckpt-{step}.pkl"))
+        self._gc()
+
+    def _steps(self):
+        out = []
+        for fn in os.listdir(self.directory):
+            if fn.startswith("ckpt-") and fn.endswith(".pkl"):
+                try:
+                    out.append(int(fn[5:-4]))
+                except ValueError:
+                    pass
+        return sorted(out)
+
+    def _gc(self):
+        steps = self._steps()
+        for s in steps[:-self.max_to_keep]:
+            os.remove(os.path.join(self.directory, f"ckpt-{s}.pkl"))
+
+    def latest_step(self):
+        steps = self._steps()
+        return steps[-1] if steps else None
+
+    def restore(self, model=None, optimizer=None, step=None):
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return None
+        state = load(os.path.join(self.directory, f"ckpt-{step}.pkl"))
+        if model is not None and "model" in state:
+            model.set_state_dict(state["model"])
+        if optimizer is not None and "optimizer" in state:
+            optimizer.set_state_dict(state["optimizer"])
+        return state
+
+
+# ---------------------------------------------------------------------------
+# Dataset / DataLoader (reference: fluid/reader.py, dataloader/)
+
+class Dataset:
+    """Map-style dataset (reference: dataloader/dataset.py)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset:
+    def __iter__(self):
+        raise NotImplementedError
+
+
+class TensorDataset(Dataset):
+    def __init__(self, *tensors):
+        self.arrays = [t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+                       for t in tensors]
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.arrays)
+
+    def __len__(self):
+        return len(self.arrays[0])
+
+
+class BatchSampler:
+    """reference: dataloader/batch_sampler.py."""
+
+    def __init__(self, dataset=None, shuffle=False, batch_size=1,
+                 drop_last=False, seed=None):
+        self.n = len(dataset) if dataset is not None else 0
+        self.shuffle = shuffle
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.seed = seed
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+    def __iter__(self):
+        idx = np.arange(self.n)
+        if self.shuffle:
+            rng = np.random.default_rng(
+                None if self.seed is None else self.seed + self.epoch)
+            rng.shuffle(idx)
+        self.epoch += 1
+        bs = self.batch_size
+        end = (self.n // bs) * bs if self.drop_last else self.n
+        for i in range(0, end, bs):
+            yield idx[i:i + bs].tolist()
+
+    def __len__(self):
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+
+def default_collate_fn(batch):
+    """Stack samples into numpy batches (tuple-of-fields layout)."""
+    first = batch[0]
+    if isinstance(first, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch])
+                     for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in first}
+    return np.stack([np.asarray(b) for b in batch])
+
+
+class DataLoader:
+    """reference: fluid/reader.py DataLoader. Background-thread prefetch
+    (the C++ fast path in csrc covers contiguous array datasets)."""
+
+    def __init__(self, dataset, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, prefetch_factor=2,
+                 batch_sampler=None, return_list=True, feed_list=None,
+                 places=None, use_native=True, seed=None):
+        self.dataset = dataset
+        self.batch_sampler = batch_sampler or BatchSampler(
+            dataset, shuffle=shuffle, batch_size=batch_size,
+            drop_last=drop_last, seed=seed)
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch = max(1, prefetch_factor)
+        self.num_workers = num_workers
+        self._native = None
+        if use_native and isinstance(dataset, TensorDataset):
+            try:
+                from .native import NativeBatcher
+                self._native = NativeBatcher(dataset.arrays)
+            except Exception:
+                self._native = None
+
+    def __len__(self):
+        return len(self.batch_sampler)
+
+    def _produce(self, q):
+        try:
+            for idx in self.batch_sampler:
+                if self._native is not None:
+                    q.put(self._native.gather(idx))
+                else:
+                    q.put(self.collate_fn([self.dataset[i] for i in idx]))
+            q.put(_SENTINEL)
+        except BaseException as e:  # surface worker errors to the consumer
+            q.put(_WorkerError(e))
+
+    def __iter__(self):
+        if self.num_workers == 0 and self.prefetch <= 1:
+            for idx in self.batch_sampler:
+                if self._native is not None:
+                    yield self._native.gather(idx)
+                else:
+                    yield self.collate_fn([self.dataset[i] for i in idx])
+            return
+        q = _queue.Queue(maxsize=self.prefetch)
+        t = threading.Thread(target=self._produce, args=(q,), daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _SENTINEL:
+                break
+            if isinstance(item, _WorkerError):
+                raise item.exc
+            yield item
+
+
+_SENTINEL = object()
+
+
+class _WorkerError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+# fluid-era reader decorators (reference: python/paddle/reader/decorator.py)
+def batch_reader(reader, batch_size, drop_last=False):
+    def _reader():
+        batch = []
+        for item in reader():
+            batch.append(item)
+            if len(batch) == batch_size:
+                yield default_collate_fn(batch)
+                batch = []
+        if batch and not drop_last:
+            yield default_collate_fn(batch)
+    return _reader
+
+
+def shuffle_reader(reader, buf_size, seed=None):
+    def _reader():
+        rng = np.random.default_rng(seed)
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        rng.shuffle(buf)
+        yield from buf
+    return _reader

@@ -1,0 +1,287 @@
+"""paddle_tpu.jit — dygraph-to-static compilation (the TPU perf path).
+
+TPU-native rebuild of the reference's @to_static / ProgramTranslator
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/* and jit.py).
+The reference rewrites Python AST into a static Program; on TPU we do
+something far simpler and stronger: functionalize the *state* and let
+`jax.jit` trace the ordinary dygraph code into one XLA computation.
+
+How it works: all mutable framework state (Parameters, buffers, optimizer
+slots, lr, the global PRNG key) lives in Tensors. ``to_static(fn)`` swaps
+every such Tensor's payload for a traced value, runs ``fn`` (the tape
+records vjps on tracers; ``loss.backward()`` and ``optimizer.step()``
+mutate traced payloads), then returns (outputs, new_state) from the traced
+function. The result: forward + backward + optimizer update fused into a
+single donated-buffer XLA executable — the shape the MXU wants.
+
+State discovery: pass ``models=``/``optimizers=`` explicitly, or let
+to_static scan the function's closure for Layers and Optimizers.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor, Parameter
+from .nn.layer import Layer
+from .optimizer import Optimizer
+from . import random as prandom
+
+
+def _discover_state_objects(fn, models, optimizers):
+    models = list(models) if models else []
+    optimizers = list(optimizers) if optimizers else []
+    seen_m = {id(m) for m in models}
+    seen_o = {id(o) for o in optimizers}
+
+    def visit(obj):
+        if isinstance(obj, Layer) and id(obj) not in seen_m:
+            seen_m.add(id(obj))
+            models.append(obj)
+        elif isinstance(obj, Optimizer) and id(obj) not in seen_o:
+            seen_o.add(id(obj))
+            optimizers.append(obj)
+
+    target = fn
+    while hasattr(target, "__wrapped__"):
+        target = target.__wrapped__
+    if inspect.ismethod(target):
+        visit(target.__self__)
+        target = target.__func__
+    if getattr(target, "__closure__", None):
+        for cell in target.__closure__:
+            try:
+                visit(cell.cell_contents)
+            except ValueError:
+                pass
+    return models, optimizers
+
+
+def _collect_state(models, optimizers):
+    """Name → Tensor holder map for everything the step may read/mutate."""
+    holders = {}
+    for mi, m in enumerate(models):
+        for name, p in m.named_parameters():
+            holders[f"m{mi}.{name}"] = p
+        for name, b in m.named_buffers():
+            if isinstance(b, Tensor):
+                holders[f"m{mi}.buf.{name}"] = b
+    for oi, o in enumerate(optimizers):
+        o._ensure_all_slots()
+        holders[f"o{oi}.lr"] = o._lr_tensor
+        for pid, slots in o._accumulators.items():
+            for sname, t in slots.items():
+                holders[f"o{oi}.{pid}.{sname}"] = t
+    holders["rng"] = prandom.global_key_tensor()
+    return holders
+
+
+class StaticFunction:
+    """The compiled callable returned by to_static."""
+
+    def __init__(self, fn, models=None, optimizers=None, donate_state=True,
+                 jit_kwargs=None):
+        functools.update_wrapper(self, fn,
+                                 assigned=("__name__", "__doc__"),
+                                 updated=())
+        self._fn = fn
+        self._models = models
+        self._optimizers = optimizers
+        self._donate = donate_state
+        self._jit_kwargs = jit_kwargs or {}
+        self._cache = {}
+
+    def _resolve_objects(self):
+        if self._models is None or self._optimizers is None:
+            m, o = _discover_state_objects(self._fn, self._models,
+                                           self._optimizers)
+            self._models, self._optimizers = m, o
+        return self._models, self._optimizers
+
+    def __call__(self, *args, **kwargs):
+        models, optimizers = self._resolve_objects()
+        holders = _collect_state(models, optimizers)
+        state_names = sorted(holders)
+
+        # Tensor is a pytree node, so leaves here are raw arrays / scalars.
+        flat_args, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        arr_idx, arrays, statics = [], [], []
+        for i, a in enumerate(flat_args):
+            if isinstance(a, (jax.Array, np.ndarray)):
+                arrays.append(jnp.asarray(a))
+                arr_idx.append(i)
+            else:
+                statics.append((i, a))
+
+        train_flags = tuple(m.training for m in models)
+        key = (treedef, tuple(arr_idx),
+               tuple((a.shape, str(a.dtype)) for a in arrays),
+               tuple((i, repr(s)) for i, s in statics), train_flags,
+               tuple(state_names))
+
+        if key not in self._cache:
+            self._cache[key] = self._make_entry(treedef, arr_idx, statics,
+                                                state_names)
+        entry = self._cache[key]
+
+        state_vals = [holders[n].data for n in state_names]
+        out_arrays, new_state = entry["jitted"](state_vals, arrays)
+
+        for name, new in zip(state_names, new_state):
+            holders[name].data = new
+        for m in models:
+            for p in m.parameters():
+                p._grad = None
+
+        # rebuild outputs: arrays -> Tensors at recorded positions
+        meta = entry["meta"]
+        out_leaves = []
+        ai = 0
+        for kind, payload in meta["slots"]:
+            if kind == "arr":
+                out_leaves.append(Tensor(out_arrays[ai]))
+                ai += 1
+            else:
+                out_leaves.append(payload)
+        return jax.tree_util.tree_unflatten(meta["treedef"], out_leaves)
+
+    def _make_entry(self, treedef, arr_idx, statics, state_names):
+        fn = self._fn
+        models, optimizers = self._models, self._optimizers
+        meta = {}
+
+        def traced(state_vals, arrays):
+            flat = [None] * treedef.num_leaves
+            for i, a in zip(arr_idx, arrays):
+                flat[i] = a
+            for i, s in statics:
+                flat[i] = s
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, flat)
+
+            hs = _collect_state(models, optimizers)
+            saved = {}
+            try:
+                for name, v in zip(state_names, state_vals):
+                    saved[name] = hs[name].data
+                    hs[name].data = v
+                out = fn(*args, **kwargs)
+                new_state = [hs[n].data for n in state_names]
+                # flatten outputs treating Tensors as leaves (don't let the
+                # pytree registration split them — we need to tag them)
+                out_flat, out_treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, Tensor))
+                slots, out_arrays = [], []
+                for o in out_flat:
+                    if isinstance(o, Tensor):
+                        slots.append(("arr", None))
+                        out_arrays.append(o.data)
+                    elif isinstance(o, (jax.Array, np.ndarray)):
+                        slots.append(("arr", None))
+                        out_arrays.append(jnp.asarray(o))
+                    else:
+                        slots.append(("static", o))
+                meta["slots"] = slots
+                meta["treedef"] = out_treedef
+                for m in models:
+                    for p in m.parameters():
+                        p._grad = None
+                return out_arrays, new_state
+            finally:
+                for name, v in saved.items():
+                    hs[name].data = v
+
+        donate = (0,) if self._donate else ()
+        jitted = jax.jit(traced, donate_argnums=donate, **self._jit_kwargs)
+        return {"jitted": jitted, "meta": meta}
+
+
+def to_static(function=None, input_spec=None, models=None, optimizers=None,
+              donate_state=True, **kwargs):
+    """Decorator/wrapper: compile a dygraph step into one XLA computation.
+
+    reference: paddle.jit.to_static (dygraph_to_static/program_translator.py)
+    — here via functional-state tracing instead of AST rewriting.
+    """
+    def wrap(fn):
+        return StaticFunction(fn, models=models, optimizers=optimizers,
+                              donate_state=donate_state)
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+# ---------------------------------------------------------------------------
+# recompute (gradient checkpointing)
+
+def recompute(layer_or_fn, *args, **kwargs):
+    """Run a Layer/function with rematerialization (reference:
+    RecomputeOptimizer / fleet recompute; TPU-native: jax.checkpoint).
+
+    Usage: ``out = jit.recompute(block, x)`` — activations inside `block`
+    are recomputed during backward, trading FLOPs for HBM.
+    """
+    from .dispatch import apply
+    from .nn.layer import bind_state
+    from . import autograd as _ag
+
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+        holder_map = dict(layer.named_parameters())
+        for n, b in layer.named_buffers():
+            if isinstance(b, Tensor):
+                holder_map["buffer:" + n] = b
+        names = sorted(holder_map)
+
+        def impl(x, *param_vals):
+            vals = dict(zip(names, param_vals))
+            with bind_state(layer, vals):
+                with _ag.no_grad():
+                    out = layer(Tensor(x), **kwargs)
+            return out.data if isinstance(out, Tensor) else out
+
+        ckpt = jax.checkpoint(impl)
+        tensors = (args[0],) + tuple(holder_map[n] for n in names)
+        return apply(ckpt, tensors, name="recompute")
+
+    fn = layer_or_fn
+
+    def impl(*xs):
+        with _ag.no_grad():
+            out = fn(*[Tensor(x) for x in xs])
+        return out.data if isinstance(out, Tensor) else out
+
+    return apply(jax.checkpoint(impl), args, name="recompute")
+
+
+class TracedLayer:
+    """reference: fluid.dygraph.TracedLayer — trace a layer for inference."""
+
+    def __init__(self, layer, example_inputs):
+        self._layer = layer
+        self._static = to_static(lambda *xs: layer(*xs), models=[layer],
+                                 optimizers=[])
+        self._example = example_inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer, inputs)
+        out = tl(*inputs)
+        return out, tl
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+
+def save(layer, path, input_spec=None):
+    """paddle.jit.save parity — delegates to io.save_inference_model."""
+    from . import io as pio
+    pio.save_inference_model(path, layer, input_spec=input_spec)
+
+
+def load(path):
+    from . import io as pio
+    return pio.load_inference_model(path)

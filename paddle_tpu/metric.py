@@ -1,0 +1,264 @@
+"""paddle_tpu.metric — evaluation metrics.
+
+TPU-native rebuild of reference python/paddle/fluid/metrics.py
+(MetricBase, Accuracy, Precision, Recall, Auc, CompositeMetric,
+ChunkEvaluator, EditDistance) + layers.accuracy/auc. Device work (argmax,
+comparisons) runs as jax ops; scalar accumulation is host-side numpy, like
+the reference's numpy accumulators.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from .tensor import Tensor
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(jax.device_get(x.data))
+    return np.asarray(x)
+
+
+class Metric:
+    """Base (reference: metrics.py:MetricBase)."""
+
+    def __init__(self, name=None):
+        self._name = name or self.__class__.__name__
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    # fluid-era alias
+    def eval(self):
+        return self.accumulate()
+
+
+MetricBase = Metric
+
+
+class Accuracy(Metric):
+    """reference: metrics.py:Accuracy (+ layers.accuracy top-k)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__(name)
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label):
+        """Returns per-sample correctness for each k."""
+        pred = _np(pred)
+        label = _np(label).reshape(-1)
+        maxk = max(self.topk)
+        top = np.argsort(-pred, axis=-1)[..., :maxk]
+        correct = top == label[:, None]
+        return correct
+
+    def update(self, correct_or_pred, label=None):
+        if label is not None:
+            correct = self.compute(correct_or_pred, label)
+        else:
+            correct = _np(correct_or_pred)
+        for i, k in enumerate(self.topk):
+            self.total[i] += correct[..., :k].any(axis=-1).sum()
+            self.count[i] += correct.shape[0]
+        return self.total / np.maximum(self.count, 1)
+
+    def accumulate(self):
+        acc = self.total / np.maximum(self.count, 1)
+        return acc[0] if len(self.topk) == 1 else acc
+
+
+class Precision(Metric):
+    """reference: metrics.py:Precision (binary)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(np.int64).reshape(-1)
+        labels = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(Metric):
+    """reference: metrics.py:Recall."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = np.rint(_np(preds)).astype(np.int64).reshape(-1)
+        labels = _np(labels).astype(np.int64).reshape(-1)
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class Auc(Metric):
+    """reference: metrics.py:Auc — histogram-bucketed ROC AUC (matches the
+    reference's stat_pos/stat_neg accumulator design)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name)
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.reshape(-1)
+        labels = _np(labels).reshape(-1)
+        idx = np.clip((preds * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx[labels == 1], 1)
+        np.add.at(self._stat_neg, idx[labels == 0], 1)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # P(score_pos > score_neg) + 0.5 P(tie): ascending buckets, count
+        # negatives strictly below + half of same-bucket ties
+        area = 0.0
+        cum_neg = 0.0
+        for p, n in zip(self._stat_pos, self._stat_neg):
+            area += p * (cum_neg + n / 2.0)
+            cum_neg += n
+        return float(area / (tot_pos * tot_neg))
+
+
+class CompositeMetric(Metric):
+    """reference: metrics.py:CompositeMetric."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def accumulate(self):
+        return [m.accumulate() for m in self._metrics]
+
+
+class ChunkEvaluator(Metric):
+    """reference: metrics.py:ChunkEvaluator — sequence chunk F1 from
+    (num_infer_chunks, num_label_chunks, num_correct_chunks) counts."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.num_infer = 0
+        self.num_label = 0
+        self.num_correct = 0
+
+    def update(self, num_infer_chunks, num_label_chunks, num_correct_chunks):
+        self.num_infer += int(_np(num_infer_chunks).sum())
+        self.num_label += int(_np(num_label_chunks).sum())
+        self.num_correct += int(_np(num_correct_chunks).sum())
+
+    def accumulate(self):
+        precision = self.num_correct / self.num_infer if self.num_infer else 0
+        recall = self.num_correct / self.num_label if self.num_label else 0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(Metric):
+    """reference: metrics.py:EditDistance (normalized levenshtein)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self):
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    @staticmethod
+    def _levenshtein(a, b):
+        m, n = len(a), len(b)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                cost = 0 if a[i - 1] == b[j - 1] else 1
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1, prev[j - 1] + cost)
+        return int(dp[n])
+
+    def update(self, hyps, refs):
+        for h, r in zip(hyps, refs):
+            h = list(_np(h).reshape(-1)) if not isinstance(h, str) else h
+            r = list(_np(r).reshape(-1)) if not isinstance(r, str) else r
+            d = self._levenshtein(h, r)
+            norm = d / max(len(r), 1)
+            self.total_distance += norm
+            self.seq_num += 1
+            if d > 0:
+                self.instance_error += 1
+
+    def accumulate(self):
+        if not self.seq_num:
+            return 0.0, 0.0
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+# functional surface (reference: layers.accuracy / layers.auc)
+def accuracy(input, label, k=1):
+    pred = _np(input)
+    label = _np(label).reshape(-1)
+    top = np.argsort(-pred, axis=-1)[..., :k]
+    correct = (top == label[:, None]).any(axis=-1)
+    return Tensor(np.asarray(correct.mean(), np.float32))

@@ -1,0 +1,35 @@
+"""paddle_tpu.models — the model zoo.
+
+TPU-native rebuild of the reference's flagship models (reference: the Book
+chapters + fluid/tests configs: LeNet/MNIST, VGG, ResNet-50, MobileNet,
+BERT, Transformer (WMT), Wide&Deep, DeepFM, word2vec).
+"""
+from .lenet import LeNet
+
+__all__ = ["LeNet"]
+
+
+def __getattr__(name):
+    # lazy imports keep `import paddle_tpu` light
+    if name in ("ResNet", "resnet50", "resnet18", "resnet34", "resnet101"):
+        from . import resnet
+        return getattr(resnet, name)
+    if name in ("VGG", "vgg16", "vgg19"):
+        from . import vgg
+        return getattr(vgg, name)
+    if name in ("MobileNetV1", "MobileNetV2"):
+        from . import mobilenet
+        return getattr(mobilenet, name)
+    if name in ("Bert", "BertConfig", "BertForPretraining"):
+        from . import bert
+        return getattr(bert, name)
+    if name in ("Transformer",):
+        from . import transformer
+        return getattr(transformer, name)
+    if name in ("WideDeep", "DeepFM"):
+        from . import ctr
+        return getattr(ctr, name)
+    if name in ("Word2Vec", "SkipGram"):
+        from . import word2vec
+        return getattr(word2vec, name)
+    raise AttributeError(name)

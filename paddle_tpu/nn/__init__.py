@@ -1,0 +1,20 @@
+"""paddle_tpu.nn — Layers, containers, losses, functional.
+
+TPU-native rebuild of the reference's paddle.fluid.dygraph layer API
+(reference: python/paddle/fluid/dygraph/{layers,nn,container}.py).
+"""
+from .layer import Layer, functional_call, state_pytree, bind_state
+from .container import Sequential, LayerList, ParameterList
+from .layers import (
+    Linear, Conv2D, Conv2DTranspose, Conv3D, MaxPool2D, AvgPool2D,
+    AdaptiveAvgPool2D, Pool2D, BatchNorm, BatchNorm1D, BatchNorm2D,
+    BatchNorm3D, SyncBatchNorm, LayerNorm, GroupNorm, InstanceNorm2D,
+    SpectralNorm, Embedding, Dropout, PRelu, BilinearTensorProduct, GRUUnit,
+    Flatten, Upsample, Pad2D,
+    ReLU, ReLU6, LeakyReLU, GELU, Sigmoid, Tanh, Softmax, LogSoftmax,
+    Softplus, Hardswish, Hardsigmoid, Swish, Silu, Mish, ELU, SELU, Hardtanh,
+)
+from .loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, BCELoss,
+                   BCEWithLogitsLoss, KLDivLoss, NLLLoss, MarginRankingLoss)
+from . import functional
+from . import functional as F

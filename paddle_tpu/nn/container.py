@@ -1,0 +1,104 @@
+"""paddle_tpu.nn.container — Sequential / LayerList / ParameterList.
+
+TPU-native rebuild of reference python/paddle/fluid/dygraph/container.py.
+"""
+from __future__ import annotations
+
+from .layer import Layer
+from ..tensor import Parameter
+
+
+class Sequential(Layer):
+    """reference: container.py:Sequential — accepts layers or (name, layer)
+    tuples."""
+
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and \
+                layers[0] and isinstance(layers[0][0], (list, tuple)):
+            layers = layers[0]
+        for i, item in enumerate(layers):
+            if isinstance(item, (list, tuple)):
+                name, layer = item
+            else:
+                name, layer = str(i), item
+            self.add_sublayer(name, layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return Sequential(*list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+
+class LayerList(Layer):
+    """reference: container.py:LayerList."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers is not None:
+            for i, layer in enumerate(sublayers):
+                self.add_sublayer(str(i), layer)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return LayerList(list(self._sub_layers.values())[idx])
+        return list(self._sub_layers.values())[idx]
+
+    def __setitem__(self, idx, layer):
+        self._sub_layers[str(idx)] = layer
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for layer in layers:
+            self.append(layer)
+        return self
+
+    def insert(self, index, layer):
+        items = list(self._sub_layers.values())
+        items.insert(index, layer)
+        self._sub_layers.clear()
+        for i, l in enumerate(items):
+            self._sub_layers[str(i)] = l
+
+
+class ParameterList(Layer):
+    """reference: container.py:ParameterList."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters is not None:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def __getitem__(self, idx):
+        return list(self._parameters.values())[idx]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+    def append(self, parameter):
+        self.add_parameter(str(len(self._parameters)), parameter)
+        return self

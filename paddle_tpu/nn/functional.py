@@ -1,0 +1,8 @@
+"""paddle_tpu.nn.functional — functional NN API surface.
+
+Mirrors paddle.nn.functional by re-exporting the op library
+(reference: python/paddle/fluid/layers/nn.py + loss.py functional surface).
+"""
+from ..ops.nn_ops import *  # noqa: F401,F403
+from ..ops.loss import *  # noqa: F401,F403
+from ..ops.manip import one_hot, pad  # noqa: F401

@@ -1,0 +1,523 @@
+"""paddle_tpu.nn.layers — the dygraph Layer zoo.
+
+TPU-native rebuild of the reference's dygraph layers
+(reference: python/paddle/fluid/dygraph/nn.py — Linear, Conv2D, Conv3D,
+Conv2DTranspose, Pool2D, BatchNorm, LayerNorm, GroupNorm, InstanceNorm,
+SpectralNorm, Embedding, Dropout, PRelu, NCE, BilinearTensorProduct,
+GRUUnit). Parameters are created eagerly at construction (no LayerHelper /
+startup Program); forward calls the pure functional ops, so every Layer
+works identically in eager, to_static, and static-Program modes.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor, Parameter, convert_dtype
+from .. import initializer as I
+from .. import ops
+from ..ops import nn_ops as F
+from .layer import Layer
+
+
+class Linear(Layer):
+    """reference: dygraph/nn.py:Linear (weight [in, out] + bias)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                (out_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def __repr__(self):
+        return (f"Linear(in={self.in_features}, out={self.out_features}, "
+                f"bias={self.bias is not None})")
+
+
+class Conv2D(Layer):
+    """reference: dygraph/nn.py:Conv2D. Weight layout OIHW (API parity);
+    XLA re-lays out for the MXU internally."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        ks = F._pair(kernel_size, 2)
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation,
+                           groups=groups, data_format=data_format)
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, ks[0], ks[1]),
+            attr=weight_attr,
+            default_initializer=I.Normal(0.0, np.sqrt(2.0 / fan_in)))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, **self._attrs)
+
+
+class Conv2DTranspose(Layer):
+    """reference: dygraph/nn.py:Conv2DTranspose (weight IOHW)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        ks = F._pair(kernel_size, 2)
+        self._attrs = dict(stride=stride, padding=padding,
+                           output_padding=output_padding, dilation=dilation,
+                           groups=groups, data_format=data_format)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, ks[0], ks[1]),
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self.bias, **self._attrs)
+
+
+class Conv3D(Layer):
+    """reference: dygraph/nn.py:Conv3D."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        ks = F._pair(kernel_size, 3)
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation,
+                           groups=groups, data_format=data_format)
+        fan_in = in_channels * int(np.prod(ks)) // groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + ks, attr=weight_attr,
+            default_initializer=I.Normal(0.0, np.sqrt(2.0 / fan_in)))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, **self._attrs)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 data_format="NCHW"):
+        super().__init__()
+        self._a = dict(kernel_size=kernel_size, stride=stride,
+                       padding=padding, ceil_mode=ceil_mode,
+                       data_format=data_format)
+
+    def forward(self, x):
+        return F.max_pool2d(x, **self._a)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 data_format="NCHW"):
+        super().__init__()
+        self._a = dict(kernel_size=kernel_size, stride=stride,
+                       padding=padding, exclusive=exclusive,
+                       data_format=data_format)
+
+    def forward(self, x):
+        return F.avg_pool2d(x, **self._a)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self._a = dict(output_size=output_size, data_format=data_format)
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, **self._a)
+
+
+class Pool2D(Layer):
+    """fluid.dygraph.Pool2D parity shim."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, data_format="NCHW"):
+        super().__init__()
+        self._a = dict(pool_size=pool_size, pool_type=pool_type,
+                       pool_stride=pool_stride, pool_padding=pool_padding,
+                       global_pooling=global_pooling, data_format=data_format)
+
+    def forward(self, x):
+        return F.pool2d(x, **self._a)
+
+
+class BatchNorm(Layer):
+    """reference: dygraph/nn.py:BatchNorm. Running stats live in buffers;
+    forward in train mode returns fresh stats and we write them back
+    (functionally visible to to_static as carried state)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 dtype=None):
+        super().__init__(dtype=dtype)
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros((num_features,), self._dtype)))
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones((num_features,), self._dtype)))
+
+    def forward(self, x):
+        out, new_mean, new_var = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self._momentum,
+            epsilon=self._epsilon, data_format=self._data_format)
+        if self.training:
+            self._mean.data = new_mean.data
+            self._variance.data = new_var.data
+        return out
+
+
+class BatchNorm1D(BatchNorm):
+    def __init__(self, num_features, **kw):
+        kw.setdefault("data_format", "NCL")
+        super().__init__(num_features, **kw)
+
+
+class BatchNorm2D(BatchNorm):
+    pass
+
+
+class BatchNorm3D(BatchNorm):
+    def __init__(self, num_features, **kw):
+        kw.setdefault("data_format", "NCDHW")
+        super().__init__(num_features, **kw)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BN (reference: sync_batch_norm_op.cu): inside a
+    shard_map region with the data-parallel axis bound, batch statistics
+    are psum-averaged over that axis, so all replicas normalize with the
+    same global-batch stats; running stats are updated from the synced
+    values. Outside SPMD it degrades to ordinary BatchNorm."""
+
+    def __init__(self, num_features, axis_name="dp", **kw):
+        super().__init__(num_features, **kw)
+        self._axis_name = axis_name
+
+    def forward(self, x):
+        from ..parallel import collective
+        from ..dispatch import apply as _apply
+        if not (self.training and collective.in_spmd_context(
+                self._axis_name)):
+            return super().forward(x)
+
+        axis_name = self._axis_name
+        momentum, eps = self._momentum, self._epsilon
+        chan_first = self._data_format.startswith("NC")
+
+        def impl(x, rm, rv, w, b):
+            import jax.numpy as jnp
+            from jax import lax
+            axes = ((0,) + tuple(range(2, x.ndim))) if chan_first else \
+                tuple(range(x.ndim - 1))
+            shape = ((1, -1) + (1,) * (x.ndim - 2)) if chan_first else \
+                ((1,) * (x.ndim - 1) + (-1,))
+            s = lax.psum(jnp.sum(x, axis=axes), axis_name)
+            sq = lax.psum(jnp.sum(jnp.square(x), axis=axes), axis_name)
+            cnt = lax.psum(jnp.asarray(
+                np.prod([x.shape[a] for a in axes]), jnp.float32), axis_name)
+            mean = s / cnt
+            var = sq / cnt - jnp.square(mean)
+            new_rm = momentum * rm + (1 - momentum) * mean
+            new_rv = momentum * rv + (1 - momentum) * var
+            out = (x - mean.reshape(shape)) * lax.rsqrt(var + eps)
+            out = out * w.reshape(shape) + b.reshape(shape)
+            return out, new_rm, new_rv
+
+        out, new_mean, new_var = _apply(
+            impl, (x, self._mean, self._variance, self.weight, self.bias),
+            n_out=3, name="sync_batch_norm")
+        self._mean.data = new_mean.data
+        self._variance.data = new_var.data
+        return out
+
+
+class LayerNorm(Layer):
+    """reference: dygraph/nn.py:LayerNorm (fused kernel → XLA/Pallas)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        if weight_attr is False:
+            self.weight = None
+        else:
+            self.weight = self.create_parameter(
+                self._normalized_shape, attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(self._normalized_shape,
+                                              attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self._normalized_shape, self.weight,
+                            self.bias, self._epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self._a = dict(num_groups=num_groups, epsilon=epsilon,
+                       data_format=data_format)
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_channels,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, weight=self.weight, bias=self.bias, **self._a)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self._epsilon)
+
+
+class SpectralNorm(Layer):
+    """reference: dygraph/nn.py:SpectralNorm — power-iteration normalized
+    weight. Returns the normalized weight of shape `weight_shape`."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ..dispatch import apply
+        dim, iters, eps = self._dim, self._power_iters, self._eps
+
+        def impl(w, u, v):
+            mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma, u, v
+
+        out, u, v = apply(impl, (weight, self.weight_u, self.weight_v),
+                          n_out=3, name="spectral_norm")
+        self.weight_u.data = u.data
+        self.weight_v.data = v.data
+        return out
+
+
+class Embedding(Layer):
+    """reference: dygraph/nn.py:Embedding (lookup_table)."""
+
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self._padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0 / np.sqrt(embedding_dim)))
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train"):
+        super().__init__()
+        self._a = dict(p=p, axis=axis, mode=mode)
+
+    def forward(self, x):
+        return F.dropout(x, training=self.training, **self._a)
+
+
+class PRelu(Layer):
+    """reference: dygraph/nn.py:PRelu (modes: all/channel/element)."""
+
+    def __init__(self, mode="all", channel=None, input_shape=None,
+                 weight_attr=None):
+        super().__init__()
+        if mode == "all":
+            shape = (1,)
+        elif mode == "channel":
+            shape = (channel,)
+        else:
+            shape = tuple(input_shape)
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=I.Constant(0.25))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class BilinearTensorProduct(Layer):
+    """reference: dygraph/nn.py:BilinearTensorProduct
+    out_k = x W_k y^T + b_k."""
+
+    def __init__(self, input1_dim, input2_dim, output_dim, name=None,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (output_dim, input1_dim, input2_dim), attr=weight_attr)
+        self.bias = self.create_parameter((output_dim,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, y):
+        from ..dispatch import apply
+        def impl(x, y, w, b):
+            return jnp.einsum("bi,oij,bj->bo", x, w, y) + b
+        return apply(impl, (x, y, self.weight, self.bias),
+                     name="bilinear_tensor_product")
+
+
+class GRUUnit(Layer):
+    """reference: dygraph/nn.py:GRUUnit — one GRU step (gate_weight holds
+    update/reset gates, candidate_weight the candidate state)."""
+
+    def __init__(self, size, weight_attr=None, bias_attr=None,
+                 activation="tanh", gate_activation="sigmoid"):
+        super().__init__()
+        d = size // 3
+        self._hidden = d
+        self.gate_weight = self.create_parameter((d, d * 2), attr=weight_attr)
+        self.candidate_weight = self.create_parameter((d, d),
+                                                      attr=weight_attr)
+        self.gate_bias = self.create_parameter((d * 2,), attr=bias_attr,
+                                               is_bias=True)
+        self.candidate_bias = self.create_parameter((d,), attr=bias_attr,
+                                                    is_bias=True)
+        self._act = getattr(jnp, activation) if hasattr(jnp, activation) \
+            else jnp.tanh
+        import jax
+        self._gate_act = jax.nn.sigmoid if gate_activation == "sigmoid" \
+            else jnp.tanh
+
+    def forward(self, input, hidden):
+        from ..dispatch import apply
+        d = self._hidden
+        act, gate_act = self._act, self._gate_act
+
+        def impl(x, h, gw, cw, gb, cb):
+            xu, xr, xc = x[:, :d], x[:, d:2 * d], x[:, 2 * d:]
+            gates = gate_act(jnp.concatenate([xu, xr], 1) + h @ gw + gb)
+            u, r = gates[:, :d], gates[:, d:]
+            c = act(xc + (r * h) @ cw + cb)
+            new_h = u * h + (1 - u) * c
+            return new_h, r, c
+
+        out = apply(impl, (input, hidden, self.gate_weight,
+                           self.candidate_weight, self.gate_bias,
+                           self.candidate_bias), n_out=3, name="gru_unit")
+        return out  # (hidden, reset_hidden_pre, gate)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self._start, self._stop = start_axis, stop_axis
+
+    def forward(self, x):
+        return ops.flatten(x, self._start, self._stop)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW"):
+        super().__init__()
+        self._a = dict(size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._a)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW"):
+        super().__init__()
+        self._padding = padding
+        self._mode = mode
+        self._value = value
+
+    def forward(self, x):
+        return ops.pad(x, self._padding, self._mode, self._value)
+
+
+# -- simple activation layers ------------------------------------------------
+
+def _act_layer(name, fn):
+    class _Act(Layer):
+        def __init__(self, *a, **kw):
+            super().__init__()
+            self._a, self._kw = a, kw
+
+        def forward(self, x):
+            return fn(x, *self._a, **self._kw)
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu)
+GELU = _act_layer("GELU", F.gelu)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+Tanh = _act_layer("Tanh", ops.tanh)
+Softmax = _act_layer("Softmax", F.softmax)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax)
+Softplus = _act_layer("Softplus", F.softplus)
+Hardswish = _act_layer("Hardswish", F.hard_swish)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hard_sigmoid)
+Swish = _act_layer("Swish", F.swish)
+Silu = _act_layer("Silu", F.silu)
+Mish = _act_layer("Mish", F.mish)
+ELU = _act_layer("ELU", F.elu)
+SELU = _act_layer("SELU", F.selu)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh)

@@ -1,0 +1,95 @@
+"""paddle_tpu.nn.loss — loss Layer classes.
+
+Layer wrappers over paddle_tpu.ops.loss (reference: paddle.nn loss layers /
+fluid.dygraph loss usage patterns).
+"""
+from __future__ import annotations
+
+from .layer import Layer
+from ..ops import loss as L
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, axis=-1, use_softmax=True):
+        super().__init__()
+        self._a = dict(ignore_index=ignore_index, reduction=reduction,
+                       soft_label=soft_label, axis=axis,
+                       use_softmax=use_softmax)
+
+    def forward(self, input, label):
+        return L.cross_entropy(input, label, **self._a)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return L.mse_loss(input, label, self._reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return L.l1_loss(input, label, self._reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self._a = dict(reduction=reduction, delta=delta)
+
+    def forward(self, input, label):
+        return L.smooth_l1_loss(input, label, **self._a)
+
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return L.binary_cross_entropy(input, label,
+                                      reduction=self._reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", pos_weight=None):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, logit, label):
+        return L.binary_cross_entropy_with_logits(
+            logit, label, reduction=self._reduction)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self._reduction = reduction
+
+    def forward(self, input, label):
+        return L.kl_div(input, label, self._reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean"):
+        super().__init__()
+        self._a = dict(ignore_index=ignore_index, reduction=reduction)
+
+    def forward(self, input, label):
+        return L.nll_loss(input, label, **self._a)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self._a = dict(margin=margin, reduction=reduction)
+
+    def forward(self, input, other, label):
+        return L.margin_ranking_loss(input, other, label, **self._a)

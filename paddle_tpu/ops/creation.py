@@ -1,0 +1,156 @@
+"""paddle_tpu.ops.creation — tensor creation + random ops.
+
+TPU-native rebuild of the reference's fill/creation operators
+(reference: paddle/fluid/operators/{fill_constant_op, uniform_random_op,
+gaussian_random_op, range_op, linspace_op, eye}.cc; python surface in
+fluid/layers/tensor.py). Random ops draw subkeys from the global threaded
+PRNG (paddle_tpu.random) instead of stateful curand generators.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, as_tensor, convert_dtype, get_default_dtype
+from ..dispatch import apply
+from .. import random as prandom
+
+
+def _dt(dtype, default=None):
+    dt = convert_dtype(dtype)
+    return dt if dt is not None else (default or get_default_dtype())
+
+
+def zeros(shape, dtype="float32", name=None):
+    return Tensor(jnp.zeros(tuple(shape), _dt(dtype)))
+
+
+def ones(shape, dtype="float32", name=None):
+    return Tensor(jnp.ones(tuple(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full(tuple(shape), fill_value, _dt(dtype)))
+
+
+fill_constant = full
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return apply(lambda x, dt: jnp.zeros(x.shape, dt or x.dtype), (x,),
+                 dict(dt=convert_dtype(dtype)), nondiff=True,
+                 name="zeros_like")
+
+
+def ones_like(x, dtype=None, name=None):
+    x = as_tensor(x)
+    return apply(lambda x, dt: jnp.ones(x.shape, dt or x.dtype), (x,),
+                 dict(dt=convert_dtype(dtype)), nondiff=True,
+                 name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = as_tensor(x)
+    return apply(lambda x, v, dt: jnp.full(x.shape, v, dt or x.dtype), (x,),
+                 dict(v=fill_value, dt=convert_dtype(dtype)), nondiff=True,
+                 name="full_like")
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    dt = convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+range = arange
+
+
+def linspace(start, stop, num, dtype="float32", name=None):
+    return Tensor(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def assign(x, output=None):
+    """reference: assign_op.cc"""
+    x = as_tensor(x)
+    out = apply(lambda x: x + 0, (x,), name="assign")
+    if output is not None:
+        output.set_value(out.data)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return apply(lambda x: x + 0, (x,), name="clone")
+
+
+# ---------------------------------------------------------------------------
+# random creation — global threaded PRNG key, jit-friendly
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    """reference: uniform_random_op.cc"""
+    key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+    return Tensor(jax.random.uniform(key, tuple(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+uniform_random = uniform
+rand = lambda shape, dtype="float32": uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype="float32", name=None):
+    return Tensor(jax.random.normal(prandom.next_key(), tuple(shape),
+                                    _dt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    """reference: gaussian_random_op.cc"""
+    out = jax.random.normal(prandom.next_key(), tuple(shape), get_default_dtype())
+    return Tensor(out * std + mean)
+
+
+gaussian = normal
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(prandom.next_key(), tuple(shape), low,
+                                     high, dtype=_dt(dtype, jnp.int64)))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(prandom.next_key(),
+                                         n).astype(_dt(dtype, jnp.int64)))
+
+
+def bernoulli(x, name=None):
+    x = as_tensor(x)
+    key = prandom.next_key()
+    return apply(lambda x, key: jax.random.bernoulli(
+        key, x).astype(x.dtype), (x,), dict(key=key), nondiff=True,
+        name="bernoulli")
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = as_tensor(x)
+    key = prandom.next_key()
+    def impl(x, key, num_samples, replacement):
+        logits = jnp.log(jnp.maximum(x, 1e-30))
+        idt = convert_dtype("int64")
+        if replacement:
+            out = jax.random.categorical(
+                key, logits, axis=-1, shape=(num_samples,) + x.shape[:-1])
+            return jnp.moveaxis(out, 0, -1).astype(idt)
+        # without replacement: Gumbel top-k over the category axis
+        g = jax.random.gumbel(key, logits.shape, logits.dtype)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(idt)
+    return apply(impl, (x,), dict(key=key, num_samples=num_samples,
+                                  replacement=replacement), nondiff=True,
+                 name="multinomial")

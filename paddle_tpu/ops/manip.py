@@ -1,0 +1,312 @@
+"""paddle_tpu.ops.manip — shape/layout/index manipulation ops.
+
+TPU-native rebuild of the reference's tensor-manipulation operators
+(reference: paddle/fluid/operators/{reshape_op, transpose_op, concat_op,
+split_op, slice_op, gather_op, scatter_op, stack_op, squeeze_op, expand_op,
+pad_op, one_hot_op}.cc; python surface in fluid/layers/nn.py + tensor.py).
+All static-shape friendly: XLA requires static shapes under jit, so dynamic
+outputs (e.g. masked select) are either avoided or documented as eager-only.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor, as_tensor, convert_dtype
+from ..dispatch import apply
+
+_slice = __builtins__["slice"] if isinstance(__builtins__, dict) else __builtins__.slice
+
+
+def reshape(x, shape, name=None):
+    def impl(x, shape):
+        return jnp.reshape(x, shape)
+    return apply(impl, (x,), dict(shape=tuple(shape)), name="reshape")
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def impl(x, start_axis, stop_axis):
+        nd = x.ndim
+        sa = start_axis % nd
+        so = stop_axis % nd
+        new_shape = x.shape[:sa] + (-1,) + x.shape[so + 1:]
+        return jnp.reshape(x, new_shape)
+    return apply(impl, (x,), dict(start_axis=start_axis, stop_axis=stop_axis),
+                 name="flatten")
+
+
+def transpose(x, perm, name=None):
+    return apply(lambda x, perm: jnp.transpose(x, perm), (x,),
+                 dict(perm=tuple(perm)), name="transpose")
+
+
+def concat(xs, axis=0, name=None):
+    def impl(*arrays, axis):
+        return jnp.concatenate(arrays, axis=axis)
+    return apply(impl, tuple(xs), dict(axis=axis), name="concat")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    """reference: split_op.cc — returns a list of tensors."""
+    def impl(x, num_or_sections, axis):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(x, num_or_sections, axis=axis))
+        sizes = list(num_or_sections)
+        total = x.shape[axis]
+        if -1 in sizes:
+            known = sum(s for s in sizes if s != -1)
+            sizes[sizes.index(-1)] = total - known
+        offsets = []
+        acc = 0
+        for s in sizes[:-1]:
+            acc += s
+            offsets.append(acc)
+        return tuple(jnp.split(x, offsets, axis=axis))
+    n = num_or_sections if isinstance(num_or_sections, int) else len(
+        num_or_sections)
+    sections = (tuple(num_or_sections)
+                if not isinstance(num_or_sections, int) else num_or_sections)
+    out = apply(impl, (x,), dict(num_or_sections=sections, axis=axis),
+                n_out=n, name="split")
+    return list(out)
+
+
+def stack(xs, axis=0, name=None):
+    def impl(*arrays, axis):
+        return jnp.stack(arrays, axis=axis)
+    return apply(impl, tuple(xs), dict(axis=axis), name="stack")
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else as_tensor(x).shape[axis]
+    def impl(x, axis, n):
+        return tuple(jnp.moveaxis(x, axis, 0)[i] for i in range(n))
+    out = apply(impl, (x,), dict(axis=axis, n=n), n_out=n, name="unstack")
+    return list(out)
+
+
+def squeeze(x, axis=None, name=None):
+    def impl(x, axis):
+        if axis is None:
+            return jnp.squeeze(x)
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axes) if axes else x
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(impl, (x,), dict(axis=ax), name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    def impl(x, axis):
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        for a in sorted(axes):
+            x = jnp.expand_dims(x, a)
+        return x
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(impl, (x,), dict(axis=ax), name="unsqueeze")
+
+
+def expand(x, shape, name=None):
+    """reference: expand_op.cc (expand_v2 semantics: -1 keeps dim)."""
+    def impl(x, shape):
+        shape = list(shape)
+        offset = len(shape) - x.ndim
+        for i in range(len(shape)):
+            if shape[i] == -1:
+                shape[i] = x.shape[i - offset]
+        return jnp.broadcast_to(x, tuple(shape))
+    return apply(impl, (x,), dict(shape=tuple(shape)), name="expand")
+
+
+broadcast_to = expand
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda x, y: jnp.broadcast_to(x, y.shape), (x, y),
+                 name="expand_as")
+
+
+def tile(x, repeat_times, name=None):
+    return apply(lambda x, reps: jnp.tile(x, reps), (x,),
+                 dict(reps=tuple(repeat_times)), name="tile")
+
+
+def slice(x, axes, starts, ends, name=None):
+    """reference: slice_op.cc"""
+    def impl(x, axes, starts, ends):
+        idx = [_slice(None)] * x.ndim
+        for ax, st, en in zip(axes, starts, ends):
+            idx[ax] = _slice(st, en)
+        return x[tuple(idx)]
+    return apply(impl, (x,), dict(axes=tuple(axes), starts=tuple(starts),
+                                  ends=tuple(ends)), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def impl(x, axes, starts, ends, strides):
+        idx = [_slice(None)] * x.ndim
+        for ax, st, en, sr in zip(axes, starts, ends, strides):
+            idx[ax] = _slice(st, en, sr)
+        return x[tuple(idx)]
+    return apply(impl, (x,), dict(axes=tuple(axes), starts=tuple(starts),
+                                  ends=tuple(ends), strides=tuple(strides)),
+                 name="strided_slice")
+
+
+def gather(x, index, axis=0, name=None):
+    """reference: gather_op.cc — gather rows along axis."""
+    def impl(x, index, axis):
+        return jnp.take(x, index, axis=axis)
+    return apply(impl, (x, index), dict(axis=axis), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    """reference: gather_nd_op.cc"""
+    def impl(x, index):
+        return x[tuple(jnp.moveaxis(index, -1, 0))]
+    return apply(impl, (x, index), name="gather_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis=axis, name=name)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    """reference: scatter_op.cc — writes updates rows into x at index."""
+    def impl(x, index, updates, overwrite):
+        if overwrite:
+            return x.at[index].set(updates)
+        # accumulate semantics: zero the rows then add (matches reference)
+        zeroed = x.at[index].set(jnp.zeros_like(updates))
+        return zeroed.at[index].add(updates)
+    return apply(impl, (x, index, updates), dict(overwrite=overwrite),
+                 name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def impl(x, index, updates):
+        return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+    return apply(impl, (x, index, updates), name="scatter_nd_add")
+
+
+def put_along_axis(x, index, values, axis, name=None):
+    def impl(x, index, values, axis):
+        return jnp.put_along_axis(x, index, values, axis=axis,
+                                  inplace=False)
+    return apply(impl, (x, index, values), dict(axis=axis),
+                 name="put_along_axis")
+
+
+def take_along_axis(x, index, axis, name=None):
+    def impl(x, index, axis):
+        return jnp.take_along_axis(x, index, axis=axis)
+    return apply(impl, (x, index), dict(axis=axis), name="take_along_axis")
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply(lambda x, axis: jnp.flip(x, axis=axis), (x,),
+                 dict(axis=ax), name="flip")
+
+
+reverse = flip
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda x, shifts, axis: jnp.roll(x, shifts, axis=axis),
+                 (x,), dict(shifts=shifts, axis=axis), name="roll")
+
+
+def pad(x, pad, mode="constant", value=0.0, name=None):
+    """paddle pad: flat list [lo0, hi0, lo1, hi1, ...] over ALL dims (old
+    fluid.layers.pad) — we accept that plus paddle2-style per-last-dims."""
+    def impl(x, pad, mode, value):
+        if len(pad) == 2 * x.ndim:
+            # fluid.layers.pad flat form: ascending dim order
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+        else:
+            # paddle2/torch form: last dim first ([left,right,top,bottom])
+            n = len(pad) // 2
+            pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(n)]
+            widths = [(0, 0)] * (x.ndim - n) + pairs[::-1]
+        if mode == "constant":
+            return jnp.pad(x, widths, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        return jnp.pad(x, widths, mode=jmode)
+    return apply(impl, (x,), dict(pad=tuple(pad), mode=mode, value=value),
+                 name="pad")
+
+
+def one_hot(x, num_classes, name=None):
+    """reference: one_hot_op.cc"""
+    def impl(x, num_classes):
+        return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+    out = apply(impl, (x,), dict(num_classes=num_classes), nondiff=True,
+                name="one_hot")
+    return out
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           name=None):
+    """Eager-only (dynamic output shape — not jittable on TPU)."""
+    x = as_tensor(x)
+    import numpy as np
+    arr = np.asarray(jax.device_get(x.data))
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def masked_select(x, mask, name=None):
+    """Eager-only (dynamic output shape)."""
+    x, mask = as_tensor(x), as_tensor(mask)
+    import numpy as np
+    arr = np.asarray(jax.device_get(x.data))
+    m = np.asarray(jax.device_get(mask.data))
+    return Tensor(arr[m])
+
+
+def diag(x, offset=0, name=None):
+    return apply(lambda x, offset: jnp.diag(x, k=offset), (x,),
+                 dict(offset=offset), name="diag")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply(lambda x, k: jnp.tril(x, k=k), (x,), dict(k=diagonal),
+                 name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply(lambda x, k: jnp.triu(x, k=k), (x,), dict(k=diagonal),
+                 name="triu")
+
+
+def meshgrid(*xs, name=None):
+    n = len(xs)
+    def impl(*arrays):
+        return tuple(jnp.meshgrid(*arrays, indexing="ij"))
+    return list(apply(impl, tuple(xs), n_out=n, name="meshgrid"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis, name=name)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: shard_index_op.cc (used by the PS/CTR path): map global ids
+    to shard-local ids, others to ignore_value."""
+    def impl(x, index_num, nshards, shard_id, ignore_value):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = (shard_id + 1) * shard_size
+        in_shard = (x >= lo) & (x < hi)
+        return jnp.where(in_shard, x - lo, ignore_value)
+    return apply(impl, (input,), dict(index_num=index_num, nshards=nshards,
+                                      shard_id=shard_id,
+                                      ignore_value=ignore_value),
+                 nondiff=True, name="shard_index")

@@ -1,0 +1,357 @@
+"""paddle_tpu.ops.math — elementwise math, reductions, linear algebra.
+
+TPU-native rebuild of the reference's math operators
+(reference: paddle/fluid/operators/elementwise/*, reduce_ops/*, matmul_op.cc,
+activation_op.cc; python surface in python/paddle/fluid/layers/{nn,ops,
+tensor}.py). One pure-jax impl per op, dispatched through
+paddle_tpu.dispatch.apply so the same definition serves dygraph (tape),
+to_static (traced), and static Program recording. Matmuls stay big and
+batched for the MXU; no per-element Python.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor, as_tensor, convert_dtype
+from ..dispatch import apply
+
+# ---------------------------------------------------------------------------
+# binary elementwise (numpy broadcasting, like reference elementwise ops)
+
+def _promote(x, y):
+    return x, y
+
+
+def _bin(name, fn):
+    def op(x, y, name=None):
+        return apply(fn, (x, y), name=name or op.__name__)
+    op.__name__ = name
+    return op
+
+
+elementwise_add = add = _bin("add", lambda x, y: jnp.add(x, y))
+elementwise_sub = subtract = _bin("subtract", lambda x, y: jnp.subtract(x, y))
+elementwise_mul = multiply = _bin("multiply", lambda x, y: jnp.multiply(x, y))
+elementwise_div = divide = _bin("divide", lambda x, y: jnp.divide(x, y))
+elementwise_pow = pow = _bin("pow", lambda x, y: jnp.power(x, y))
+elementwise_mod = mod = remainder = _bin("mod", lambda x, y: jnp.mod(x, y))
+elementwise_floordiv = floor_divide = _bin(
+    "floor_divide", lambda x, y: jnp.floor_divide(x, y))
+elementwise_max = maximum = _bin("maximum", lambda x, y: jnp.maximum(x, y))
+elementwise_min = minimum = _bin("minimum", lambda x, y: jnp.minimum(x, y))
+atan2 = _bin("atan2", lambda x, y: jnp.arctan2(x, y))
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise (reference: activation_op.cc + ops.py one-liners)
+
+def _un(name, fn, nondiff=False):
+    def op(x, name=None, **kw):
+        return apply(fn, (x,), attrs=kw, nondiff=nondiff,
+                     name=name or op.__name__)
+    op.__name__ = name
+    return op
+
+
+exp = _un("exp", jnp.exp)
+log = _un("log", jnp.log)
+log2 = _un("log2", jnp.log2)
+log10 = _un("log10", jnp.log10)
+log1p = _un("log1p", jnp.log1p)
+sqrt = _un("sqrt", jnp.sqrt)
+rsqrt = _un("rsqrt", lax.rsqrt)
+square = _un("square", jnp.square)
+abs = _un("abs", jnp.abs)
+neg = negative = _un("negative", jnp.negative)
+reciprocal = _un("reciprocal", jnp.reciprocal)
+sin = _un("sin", jnp.sin)
+cos = _un("cos", jnp.cos)
+tan = _un("tan", jnp.tan)
+asin = arcsin = _un("asin", jnp.arcsin)
+acos = arccos = _un("acos", jnp.arccos)
+atan = arctan = _un("atan", jnp.arctan)
+sinh = _un("sinh", jnp.sinh)
+cosh = _un("cosh", jnp.cosh)
+tanh = _un("tanh", jnp.tanh)
+asinh = _un("asinh", jnp.arcsinh)
+acosh = _un("acosh", jnp.arccosh)
+atanh = _un("atanh", jnp.arctanh)
+ceil = _un("ceil", jnp.ceil)
+floor = _un("floor", jnp.floor)
+round = _un("round", jnp.round)
+trunc = _un("trunc", jnp.trunc)
+sign = _un("sign", jnp.sign)
+erf = _un("erf", jax.scipy.special.erf)
+expm1 = _un("expm1", jnp.expm1)
+logical_not = _un("logical_not", jnp.logical_not, nondiff=True)
+isnan = _un("isnan", jnp.isnan, nondiff=True)
+isinf = _un("isinf", jnp.isinf, nondiff=True)
+isfinite = _un("isfinite", jnp.isfinite, nondiff=True)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, name=None):
+    """reference: paddle/fluid/operators/scale_op.cc"""
+    def impl(x, scale, bias, bias_after_scale):
+        if bias_after_scale:
+            return x * scale + bias
+        return (x + bias) * scale
+    return apply(impl, (x,), dict(scale=scale, bias=bias,
+                                  bias_after_scale=bias_after_scale),
+                 name="scale")
+
+
+def clip(x, min=None, max=None, name=None):
+    """reference: clip_op.cc"""
+    return apply(lambda x, lo, hi: jnp.clip(x, lo, hi), (x,),
+                 dict(lo=min, hi=max), name="clip")
+
+
+def cast(x, dtype):
+    """reference: cast_op.cc"""
+    dt = convert_dtype(dtype)
+    return apply(lambda x, dt: x.astype(dt), (x,), dict(dt=dt), name="cast")
+
+
+# ---------------------------------------------------------------------------
+# comparisons / logical (nondiff; reference: controlflow/compare_op.cc)
+
+def _binn(name, fn):
+    def op(x, y, name=None):
+        return apply(fn, (x, y), nondiff=True, name=name or op.__name__)
+    op.__name__ = name
+    return op
+
+
+equal = _binn("equal", lambda x, y: jnp.equal(x, y))
+not_equal = _binn("not_equal", lambda x, y: jnp.not_equal(x, y))
+less_than = _binn("less_than", lambda x, y: jnp.less(x, y))
+less_equal = _binn("less_equal", lambda x, y: jnp.less_equal(x, y))
+greater_than = _binn("greater_than", lambda x, y: jnp.greater(x, y))
+greater_equal = _binn("greater_equal", lambda x, y: jnp.greater_equal(x, y))
+logical_and = _binn("logical_and", lambda x, y: jnp.logical_and(x, y))
+logical_or = _binn("logical_or", lambda x, y: jnp.logical_or(x, y))
+logical_xor = _binn("logical_xor", lambda x, y: jnp.logical_xor(x, y))
+
+
+# ---------------------------------------------------------------------------
+# reductions (reference: reduce_ops/reduce_{sum,mean,max,min,prod}_op)
+
+def _axis_attr(axis, keepdim):
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+    return dict(axis=axis, keepdims=keepdim)
+
+
+def sum(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda x, axis, keepdims: jnp.sum(
+        x if dt is None else x.astype(dt), axis=axis, keepdims=keepdims),
+        (x,), _axis_attr(axis, keepdim), name="reduce_sum")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda x, axis, keepdims: jnp.mean(x, axis=axis,
+                                                    keepdims=keepdims),
+                 (x,), _axis_attr(axis, keepdim), name="reduce_mean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply(lambda x, axis, keepdims: jnp.max(x, axis=axis,
+                                                   keepdims=keepdims),
+                 (x,), _axis_attr(axis, keepdim), name="reduce_max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply(lambda x, axis, keepdims: jnp.min(x, axis=axis,
+                                                   keepdims=keepdims),
+                 (x,), _axis_attr(axis, keepdim), name="reduce_min")
+
+
+def prod(x, axis=None, keepdim=False, name=None):
+    return apply(lambda x, axis, keepdims: jnp.prod(x, axis=axis,
+                                                    keepdims=keepdims),
+                 (x,), _axis_attr(axis, keepdim), name="reduce_prod")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda x, axis, keepdims: jax.scipy.special.logsumexp(
+        x, axis=axis, keepdims=keepdims), (x,), _axis_attr(axis, keepdim),
+        name="logsumexp")
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply(lambda x, axis, keepdims: jnp.all(x, axis=axis,
+                                                   keepdims=keepdims),
+                 (x,), _axis_attr(axis, keepdim), nondiff=True, name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply(lambda x, axis, keepdims: jnp.any(x, axis=axis,
+                                                   keepdims=keepdims),
+                 (x,), _axis_attr(axis, keepdim), nondiff=True, name="any")
+
+
+def cumsum(x, axis=None, name=None):
+    def impl(x, axis):
+        if axis is None:
+            return jnp.cumsum(x.reshape(-1))
+        return jnp.cumsum(x, axis=axis)
+    return apply(impl, (x,), dict(axis=axis), name="cumsum")
+
+
+def cumprod(x, dim=None, name=None):
+    return apply(lambda x, axis: jnp.cumprod(x, axis=axis), (x,),
+                 dict(axis=dim), name="cumprod")
+
+
+# ---------------------------------------------------------------------------
+# argmax / argmin / argsort / topk / sort (reference: arg_max_op.cc, top_k_op)
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def impl(x, axis, keepdims):
+        out = jnp.argmax(x, axis=axis).astype(dt)
+        if keepdims and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out
+    return apply(impl, (x,), dict(axis=axis, keepdims=keepdim), nondiff=True,
+                 name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = convert_dtype(dtype)
+    def impl(x, axis, keepdims):
+        out = jnp.argmin(x, axis=axis).astype(dt)
+        if keepdims and axis is not None:
+            out = jnp.expand_dims(out, axis)
+        return out
+    return apply(impl, (x,), dict(axis=axis, keepdims=keepdim), nondiff=True,
+                 name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def impl(x, axis, descending):
+        idx = jnp.argsort(-x if descending else x, axis=axis)
+        return idx.astype(convert_dtype("int64"))
+    return apply(impl, (x,), dict(axis=axis, descending=descending),
+                 nondiff=True, name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def impl(x, axis, descending):
+        out = jnp.sort(x, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+    return apply(impl, (x,), dict(axis=axis, descending=descending),
+                 name="sort")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    """reference: top_k_op.cc — returns (values, indices)."""
+    def impl(x, k, axis, largest):
+        xm = jnp.moveaxis(x, axis, -1)
+        if largest:
+            v, i = lax.top_k(xm, k)
+        else:
+            v, i = lax.top_k(-xm, k)
+            v = -v
+        return jnp.moveaxis(v, -1, axis), jnp.moveaxis(i, -1, axis).astype(convert_dtype("int64"))
+    out = apply(impl, (x,), dict(k=k, axis=axis, largest=largest), n_out=2,
+                name="top_k")
+    out[1].stop_gradient = True
+    return out
+
+
+# ---------------------------------------------------------------------------
+# linear algebra (MXU path — keep matmuls batched, let XLA tile)
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0, name=None):
+    """reference: matmul_op.cc. Batched matmul with optional transposes;
+    lowers to a single dot_general on the MXU. AMP white-listed."""
+    from .. import amp
+    if amp.is_enabled():
+        dt = amp.compute_dtype()
+        x, y = cast(x, dt), cast(y, dt)
+    def impl(x, y, transpose_x, transpose_y, alpha):
+        if transpose_x:
+            x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+        if transpose_y:
+            y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+        out = jnp.matmul(x, y)
+        if alpha != 1.0:
+            out = out * alpha
+        return out
+    return apply(impl, (x, y), dict(transpose_x=transpose_x,
+                                    transpose_y=transpose_y, alpha=alpha),
+                 name="matmul")
+
+
+mm = matmul
+
+
+def dot(x, y, name=None):
+    def impl(x, y):
+        return jnp.sum(x * y, axis=-1)
+    return apply(impl, (x, y), name="dot")
+
+
+def bmm(x, y, name=None):
+    return apply(lambda x, y: jnp.matmul(x, y), (x, y), name="bmm")
+
+
+def t(x, name=None):
+    return apply(lambda x: x.T, (x,), name="t")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, x, y, beta, alpha: beta * i + alpha * (x @ y),
+                 (input, x, y), dict(beta=beta, alpha=alpha), name="addmm")
+
+
+def norm(x, p=2, axis=None, keepdim=False, name=None):
+    def impl(x, p, axis, keepdims):
+        if p == "fro" or p == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis,
+                                    keepdims=keepdims))
+        if p == 1:
+            return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdims)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                                 keepdims=keepdims), 1.0 / p)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply(impl, (x,), dict(p=p, axis=ax, keepdims=keepdim),
+                 name="norm")
+
+
+# ---------------------------------------------------------------------------
+# misc
+
+def where(condition, x, y, name=None):
+    """reference: where_op / select. condition is nondiff."""
+    def impl(c, x, y):
+        return jnp.where(c, x, y)
+    return apply(impl, (condition, x, y), name="where")
+
+
+def maximum_(x, y):
+    return maximum(x, y)
+
+
+def increment(x, value=1.0, name=None):
+    """reference: increment_op.cc — in static mode this mutates the var; in
+    dygraph we return x + value and also update in place."""
+    out = apply(lambda x, value: x + value, (x,), dict(value=value),
+                name="increment")
+    return out
+
+
+def accuracy_top1(pred, label):
+    """Helper used by metrics: fraction of argmax==label."""
+    def impl(pred, label):
+        p = jnp.argmax(pred, axis=-1)
+        return jnp.mean((p == label.reshape(p.shape)).astype(jnp.float32))
+    return apply(impl, (pred, label), nondiff=True, name="accuracy")

@@ -1,0 +1,673 @@
+"""paddle_tpu.ops.nn_ops — neural-net functional ops.
+
+TPU-native rebuild of the reference's NN operators
+(reference: paddle/fluid/operators/{conv_op, pool_op, batch_norm_op,
+layer_norm_op, group_norm_op, instance_norm_op, softmax_op, dropout_op,
+lookup_table_op, interpolate_op, prelu_op}.cc/.cu; python surface in
+python/paddle/fluid/layers/nn.py).
+
+TPU-first choices:
+* convs lower to one `lax.conv_general_dilated` (MXU); NCHW accepted for
+  API parity but internally dims are passed via dimension_numbers so XLA
+  picks the TPU-friendly layout — no manual im2col as in the CUDA kernels.
+* normalizations are fused arithmetic XLA folds into neighbouring matmuls;
+  a Pallas fused layer_norm lives in paddle_tpu/ops/pallas for the hot path.
+* dropout threads the global PRNG key (see paddle_tpu.random) — no curand.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor, as_tensor, convert_dtype
+from ..dispatch import apply
+from .. import random as prandom
+
+
+# ---------------------------------------------------------------------------
+# activations (reference: activation_op.cc, gelu_op, prelu_op)
+
+def relu(x, name=None):
+    return apply(lambda x: jnp.maximum(x, 0), (x,), name="relu")
+
+
+def relu6(x, name=None):
+    return apply(lambda x: jnp.clip(x, 0, 6), (x,), name="relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda x, a: jnp.where(x >= 0, x, a * x), (x,),
+                 dict(a=negative_slope), name="leaky_relu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def impl(x, w):
+        if w.size == 1:
+            wb = w.reshape(())
+        elif data_format == "NCHW" and x.ndim > 2:
+            wb = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+        else:
+            wb = w
+        return jnp.where(x >= 0, x, wb * x)
+    return apply(impl, (x, weight), name="prelu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda x, a: jnp.where(x > 0, x, a * jnp.expm1(x)), (x,),
+                 dict(a=alpha), name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda x, s, a: s * jnp.where(x > 0, x, a * jnp.expm1(x)),
+                 (x,), dict(s=scale, a=alpha), name="selu")
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda x, approximate: jax.nn.gelu(x, approximate=approximate),
+                 (x,), dict(approximate=approximate), name="gelu")
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, (x,), name="sigmoid")
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, (x,), name="log_sigmoid")
+
+
+def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
+    return apply(lambda x, s, o: jnp.clip(s * x + o, 0.0, 1.0), (x,),
+                 dict(s=slope, o=offset), name="hard_sigmoid")
+
+
+def hard_swish(x, name=None):
+    return apply(lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0, (x,),
+                 name="hard_swish")
+
+
+def swish(x, name=None):
+    return apply(lambda x: x * jax.nn.sigmoid(x), (x,), name="swish")
+
+
+silu = swish
+
+
+def mish(x, name=None):
+    return apply(lambda x: x * jnp.tanh(jax.nn.softplus(x)), (x,),
+                 name="mish")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda x, b, t: jnp.where(
+        b * x > t, x, jax.nn.softplus(b * x) / b), (x,),
+        dict(b=beta, t=threshold), name="softplus")
+
+
+def softsign(x, name=None):
+    return apply(lambda x: x / (1 + jnp.abs(x)), (x,), name="softsign")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda x, t: jnp.where(x > t, x - t,
+                                        jnp.where(x < -t, x + t, 0.0)),
+                 (x,), dict(t=threshold), name="softshrink")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply(lambda x, lo, hi: jnp.clip(x, lo, hi), (x,),
+                 dict(lo=min, hi=max), name="hardtanh")
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda x: x - jnp.tanh(x), (x,), name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda x, t: jnp.where(x > t, x, 0.0), (x,),
+                 dict(t=threshold), name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def impl(x, groups, axis):
+        c = x.shape[axis]
+        new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+        return jnp.max(x.reshape(new_shape), axis=axis + 1)
+    return apply(impl, (x,), dict(groups=groups, axis=axis), name="maxout")
+
+
+def softmax(x, axis=-1, name=None):
+    """reference: softmax_op.cc — one fused XLA softmax."""
+    return apply(lambda x, axis: jax.nn.softmax(x, axis=axis), (x,),
+                 dict(axis=axis), name="softmax")
+
+
+def log_softmax(x, axis=-1, name=None):
+    return apply(lambda x, axis: jax.nn.log_softmax(x, axis=axis), (x,),
+                 dict(axis=axis), name="log_softmax")
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+
+def linear(x, weight, bias=None, name=None):
+    """fc core (reference: mul_op + elementwise_add bias in fc layer):
+    x @ W + b in one dot for the MXU. AMP white-listed."""
+    from .. import amp
+    from .math import cast as _cast
+    if amp.is_enabled():
+        dt = amp.compute_dtype()
+        x, weight = _cast(x, dt), _cast(weight, dt)
+        bias = None if bias is None else _cast(bias, dt)
+    if bias is None:
+        return apply(lambda x, w: jnp.matmul(x, w), (x, weight),
+                     name="linear")
+    return apply(lambda x, w, b: jnp.matmul(x, w) + b, (x, weight, bias),
+                 name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """reference: lookup_table_op.cc. TPU: a gather; rows at padding_idx
+    produce zeros and receive no gradient (mask trick keeps it one fused
+    gather + where instead of the CUDA scatter-special-case)."""
+    def impl(ids, w, padding_idx):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(impl, (x, weight), dict(padding_idx=padding_idx),
+                 name="embedding")
+
+
+# ---------------------------------------------------------------------------
+# convolution (reference: conv_op.cc/conv_cudnn_op.cu)
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_dimension_numbers(ndim, data_format):
+    if ndim == 4:
+        return ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else (
+            "NHWC", "HWIO", "NHWC")
+    return ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else (
+        "NDHWC", "DHWIO", "NDHWC")
+
+
+def _norm_padding(padding, nsp):
+    """paddle padding: int, pair list, 'SAME'/'VALID'."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nsp
+    padding = list(padding)
+    if len(padding) == nsp and not isinstance(padding[0], (list, tuple)):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(nsp)]
+    return [tuple(p) for p in padding]
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    """One lax.conv_general_dilated → single MXU conv (no im2col).
+    AMP white-listed."""
+    from .. import amp
+    from .math import cast as _cast
+    if amp.is_enabled():
+        dt = amp.compute_dtype()
+        x, weight = _cast(x, dt), _cast(weight, dt)
+        bias = None if bias is None else _cast(bias, dt)
+    nsp = 2
+    dn = _conv_dimension_numbers(4, data_format)
+    attrs = dict(stride=_pair(stride, nsp), padding=_norm_padding(padding, nsp),
+                 dilation=_pair(dilation, nsp), groups=groups, dn=dn)
+
+    def impl(x, w, *maybe_bias, stride, padding, dilation, groups, dn):
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=padding,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=dn)
+        if maybe_bias:
+            b = maybe_bias[0]
+            if dn[2] == "NCHW":
+                out = out + b.reshape(1, -1, 1, 1)
+            else:
+                out = out + b.reshape(1, 1, 1, -1)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(impl, args, attrs, name="conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    nsp = 3
+    dn = _conv_dimension_numbers(5, data_format)
+    attrs = dict(stride=_pair(stride, nsp), padding=_norm_padding(padding, nsp),
+                 dilation=_pair(dilation, nsp), groups=groups, dn=dn)
+
+    def impl(x, w, *maybe_bias, stride, padding, dilation, groups, dn):
+        out = lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=padding,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=dn)
+        if maybe_bias:
+            b = maybe_bias[0]
+            shape = ((1, -1) + (1,) * 3) if dn[2] == "NCDHW" else (
+                (1,) * 4 + (-1,))
+            out = out + b.reshape(shape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(impl, args, attrs, name="conv3d")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCHW", name=None):
+    """reference: conv_transpose_op.cc. Expressed as an lhs-dilated conv of
+    the gradient — XLA lowers this straight onto the MXU.
+
+    The weight is ALWAYS the reference's IOHW layout
+    (in, out/groups, kh, kw), regardless of data_format (which only
+    describes the activations)."""
+    nsp = 2
+    lhs_spec = data_format  # "NCHW" or "NHWC"
+    dn = (lhs_spec, "OIHW", lhs_spec)
+    stride_t = _pair(stride, nsp)
+    pad = _norm_padding(padding, nsp)
+    dil = _pair(dilation, nsp)
+    outpad = _pair(output_padding, nsp)
+
+    def impl(x, w, *maybe_bias):
+        kdims = w.shape[2:]
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # transpose padding math: effective pad = d*(k-1) - p
+            padding_cfg = [
+                (dil[i] * (kdims[i] - 1) - pad[i][0],
+                 dil[i] * (kdims[i] - 1) - pad[i][1] + outpad[i])
+                for i in range(nsp)]
+        if groups > 1:
+            # per-group: (in/g, out/g, kh, kw) -> (out/g, in/g, kh, kw)
+            ci = w.shape[0]
+            w_g = w.reshape(groups, ci // groups, *w.shape[1:])
+            w_t = jnp.concatenate(
+                [jnp.flip(w_g[g], axis=(2, 3)).swapaxes(0, 1)
+                 for g in range(groups)], axis=0)
+        else:
+            # (in, out, kh, kw) -> flip spatial, swap io -> (out, in, kh, kw)
+            w_t = jnp.flip(w, axis=(2, 3)).swapaxes(0, 1)
+        out = lax.conv_general_dilated(
+            x, w_t, window_strides=(1, 1), padding=padding_cfg,
+            lhs_dilation=stride_t, rhs_dilation=dil,
+            feature_group_count=groups, dimension_numbers=dn)
+        if maybe_bias:
+            b = maybe_bias[0]
+            if data_format == "NCHW":
+                out = out + b.reshape(1, -1, 1, 1)
+            else:
+                out = out + b.reshape(1, 1, 1, -1)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(impl, args, name="conv2d_transpose")
+
+
+# ---------------------------------------------------------------------------
+# pooling (reference: pool_op.cc) — lax.reduce_window
+
+def _pool(x, kind, kernel, stride, padding, data_format, ceil_mode=False,
+          exclusive=True, global_pool=False):
+    nsp = x.data.ndim - 2 if isinstance(x, Tensor) else 2
+
+    def impl(x, kernel, stride, padding, data_format, global_pool):
+        nd = x.ndim
+        nsp = nd - 2
+        if global_pool:
+            kernel = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+            stride = kernel
+            padding = [(0, 0)] * nsp
+        kernel = _pair(kernel, nsp)
+        stride = _pair(stride if stride is not None else kernel, nsp)
+        pad = _norm_padding(padding, nsp)
+        if data_format in ("NCHW", "NCDHW"):
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = ([(0, 0), (0, 0)] + pad) if not isinstance(pad, str) else pad
+        else:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = ([(0, 0)] + pad + [(0, 0)]) if not isinstance(pad, str) else pad
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else (
+                jnp.iinfo(x.dtype).min)
+            return lax.reduce_window(x, init, lax.max, window, strides, pads)
+        # avg
+        ones = jnp.ones_like(x)
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+        if exclusive and not isinstance(pads, str):
+            cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+            return s / cnt
+        return s / float(np.prod(kernel))
+
+    return apply(impl, (x,), dict(kernel=kernel, stride=stride,
+                                  padding=padding, data_format=data_format,
+                                  global_pool=global_pool),
+                 name=f"{kind}_pool")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCHW", name=None):
+    return _pool(x, "max", kernel_size, stride, padding, data_format,
+                 ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               data_format="NCHW", name=None):
+    return _pool(x, "avg", kernel_size, stride, padding, data_format,
+                 exclusive=exclusive)
+
+
+def _adaptive_pool2d(x, output_size, data_format, reduce_name):
+    """Adaptive pooling with paddle's start/end-index formula — handles
+    non-divisible spatial sizes (the divisible case stays a single reshape)."""
+    def impl(x, output_size, data_format):
+        os_ = _pair(output_size, 2)
+        chan_last = data_format == "NHWC"
+        if chan_last:
+            x = jnp.moveaxis(x, -1, 1)
+        n, c, h, w = x.shape
+        red = jnp.mean if reduce_name == "avg" else jnp.max
+        if h % os_[0] == 0 and w % os_[1] == 0:
+            x6 = x.reshape(n, c, os_[0], h // os_[0], os_[1], w // os_[1])
+            out = red(x6, axis=(3, 5))
+        else:
+            rows = []
+            for i in range(os_[0]):
+                h0, h1 = (i * h) // os_[0], -(-((i + 1) * h) // os_[0])
+                cols = []
+                for j in range(os_[1]):
+                    w0, w1 = (j * w) // os_[1], -(-((j + 1) * w) // os_[1])
+                    cols.append(red(x[:, :, h0:h1, w0:w1], axis=(2, 3)))
+                rows.append(jnp.stack(cols, axis=-1))
+            out = jnp.stack(rows, axis=-2)
+        if chan_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(impl, (x,), dict(output_size=output_size,
+                                  data_format=data_format),
+                 name=f"adaptive_{reduce_name}_pool2d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool2d(x, output_size, data_format, "avg")
+
+
+def adaptive_max_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool2d(x, output_size, data_format, "max")
+
+
+def pool2d(x, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
+           global_pooling=False, data_format="NCHW", name=None):
+    """fluid.layers.pool2d parity wrapper."""
+    return _pool(x, "max" if pool_type == "max" else "avg", pool_size,
+                 pool_stride, pool_padding, data_format,
+                 global_pool=global_pooling)
+
+
+# ---------------------------------------------------------------------------
+# normalization (reference: batch_norm_op.cc, layer_norm_op.cu fused kernel,
+# group_norm_op, instance_norm_op)
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    """Returns (out, new_running_mean, new_running_var). The Layer writes the
+    running stats back (stateless-functional twist on the reference's
+    in-place MomentumTensor update)."""
+    def impl(x, rm, rv, *wb, training, momentum, epsilon, data_format):
+        if data_format in ("NCHW", "NCL", "NCDHW") and x.ndim > 2:
+            axes = (0,) + tuple(range(2, x.ndim))
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+        else:
+            axes = tuple(range(x.ndim - 1))
+            shape = (1,) * (x.ndim - 1) + (-1,)
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            new_rm = momentum * rm + (1 - momentum) * mean
+            new_rv = momentum * rv + (1 - momentum) * var
+        else:
+            mean, var = rm, rv
+            new_rm, new_rv = rm, rv
+        inv = lax.rsqrt(var + epsilon)
+        out = (x - mean.reshape(shape)) * inv.reshape(shape)
+        if wb:
+            w, b = wb
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out, new_rm, new_rv
+
+    args = (x, running_mean, running_var)
+    if weight is not None:
+        args = args + (weight, bias)
+    out = apply(impl, args, dict(training=training, momentum=momentum,
+                                 epsilon=epsilon, data_format=data_format),
+                n_out=3, name="batch_norm")
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    """reference: layer_norm_op fused CUDA kernel → here plain XLA (fused by
+    the compiler); Pallas variant in ops/pallas/layer_norm.py for the
+    flagship path."""
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(
+        normalized_shape)
+    naxes = len(ns)
+
+    def impl(x, *wb, naxes, epsilon):
+        axes = tuple(range(x.ndim - naxes, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) * lax.rsqrt(var + epsilon)
+        if wb:
+            w, b = wb
+            out = out * w + b
+        return out
+
+    args = (x,) if weight is None else (x, weight, bias)
+    return apply(impl, args, dict(naxes=naxes, epsilon=epsilon),
+                 name="layer_norm")
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    def impl(x, *wb, num_groups, epsilon, data_format):
+        if data_format == "NHWC":
+            x = jnp.moveaxis(x, -1, 1)
+        n, c = x.shape[:2]
+        sp = x.shape[2:]
+        xg = x.reshape(n, num_groups, c // num_groups, *sp)
+        axes = tuple(range(2, xg.ndim))
+        mean = jnp.mean(xg, axis=axes, keepdims=True)
+        var = jnp.var(xg, axis=axes, keepdims=True)
+        out = ((xg - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+        if wb:
+            w, b = wb
+            shape = (1, c) + (1,) * len(sp)
+            out = out * w.reshape(shape) + b.reshape(shape)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = (x,) if weight is None else (x, weight, bias)
+    return apply(impl, args, dict(num_groups=num_groups, epsilon=epsilon,
+                                  data_format=data_format), name="group_norm")
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5, name=None):
+    def impl(x, *wb, epsilon):
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - mean) * lax.rsqrt(var + epsilon)
+        if wb:
+            w, b = wb
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            out = out * w.reshape(shape) + b.reshape(shape)
+        return out
+    args = (x,) if weight is None else (x, weight, bias)
+    return apply(impl, args, dict(epsilon=epsilon), name="instance_norm")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def impl(x, p, axis, epsilon):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                                keepdims=True), 1.0 / p)
+        return x / jnp.maximum(nrm, epsilon)
+    return apply(impl, (x,), dict(p=p, axis=axis, epsilon=epsilon),
+                 name="normalize")
+
+
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0, name=None):
+    """reference: lrn_op.cc (NCHW)."""
+    def impl(x, size, alpha, beta, k):
+        sq = jnp.square(x)
+        half = size // 2
+        pads = [(0, 0), (half, size - 1 - half), (0, 0), (0, 0)]
+        sq = jnp.pad(sq, pads)
+        acc = sum(sq[:, i:i + x.shape[1]] for i in range(size))
+        return x / jnp.power(k + alpha * acc, beta)
+    return apply(impl, (x,), dict(size=size, alpha=alpha, beta=beta, k=k),
+                 name="lrn")
+
+
+# ---------------------------------------------------------------------------
+# dropout (reference: dropout_op.cu) — global threaded PRNG
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None,
+            name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda x, p: x * (1 - p), (x,), dict(p=p),
+                         name="dropout_infer")
+        return x
+    key = prandom.next_key()
+
+    def impl(x, key, p, mode, axis):
+        shape = x.shape
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, x / (1.0 - p), 0.0)
+        return jnp.where(keep, x, 0.0)
+
+    return apply(impl, (x,), dict(key=key, p=p, mode=mode, axis=axis),
+                 name="dropout")
+
+
+# ---------------------------------------------------------------------------
+# attention / misc
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, scale=None,
+                                 name=None):
+    """Plain XLA attention (B, H, S, D). Flash/pallas variant in
+    ops/pallas/flash_attention.py; ring variant in parallel/ring_attention."""
+    attrs = dict(is_causal=is_causal, scale=scale)
+
+    def impl(q, k, v, *mask, is_causal, scale):
+        d = q.shape[-1]
+        s = scale if scale is not None else 1.0 / np.sqrt(d)
+        logits = jnp.einsum("...qd,...kd->...qk", q, k) * s
+        if mask:
+            m = mask[0]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, -1e9)
+            else:
+                logits = logits + m
+        if is_causal:
+            sq, sk = logits.shape[-2:]
+            causal = jnp.tril(jnp.ones((sq, sk), jnp.bool_))
+            logits = jnp.where(causal, logits, -1e9)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("...qk,...kd->...qd", probs, v)
+
+    args = (q, k, v) if attn_mask is None else (q, k, v, attn_mask)
+    out = apply(impl, args, attrs, name="sdpa")
+    if dropout_p > 0.0 and training:
+        out = dropout(out, p=dropout_p, training=training)
+    return out
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, data_format="NCHW", name=None):
+    """reference: interpolate_op.cc (nearest/bilinear)."""
+    def impl(x, size, scale_factor, mode, align_corners, data_format):
+        chan_last = data_format == "NHWC"
+        if not chan_last:
+            x = jnp.moveaxis(x, 1, -1)
+        n, h, w, c = x.shape
+        if size is None:
+            sf = _pair(scale_factor, 2)
+            size = (int(h * sf[0]), int(w * sf[1]))
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "bicubic": "cubic"}[mode]
+        out = jax.image.resize(x, (n, size[0], size[1], c), method=method)
+        if not chan_last:
+            out = jnp.moveaxis(out, -1, 1)
+        return out
+    sz = tuple(size) if isinstance(size, (list, tuple)) else size
+    return apply(impl, (x,), dict(size=sz, scale_factor=scale_factor,
+                                  mode=mode, align_corners=align_corners,
+                                  data_format=data_format),
+                 name="interpolate")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    def impl(x, r, data_format):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            x = x.reshape(n, c // (r * r), r, r, h, w)
+            x = x.transpose(0, 1, 4, 2, 5, 3)
+            return x.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, r, r, c // (r * r))
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(n, h * r, w * r, c // (r * r))
+    return apply(impl, (x,), dict(r=upscale_factor, data_format=data_format),
+                 name="pixel_shuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """reference: unfold_op.cc (im2col)."""
+    def impl(x, kernel_sizes, strides, paddings, dilations):
+        k = _pair(kernel_sizes, 2)
+        s = _pair(strides, 2)
+        p = _norm_padding(paddings, 2)
+        d = _pair(dilations, 2)
+        patches = lax.conv_general_dilated_patches(
+            x, filter_shape=k, window_strides=s, padding=p, rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        n, ckk, oh, ow = patches.shape
+        return patches.reshape(n, ckk, oh * ow)
+    return apply(impl, (x,), dict(kernel_sizes=kernel_sizes, strides=strides,
+                                  paddings=paddings, dilations=dilations),
+                 name="unfold")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """reference: label_smooth_op.cc"""
+    def impl(label, epsilon):
+        k = label.shape[-1]
+        return (1 - epsilon) * label + epsilon / k
+    return apply(impl, (label,), dict(epsilon=epsilon), name="label_smooth")

@@ -1,0 +1,630 @@
+"""paddle_tpu.optimizer — the optimizer suite.
+
+TPU-native rebuild of the reference's optimizers
+(reference: python/paddle/fluid/optimizer.py — SGD, Momentum, LarsMomentum,
+Adagrad, DecayedAdagrad, Adadelta, Adam, Adamax, Lamb, RMSProp, Ftrl,
+Dpsgd, ModelAverage, ExponentialMovingAverage, LookaheadOptimizer,
+RecomputeOptimizer, PipelineOptimizer; and the C++ adam_op/momentum_op
+kernels).
+
+Design: each optimizer implements one pure `_rule(param, grad, slots, lr)`
+over jnp arrays. In dygraph the rule runs eagerly per parameter; under
+``jit.to_static`` the whole loop is traced into the train step, so XLA fuses
+all parameter updates with the backward pass (the reference needs a fused
+multi-tensor adam CUDA kernel for this; XLA fusion + optional Pallas fused
+adam in ops/pallas give it for free). Slot state lives in Tensors, so it is
+carried state for to_static and checkpointable.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, Parameter
+from ..regularizer import WeightDecayRegularizer, L2Decay
+from ..clip import ClipGradBase
+from . import lr as lr_sched
+from .lr import LRScheduler
+
+
+class Optimizer:
+    """Base optimizer (reference: optimizer.py:Optimizer)."""
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 regularization=None):
+        if parameters is not None and not isinstance(parameters,
+                                                     (list, tuple)):
+            parameters = list(parameters)
+        self._parameter_list = list(parameters) if parameters else None
+        self._grad_clip = grad_clip
+        # weight_decay may be a float (L2) or a regularizer object
+        wd = weight_decay if weight_decay is not None else regularization
+        if isinstance(wd, (int, float)):
+            wd = L2Decay(float(wd))
+        self._regularization = wd
+        self._lr_scheduler = None
+        if isinstance(learning_rate, LRScheduler):
+            self._lr_scheduler = learning_rate
+            learning_rate._owner = self
+            lr_value = learning_rate.last_lr
+        else:
+            lr_value = float(learning_rate)
+        # lr lives on device so compiled steps treat it as input state
+        self._lr_tensor = Tensor(jnp.asarray(lr_value, jnp.float32),
+                                 name="learning_rate")
+        self._accumulators = {}  # id(param) -> {slot_name: Tensor}
+        self._aux_state = {}     # scalar aux state (step counters etc.)
+
+    # -- lr management ------------------------------------------------------
+    def _set_lr_value(self, value):
+        self._lr_tensor.data = jnp.asarray(value, jnp.float32)
+
+    def set_lr(self, value):
+        self._set_lr_value(value)
+
+    def get_lr(self):
+        if self._lr_scheduler is not None:
+            return self._lr_scheduler.last_lr
+        return float(jax.device_get(self._lr_tensor.data))
+
+    @property
+    def _learning_rate(self):
+        return self._lr_tensor.data
+
+    # -- slots --------------------------------------------------------------
+    def _slot(self, param, name, init=None, shape=None, dtype=None):
+        pid = id(param)
+        slots = self._accumulators.setdefault(pid, {})
+        if name not in slots:
+            shape = shape if shape is not None else param.data.shape
+            dtype = dtype or param.data.dtype
+            value = jnp.zeros(shape, dtype) if init is None else jnp.full(
+                shape, init, dtype)
+            slots[name] = Tensor(value, name=f"{param.name}_{name}")
+        return slots[name]
+
+    # -- the per-parameter update rule (override) ---------------------------
+    def _rule(self, p, g, slots, lr):
+        raise NotImplementedError
+
+    def _params(self):
+        if self._parameter_list is None:
+            raise ValueError(
+                "optimizer constructed without `parameters`; pass "
+                "parameters=model.parameters() (reference dygraph requires "
+                "parameter_list too)")
+        return self._parameter_list
+
+    # -- apply --------------------------------------------------------------
+    def step(self):
+        """Apply one update from accumulated .grad (reference: dygraph
+        minimize path in optimizer.py:Optimizer.apply_gradients)."""
+        params_grads = []
+        for p in self._params():
+            if p.stop_gradient or p._grad is None:
+                continue
+            g = p._grad
+            reg = p.regularizer or self._regularization
+            if isinstance(reg, WeightDecayRegularizer):
+                g = g + reg.grad_term(p.data)
+            params_grads.append((p, g))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr = self._lr_tensor.data
+        for p, g in params_grads:
+            if g is None:
+                continue
+            self._pre_param(p)
+            slots = self._accumulators.get(id(p), {})
+            new_p, new_slots = self._rule(
+                p.data, g, {n: t.data for n, t in slots.items()}, lr)
+            p.data = new_p
+            for n, v in new_slots.items():
+                self._slot(p, n).data = v
+        self._post_step()
+
+    def _ensure_all_slots(self):
+        """Create every accumulator eagerly (used by jit.to_static so slot
+        Tensors exist before tracing rather than materializing as tracers)."""
+        for p in self._params():
+            if not p.stop_gradient:
+                self._pre_param(p)
+
+    def _pre_param(self, p):
+        # ensure slots exist before _rule reads them
+        pass
+
+    def _post_step(self):
+        pass
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """reference dygraph semantics: grads already accumulated by
+        loss.backward(); minimize applies them. In static mode the Program
+        records this optimizer instead (see paddle_tpu.static)."""
+        from ..dispatch import in_static_mode
+        if in_static_mode():
+            from ..static import record_optimizer
+            return record_optimizer(self, loss)
+        if loss is not None and loss._tape_node is not None and all(
+                p._grad is None for p in self._params()
+                if not p.stop_gradient):
+            loss.backward()
+        self.step()
+        return None, None
+
+    def clear_grad(self):
+        for p in self._params():
+            p.clear_gradient()
+
+    clear_gradients = clear_grad
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self):
+        out = {"lr": self.get_lr()}
+        names = {}
+        for i, p in enumerate(self._params()):
+            pname = p.name or f"param_{i}"
+            for sname, t in self._accumulators.get(id(p), {}).items():
+                out[f"{pname}@{sname}"] = t
+            names[pname] = p
+        out["__aux__"] = dict(self._aux_state)
+        if self._lr_scheduler is not None:
+            out["__lr_sched__"] = self._lr_scheduler.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        for i, p in enumerate(self._params()):
+            pname = p.name or f"param_{i}"
+            for key, value in state.items():
+                if key.startswith(pname + "@"):
+                    sname = key.split("@", 1)[1]
+                    slot = self._slot(p, sname)
+                    slot.set_value(value.data if isinstance(value, Tensor)
+                                   else value)
+        if "__aux__" in state:
+            self._aux_state.update(state["__aux__"])
+        if "__lr_sched__" in state and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state["__lr_sched__"])
+
+
+# ---------------------------------------------------------------------------
+# concrete rules
+
+class SGD(Optimizer):
+    """reference: optimizer.py:SGDOptimizer / sgd_op.cc"""
+
+    def _rule(self, p, g, slots, lr):
+        return p - lr * g, {}
+
+
+class Momentum(Optimizer):
+    """reference: MomentumOptimizer / momentum_op.cc"""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _pre_param(self, p):
+        self._slot(p, "velocity")
+
+    def _rule(self, p, g, slots, lr):
+        v = self._momentum * slots["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class LarsMomentum(Optimizer):
+    """reference: LarsMomentumOptimizer / lars_momentum_op.cc — layer-wise
+    adaptive rate scaling (large-batch training)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _pre_param(self, p):
+        self._slot(p, "velocity")
+
+    def _rule(self, p, g, slots, lr):
+        pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+        gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (pn > 0) & (gn > 0),
+            lr * self._lars_coeff * pn / (gn + self._lars_wd * pn + 1e-12),
+            lr)
+        v = self._momentum * slots["velocity"] + local_lr * (
+            g + self._lars_wd * p)
+        return p - v, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    """reference: AdagradOptimizer / adagrad_op.cc"""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 initial_accumulator_value=0.0, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _pre_param(self, p):
+        self._slot(p, "moment", init=self._init_acc)
+
+    def _rule(self, p, g, slots, lr):
+        m = slots["moment"] + g * g
+        return p - lr * g / (jnp.sqrt(m) + self._eps), {"moment": m}
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: DecayedAdagradOptimizer / decayed_adagrad_op.cc"""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._decay = decay
+        self._eps = epsilon
+
+    def _pre_param(self, p):
+        self._slot(p, "moment")
+
+    def _rule(self, p, g, slots, lr):
+        m = self._decay * slots["moment"] + (1 - self._decay) * g * g
+        return p - lr * g / (jnp.sqrt(m) + self._eps), {"moment": m}
+
+
+class Adadelta(Optimizer):
+    """reference: AdadeltaOptimizer / adadelta_op.cc"""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _pre_param(self, p):
+        self._slot(p, "avg_squared_grad")
+        self._slot(p, "avg_squared_update")
+
+    def _rule(self, p, g, slots, lr):
+        rho, eps = self._rho, self._eps
+        asg = rho * slots["avg_squared_grad"] + (1 - rho) * g * g
+        update = -jnp.sqrt((slots["avg_squared_update"] + eps) /
+                           (asg + eps)) * g
+        asu = rho * slots["avg_squared_update"] + (1 - rho) * update * update
+        return p + lr * update, {"avg_squared_grad": asg,
+                                 "avg_squared_update": asu}
+
+
+class Adam(Optimizer):
+    """reference: AdamOptimizer / adam_op.cc (incl. beta-pow accumulators)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, lazy_mode=False, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _pre_param(self, p):
+        self._slot(p, "moment1")
+        self._slot(p, "moment2")
+        self._slot(p, "beta1_pow", init=1.0, shape=())
+        self._slot(p, "beta2_pow", init=1.0, shape=())
+
+    def _rule(self, p, g, slots, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                       "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: AdamW in later paddle; also the
+    natural TPU formulation — decay fuses into the same update)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         **kw)
+        self._wd = float(weight_decay) if not isinstance(
+            weight_decay, WeightDecayRegularizer) else weight_decay.coeff
+        self._regularization = None  # decoupled — not added to grad
+
+    def _rule(self, p, g, slots, lr):
+        new_p, new_slots = super()._rule(p, g, slots, lr)
+        new_p = new_p - lr * self._wd * p
+        return new_p, new_slots
+
+
+class Adamax(Optimizer):
+    """reference: AdamaxOptimizer / adamax_op.cc"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _pre_param(self, p):
+        self._slot(p, "moment")
+        self._slot(p, "inf_norm")
+        self._slot(p, "beta1_pow", init=1.0, shape=())
+
+    def _rule(self, p, g, slots, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = slots["beta1_pow"] * b1
+        m = b1 * slots["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(g))
+        new_p = p - lr / (1 - b1p) * m / (u + eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class Lamb(Optimizer):
+    """reference: LambOptimizer / lamb_op.cc — layer-adaptive Adam for
+    large-batch BERT training."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 exclude_from_weight_decay_fn=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _pre_param(self, p):
+        self._slot(p, "moment1")
+        self._slot(p, "moment2")
+        self._slot(p, "beta1_pow", init=1.0, shape=())
+        self._slot(p, "beta2_pow", init=1.0, shape=())
+        self._current_param = p
+
+    def _rule(self, p, g, slots, lr):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        b1p = slots["beta1_pow"] * b1
+        b2p = slots["beta2_pow"] * b2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * g * g
+        mhat = m / (1 - b1p)
+        vhat = v / (1 - b2p)
+        wd = self._wd
+        if self._exclude_fn is not None and self._exclude_fn(
+                self._current_param):
+            wd = 0.0
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+        pn = jnp.sqrt(jnp.sum(jnp.square(p)))
+        rn = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((pn > 0) & (rn > 0), pn / rn, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v,
+                                    "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class RMSProp(Optimizer):
+    """reference: RMSPropOptimizer / rmsprop_op.cc"""
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _pre_param(self, p):
+        self._slot(p, "mean_square")
+        self._slot(p, "momentum")
+        if self._centered:
+            self._slot(p, "mean_grad")
+
+    def _rule(self, p, g, slots, lr):
+        rho, eps = self._rho, self._eps
+        ms = rho * slots["mean_square"] + (1 - rho) * g * g
+        new_slots = {"mean_square": ms}
+        if self._centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * g
+            denom = ms - mg * mg + eps
+            new_slots["mean_grad"] = mg
+        else:
+            denom = ms + eps
+        mom = self._momentum * slots["momentum"] + lr * g / jnp.sqrt(denom)
+        new_slots["momentum"] = mom
+        return p - mom, new_slots
+
+
+class Ftrl(Optimizer):
+    """reference: FtrlOptimizer / ftrl_op.cc"""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _pre_param(self, p):
+        self._slot(p, "squared")
+        self._slot(p, "linear")
+
+    def _rule(self, p, g, slots, lr):
+        l1, l2, lrp = self._l1, self._l2, self._lr_power
+        sq = slots["squared"]
+        new_sq = sq + g * g
+        sigma = (jnp.power(new_sq, -lrp) - jnp.power(
+            jnp.maximum(sq, 1e-30), -lrp)) / lr
+        lin = slots["linear"] + g - sigma * p
+        pre = jnp.power(new_sq, -lrp) / lr + 2 * l2
+        x = l1 * jnp.sign(lin) - lin
+        new_p = jnp.where(jnp.abs(lin) > l1, x / pre, 0.0)
+        return new_p, {"squared": new_sq, "linear": lin}
+
+
+class Dpsgd(Optimizer):
+    """reference: DpsgdOptimizer / dpsgd_op.cc — differentially-private SGD
+    (clip + gaussian noise)."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16,
+                 sigma=1.0, parameters=None, **kw):
+        super().__init__(learning_rate, parameters, **kw)
+        self._clip = clip
+        self._batch_size = batch_size
+        self._sigma = sigma
+
+    def _rule(self, p, g, slots, lr):
+        from .. import random as prandom
+        gn = jnp.sqrt(jnp.sum(jnp.square(g)))
+        g = g / jnp.maximum(1.0, gn / self._clip)
+        noise = jax.random.normal(prandom.next_key(), g.shape,
+                                  g.dtype) * self._sigma * self._clip
+        g = (g + noise) / self._batch_size
+        return p - lr * g, {}
+
+
+# ---------------------------------------------------------------------------
+# meta-optimizers / wrappers
+
+class ExponentialMovingAverage:
+    """reference: optimizer.py:ExponentialMovingAverage — shadow weights with
+    apply()/restore() context."""
+
+    def __init__(self, decay=0.999, thres_steps=None):
+        self._decay = decay
+        self._shadow = {}
+        self._backup = {}
+        self._step = 0
+        self._params = None
+
+    def update(self, parameters=None):
+        if parameters is not None:
+            self._params = list(parameters)
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            pid = id(p)
+            if pid not in self._shadow:
+                self._shadow[pid] = p.data
+            else:
+                self._shadow[pid] = d * self._shadow[pid] + (1 - d) * p.data
+
+    def apply(self, parameters=None):
+        params = list(parameters) if parameters is not None else self._params
+        for p in params:
+            self._backup[id(p)] = p.data
+            if id(p) in self._shadow:
+                p.data = self._shadow[id(p)]
+        return _EMAGuard(self, params)
+
+    def restore(self, parameters=None):
+        params = list(parameters) if parameters is not None else self._params
+        for p in params:
+            if id(p) in self._backup:
+                p.data = self._backup.pop(id(p))
+
+
+class _EMAGuard:
+    def __init__(self, ema, params):
+        self._ema, self._params = ema, params
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._ema.restore(self._params)
+
+
+class ModelAverage(ExponentialMovingAverage):
+    """reference: optimizer.py:ModelAverage — running average of weights over
+    a window; same apply/restore protocol."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000):
+        super().__init__(decay=0.0)
+        self._sum = {}
+        self._count = {}
+        self._max_window = max_average_window
+
+    def update(self, parameters=None):
+        if parameters is not None:
+            self._params = list(parameters)
+        for p in self._params:
+            pid = id(p)
+            if pid not in self._sum or self._count[pid] >= self._max_window:
+                self._sum[pid] = p.data
+                self._count[pid] = 1
+            else:
+                self._sum[pid] = self._sum[pid] + p.data
+                self._count[pid] += 1
+            self._shadow[pid] = self._sum[pid] / self._count[pid]
+
+
+class LookAhead:
+    """reference: LookaheadOptimizer — slow/fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        self.inner = inner_optimizer
+        self._alpha = alpha
+        self._k = k
+        self._step = 0
+        self._slow = {}
+
+    def step(self):
+        self.inner.step()
+        self._step += 1
+        if self._step % self._k == 0:
+            for p in self.inner._params():
+                pid = id(p)
+                slow = self._slow.get(pid, p.data)
+                if pid not in self._slow:
+                    self._slow[pid] = p.data
+                    continue
+                slow = slow + self._alpha * (p.data - slow)
+                self._slow[pid] = slow
+                p.data = slow
+
+    def minimize(self, loss, **kw):
+        if loss is not None and loss._tape_node is not None:
+            loss.backward()
+        self.step()
+
+    def clear_grad(self):
+        self.inner.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+class RecomputeOptimizer:
+    """reference: RecomputeOptimizer — gradient checkpointing. On TPU this
+    is `jax.checkpoint` applied to the forward segments; use
+    paddle_tpu.jit.recompute(fn) on the blocks to rematerialize, then train
+    with the inner optimizer as usual."""
+
+    def __init__(self, optimizer):
+        self.inner = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+
+# fluid-era aliases (reference exports *Optimizer names)
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+LarsMomentumOptimizer = LarsMomentum
+AdagradOptimizer = Adagrad
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+LambOptimizer = Lamb
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+DpsgdOptimizer = Dpsgd
+LookaheadOptimizer = LookAhead

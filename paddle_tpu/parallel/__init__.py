@@ -1,0 +1,11 @@
+"""paddle_tpu.parallel — distribution: mesh, collectives, fleet, parallel
+layers (reference: fluid/incubate/fleet, operators/collective, dygraph
+parallel; redesigned over jax.sharding / shard_map / ICI collectives)."""
+from . import collective
+from .collective import (make_mesh, get_mesh, set_mesh, shard, replicated,
+                         all_reduce, all_gather, reduce_scatter, broadcast,
+                         all_to_all, ppermute, barrier)
+from .env import ParallelEnv, prepare_context
+from . import fleet as fleet_mod
+from .fleet import fleet, DistributedStrategy, PaddleCloudRoleMaker, init
+from .data_parallel import DataParallel

@@ -1,0 +1,186 @@
+"""paddle_tpu.parallel.collective — collective communication.
+
+TPU-native rebuild of the reference's collective operators
+(reference: paddle/fluid/operators/collective/{c_allreduce_op, c_allgather_op,
+c_reducescatter_op, c_broadcast_op, barrier_op, c_gen_nccl_id_op}.* and
+python/paddle/fluid/layers/collective.py, transpiler/collective.py).
+
+NCCL rings become XLA collectives on the ICI mesh: inside a
+``shard_map``/``pjit`` region the ops lower to `lax.psum` / `all_gather` /
+`psum_scatter` / `ppermute`, which XLA schedules directly onto ICI links —
+there is no NCCL-style id bootstrap (gen_nccl_id) because device topology is
+part of the mesh. Outside an SPMD region (single chip eager) they are
+identity/no-ops, matching single-process semantics of the reference.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
+
+from ..tensor import Tensor, as_tensor
+from ..dispatch import apply
+
+# ---------------------------------------------------------------------------
+# global mesh registry (the TPU analogue of the reference's communicator /
+# ParallelContext state)
+
+_global_mesh = None
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    return _global_mesh
+
+
+def make_mesh(axes: dict, devices=None) -> Mesh:
+    """Create and register a Mesh, e.g. make_mesh({'dp': 2, 'tp': 4})."""
+    devices = devices if devices is not None else jax.devices()
+    names = tuple(axes)
+    sizes = tuple(axes[n] for n in names)
+    need = int(np.prod(sizes))
+    if need > len(devices):
+        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
+    arr = np.asarray(devices[:need]).reshape(sizes)
+    return set_mesh(Mesh(arr, names))
+
+
+def replicated(x, mesh=None):
+    """Place an array/Tensor replicated over the mesh."""
+    mesh = mesh or _global_mesh
+    if mesh is None:
+        return x
+    sh = NamedSharding(mesh, P())
+    if isinstance(x, Tensor):
+        x.data = jax.device_put(x.data, sh)
+        return x
+    return jax.device_put(x, sh)
+
+
+def shard(x, spec, mesh=None):
+    """Place an array/Tensor with a PartitionSpec over the mesh."""
+    mesh = mesh or _global_mesh
+    if mesh is None:
+        return x
+    sh = NamedSharding(mesh, spec if isinstance(spec, P) else P(*spec))
+    if isinstance(x, Tensor):
+        x.data = jax.device_put(x.data, sh)
+        return x
+    return jax.device_put(x, sh)
+
+
+# ---------------------------------------------------------------------------
+# SPMD-region detection: collectives need an axis name bound by
+# shard_map/pmap; in plain eager (or plain jit) they act as identity.
+
+def in_spmd_context(axis_name=None):
+    try:
+        if axis_name is not None:
+            lax.axis_size(axis_name)
+            return True
+        return False
+    except (NameError, KeyError, Exception):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# collectives (reference: c_allreduce_{sum,max,min,prod}, c_allgather,
+# c_reducescatter, c_broadcast, barrier)
+
+def _maybe(axis_name):
+    return axis_name is not None and in_spmd_context(axis_name)
+
+
+def all_reduce(x, op="sum", axis_name="dp", group=None):
+    """c_allreduce_* → lax.psum/pmax/pmin on the ICI mesh axis."""
+    if not _maybe(axis_name):
+        return as_tensor(x)
+    fns = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
+           "prod": lambda v, n: jnp.exp(lax.psum(jnp.log(v), n))}
+    fn = fns[op]
+    return apply(lambda x: fn(x, axis_name), (x,), name=f"c_allreduce_{op}")
+
+
+def all_gather(x, axis=0, axis_name="dp", group=None):
+    """c_allgather → lax.all_gather along the mesh axis."""
+    if not _maybe(axis_name):
+        return as_tensor(x)
+    return apply(lambda x: lax.all_gather(x, axis_name, axis=axis,
+                                          tiled=True),
+                 (x,), name="c_allgather")
+
+
+def reduce_scatter(x, axis=0, axis_name="dp", group=None):
+    """c_reducescatter → lax.psum_scatter."""
+    if not _maybe(axis_name):
+        return as_tensor(x)
+    return apply(lambda x: lax.psum_scatter(x, axis_name,
+                                            scatter_dimension=axis,
+                                            tiled=True),
+                 (x,), name="c_reducescatter")
+
+
+def broadcast(x, src=0, axis_name="dp", group=None):
+    """c_broadcast: every rank takes rank-src's value (select+psum)."""
+    if not _maybe(axis_name):
+        return as_tensor(x)
+
+    def impl(x):
+        idx = lax.axis_index(axis_name)
+        masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+        return lax.psum(masked, axis_name)
+
+    return apply(impl, (x,), name="c_broadcast")
+
+
+def all_to_all(x, split_axis=0, concat_axis=0, axis_name="dp", group=None):
+    """alltoall_op → lax.all_to_all (the sequence/expert-parallel workhorse)."""
+    if not _maybe(axis_name):
+        return as_tensor(x)
+    return apply(lambda x: lax.all_to_all(x, axis_name, split_axis,
+                                          concat_axis, tiled=True),
+                 (x,), name="alltoall")
+
+
+def ppermute(x, perm, axis_name="dp"):
+    """Point-to-point ring permute (building block for ring attention and
+    pipeline parallelism)."""
+    if not _maybe(axis_name):
+        return as_tensor(x)
+    return apply(lambda x: lax.ppermute(x, axis_name, perm), (x,),
+                 name="ppermute")
+
+
+def barrier(axis_name="dp", group=None):
+    """barrier_op — on XLA a barrier is an all-reduce of a scalar."""
+    if not _maybe(axis_name):
+        return
+    lax.psum(jnp.zeros((), jnp.float32), axis_name)
+
+
+def rank(axis_name="dp"):
+    if not _maybe(axis_name):
+        return 0
+    return lax.axis_index(axis_name)
+
+
+def world_size(axis_name="dp"):
+    if not _maybe(axis_name):
+        return 1
+    return lax.axis_size(axis_name)
+
+
+# reference-parity aliases (fluid.layers.collective underscored names)
+_c_allreduce = all_reduce
+_c_allgather = all_gather
+_c_reducescatter = reduce_scatter
+_c_broadcast = broadcast

@@ -1,0 +1,54 @@
+"""paddle_tpu.parallel.data_parallel — DataParallel.
+
+TPU-native rebuild of reference python/paddle/fluid/dygraph/parallel.py
+DataParallel (+ scale_loss / apply_collective_grads over NCCL).
+
+Redesign: on TPU, data parallelism is a *sharding*, not an explicit
+gradient exchange. Wrapping a model in DataParallel (after fleet.init)
+places its parameters replicated on the mesh; feeding batches sharded on
+the dp axis makes XLA's GSPMD partitioner emit the gradient all-reduce on
+ICI automatically inside the compiled train step. scale_loss /
+apply_collective_grads are therefore identity shims kept for API parity —
+the math they performed (grad-sum ÷ nranks) is what GSPMD produces.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..nn.layer import Layer
+from .fleet import fleet
+from . import collective
+
+
+class DataParallel(Layer):
+    """reference: dygraph/parallel.py:DataParallel."""
+
+    def __init__(self, layers, strategy=None, mesh=None):
+        super().__init__()
+        self._layers = layers
+        mesh = mesh or collective.get_mesh()
+        if mesh is None and not fleet._initialized:
+            fleet.init()
+            mesh = fleet.mesh
+        if mesh is not None:
+            fleet._mesh = fleet._mesh or mesh
+            fleet.shard_model(layers)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        """Parity shim: with mean-reduced losses + GSPMD allreduce the
+        scaling is already correct."""
+        return loss
+
+    def apply_collective_grads(self):
+        """Parity shim: GSPMD emits the grad allreduce inside the compiled
+        step; nothing to do here."""
+        return
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)

@@ -1,0 +1,58 @@
+"""paddle_tpu.parallel.env — ParallelEnv.
+
+TPU-native rebuild of reference python/paddle/fluid/dygraph/parallel.py
+ParallelEnv (+ prepare_context): rank/world topology comes from the JAX
+runtime (jax.process_index / device mesh) instead of env-var + NCCL-id
+bootstrap.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class ParallelEnv:
+    """reference: dygraph/parallel.py:ParallelEnv."""
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def local_rank(self):
+        return jax.process_index()
+
+    @property
+    def world_size(self):
+        return jax.process_count()
+
+    @property
+    def nranks(self):
+        return jax.device_count()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else ["127.0.0.1:0"]
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    """reference: dygraph.parallel.prepare_context — no NCCL bootstrap
+    needed; the mesh IS the communicator."""
+    return ParallelEnv()

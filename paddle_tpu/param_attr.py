@@ -1,0 +1,39 @@
+"""paddle_tpu.param_attr — ParamAttr.
+
+TPU-native rebuild of reference python/paddle/fluid/param_attr.py: a bag of
+parameter configuration (name, initializer, lr multiplier, regularizer,
+trainable) consumed by Layer.create_parameter.
+"""
+from __future__ import annotations
+
+
+class ParamAttr:
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=False,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        if arg is None or isinstance(arg, ParamAttr):
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        if arg is False:
+            return False
+        # bare initializer
+        return ParamAttr(initializer=arg)
+
+
+class WeightNormParamAttr(ParamAttr):
+    """reference: param_attr.py:WeightNormParamAttr (dim kept for parity)."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim

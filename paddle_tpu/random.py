@@ -1,0 +1,55 @@
+"""paddle_tpu.random — global PRNG state management.
+
+TPU-native rebuild of the reference's random seed handling
+(reference: python/paddle/fluid/framework.py default_startup_program random
+seed + paddle/fluid/operators/dropout_op.cu curand streams). CUDA-style
+stateful RNG does not exist on TPU/XLA; instead we keep ONE global threaded
+PRNG key (a ``jax.random`` key held in a Tensor) and every stochastic op
+splits a subkey off it. Because the key lives in a Tensor, ``jit.to_static``
+can capture it as carried state: dropout inside a compiled train step splits
+the *traced* key and writes the advanced key back, so randomness progresses
+correctly across compiled steps instead of being baked in as a constant.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+# the global key lives in a Tensor so mode transforms can swap its payload
+_global_key = Tensor(jax.random.PRNGKey(0), stop_gradient=True,
+                     name="global_rng_key")
+_seed_value = 0
+
+
+def seed(value: int):
+    """Set the global seed (paddle.seed / fluid.default_main_program
+    random_seed equivalent)."""
+    global _seed_value
+    _seed_value = int(value)
+    _global_key.data = jax.random.PRNGKey(int(value))
+    return _seed_value
+
+
+def get_seed():
+    return _seed_value
+
+
+def global_key_tensor() -> Tensor:
+    """The Tensor holding the global key — exposed so to_static can thread
+    it through compiled steps as mutable state."""
+    return _global_key
+
+
+def next_key():
+    """Split a fresh subkey off the global key, advancing it."""
+    key, sub = jax.random.split(_global_key.data)
+    _global_key.data = key
+    return sub
+
+
+def split_keys(n):
+    keys = jax.random.split(_global_key.data, n + 1)
+    _global_key.data = keys[0]
+    return keys[1:]

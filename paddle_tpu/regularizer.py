@@ -1,0 +1,41 @@
+"""paddle_tpu.regularizer — weight decay regularizers.
+
+TPU-native rebuild of reference python/paddle/fluid/regularizer.py
+(L1DecayRegularizer, L2DecayRegularizer): applied by adding the penalty
+gradient to the parameter gradient inside the (compiled) update step.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    def grad_term(self, param):
+        raise NotImplementedError
+
+
+class L2Decay(WeightDecayRegularizer):
+    """reference: L2DecayRegularizer — grad += coeff * param."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, param):
+        return self.coeff * param
+
+    def __float__(self):
+        return self.coeff
+
+
+class L1Decay(WeightDecayRegularizer):
+    """reference: L1DecayRegularizer — grad += coeff * sign(param)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def grad_term(self, param):
+        return self.coeff * jnp.sign(param)
+
+
+L2DecayRegularizer = L2Decay
+L1DecayRegularizer = L1Decay

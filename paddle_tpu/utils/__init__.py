@@ -1,0 +1,5 @@
+"""paddle_tpu.utils — profiler, debug guards, logging (reference:
+python/paddle/fluid/profiler.py, platform/profiler; debugger)."""
+from . import profiler
+from . import debug
+from .debug import check_nan_inf, enable_nan_guard

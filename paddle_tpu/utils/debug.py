@@ -1,0 +1,47 @@
+"""paddle_tpu.utils.debug — nan/inf guards, assertions, printing.
+
+TPU-native rebuild of the reference's debug aids
+(reference: check_nan_inf in framework/details/nan_inf_utils,
+layers/control_flow.py Print/Assert ops). On TPU, `jax.debug.print` /
+`jax.config.jax_debug_nans` provide the in-compiled-graph equivalents.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+def check_nan_inf(x, name="tensor", raise_error=True):
+    """Host-side check (eager). Inside jit prefer nan_guard/debug_print."""
+    data = x.data if isinstance(x, Tensor) else x
+    import numpy as np
+    arr = np.asarray(jax.device_get(data))
+    bad = not np.isfinite(arr).all()
+    if bad and raise_error:
+        raise FloatingPointError(
+            f"nan/inf detected in {name}: nan={np.isnan(arr).sum()}, "
+            f"inf={np.isinf(arr).sum()}")
+    return bad
+
+
+def enable_nan_guard(enable=True):
+    """Failure-detection mode: XLA checks every primitive output for NaN
+    (reference: FLAGS_check_nan_inf)."""
+    jax.config.update("jax_debug_nans", enable)
+
+
+def Print(x, message="", summarize=20):
+    """reference: layers/control_flow.py Print op — works inside jit."""
+    data = x.data if isinstance(x, Tensor) else x
+    jax.debug.print(message + " {x}", x=data)
+    return x
+
+
+def Assert(cond, data=None, summarize=20):
+    """reference: Assert op — eager check; inside jit use checkify."""
+    c = cond.data if isinstance(cond, Tensor) else cond
+    import numpy as np
+    if not bool(np.asarray(jax.device_get(c)).all()):
+        raise AssertionError(f"Assert failed; data={data}")

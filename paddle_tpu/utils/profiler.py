@@ -1,0 +1,69 @@
+"""paddle_tpu.utils.profiler — profiling.
+
+TPU-native rebuild of reference python/paddle/fluid/profiler.py (+
+platform/profiler.cc). The reference collects per-op CUDA timings; on TPU
+the equivalent signal is an XLA trace viewable in TensorBoard/Perfetto,
+captured via jax.profiler. A lightweight host-side timer table covers the
+start/stop/print surface of the reference API.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+_records = defaultdict(lambda: [0.0, 0])
+_trace_dir = None
+
+
+def start_profiler(state="All", tracer_option=None, trace_dir=None):
+    """reference: profiler.start_profiler. Starts a jax.profiler trace."""
+    global _trace_dir
+    _trace_dir = trace_dir or "/tmp/paddle_tpu_trace"
+    jax.profiler.start_trace(_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    jax.profiler.stop_trace()
+    print(f"[paddle_tpu.profiler] XLA trace written to {_trace_dir} "
+          "(open with TensorBoard / Perfetto)")
+    if _records:
+        print_stats()
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None):
+    """reference: fluid.profiler.profiler context manager."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def scope(name):
+    """Host-side named timer + device annotation (StepTraceAnnotation)."""
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    dt = time.perf_counter() - t0
+    _records[name][0] += dt
+    _records[name][1] += 1
+
+
+record_event = scope
+
+
+def print_stats():
+    print(f"{'name':<40}{'calls':>8}{'total_s':>12}{'avg_ms':>12}")
+    for name, (total, calls) in sorted(_records.items(),
+                                       key=lambda kv: -kv[1][0]):
+        print(f"{name:<40}{calls:>8}{total:>12.4f}"
+              f"{1000 * total / max(calls, 1):>12.4f}")
+
+
+def reset_profiler():
+    _records.clear()

@@ -1,0 +1,83 @@
+"""Tape autograd correctness vs jax.grad (SURVEY §4)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_simple_grad_matches_jax():
+    x = pt.to_tensor(np.random.randn(4, 3).astype("f4"), stop_gradient=False)
+    w = pt.Parameter(np.random.randn(3, 2).astype("f4"))
+    loss = pt.matmul(x, w).square().mean()
+    loss.backward()
+    ref = jax.grad(lambda w_: jnp.mean(jnp.square(x.data @ w_)))(w.data)
+    np.testing.assert_allclose(w.grad, ref, atol=1e-5)
+    ref_x = jax.grad(lambda x_: jnp.mean(jnp.square(x_ @ w.data)))(x.data)
+    np.testing.assert_allclose(x.grad, ref_x, atol=1e-5)
+
+
+def test_grad_accumulation():
+    w = pt.Parameter(np.ones((3,), "f4"))
+    for _ in range(3):
+        (w * 2.0).sum().backward()
+    np.testing.assert_allclose(w.grad, 6.0 * np.ones(3), atol=1e-6)
+    w.clear_gradient()
+    assert w.grad is None
+
+
+def test_branching_graph():
+    w = pt.Parameter(np.array([2.0], "f4"))
+    a = w * 3.0
+    b = a * a + a
+    b.sum().backward()
+    # d/dw (9w^2 + 3w) = 18w + 3 = 39
+    np.testing.assert_allclose(w.grad, [39.0], atol=1e-5)
+
+
+def test_no_grad():
+    w = pt.Parameter(np.ones((3,), "f4"))
+    with pt.no_grad():
+        y = (w * 2.0).sum()
+    assert y._tape_node is None
+    y2 = (w * 2.0).sum()
+    assert y2._tape_node is not None
+
+
+def test_stop_gradient_blocks():
+    w = pt.Parameter(np.ones((3,), "f4"))
+    y = (w * 2.0).detach()
+    z = (y * 3.0).sum()
+    z.backward()
+    assert w.grad is None
+
+
+def test_functional_grad_api():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * x).sum()
+    g = pt.autograd.grad(y, x, retain_graph=False)
+    np.testing.assert_allclose(g.numpy(), [2.0, 4.0], atol=1e-6)
+    assert x.grad is None  # paddle.grad must not touch accumulators
+
+
+def test_multi_output_op_grad():
+    x = pt.to_tensor(np.random.randn(5, 4).astype("f4"), stop_gradient=False)
+    vals, idx = pt.topk(x, k=2)
+    vals.sum().backward()
+    assert x.grad is not None
+    assert x.grad.shape == (5, 4)
+
+
+def test_second_backward_without_retain_raises():
+    w = pt.Parameter(np.ones((2,), "f4"))
+    y = (w * 2.0).sum()
+    y.backward()
+    with pytest.raises(RuntimeError, match="freed"):
+        y.backward()
+    # with retain_graph the second backward accumulates
+    y2 = (w * 2.0).sum()
+    w.clear_gradient()
+    y2.backward(retain_graph=True)
+    y2.backward()
+    np.testing.assert_allclose(np.asarray(w.grad), 4.0 * np.ones(2))

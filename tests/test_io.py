@@ -1,0 +1,95 @@
+"""IO: save/load, checkpoints, DataLoader (SURVEY §4)."""
+import os
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, io, optimizer as opt
+
+
+def test_save_load_state_dict(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    path = str(tmp_path / "model.pdparams")
+    io.save(m.state_dict(), path)
+    loaded = io.load(path)
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    m2.set_state_dict(loaded)
+    for (k, v), (k2, v2) in zip(sorted(m.state_dict().items()),
+                                sorted(m2.state_dict().items())):
+        np.testing.assert_allclose(v.numpy(), v2.numpy())
+
+
+def test_save_load_dygraph_roundtrip(tmp_path):
+    m = nn.Linear(3, 3)
+    o = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+    m(pt.to_tensor(np.ones((2, 3), "f4"))).mean().backward()
+    o.step()
+    io.save_dygraph(m.state_dict(), str(tmp_path / "ck"))
+    params, _ = io.load_dygraph(str(tmp_path / "ck"))
+    assert params is not None and "weight" in params
+
+
+def test_inference_model_roundtrip(tmp_path):
+    from paddle_tpu.models import LeNet
+    m = LeNet()
+    x = np.random.randn(2, 1, 28, 28).astype("f4")
+    ref = m.eval()(pt.to_tensor(x)).numpy()
+    io.save_inference_model(str(tmp_path / "infer"), m)
+    m2 = io.load_inference_model(str(tmp_path / "infer"))
+    out = m2(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_checkpoint_manager(tmp_path):
+    m = nn.Linear(2, 2)
+    o = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+    cm = io.CheckpointManager(str(tmp_path / "ckpts"), max_to_keep=2)
+    for step in [10, 20, 30]:
+        m(pt.to_tensor(np.ones((1, 2), "f4"))).mean().backward()
+        o.step(); o.clear_grad()
+        cm.save(step, model=m, optimizer=o)
+    assert cm.latest_step() == 30
+    # only last 2 kept
+    assert cm._steps() == [20, 30]
+    w_before = m.weight.numpy().copy()
+    m.weight.set_value(np.zeros_like(w_before))
+    state = cm.restore(model=m, optimizer=o)
+    assert state["step"] == 30
+    np.testing.assert_allclose(m.weight.numpy(), w_before)
+
+
+def test_dataloader_batching_and_shuffle():
+    x = np.arange(100, dtype="f4").reshape(100, 1)
+    y = np.arange(100, dtype="i4")
+    ds = io.TensorDataset(x, y)
+    dl = io.DataLoader(ds, batch_size=16, shuffle=False, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 6
+    assert batches[0][0].shape == (16, 1)
+    np.testing.assert_allclose(batches[0][1], np.arange(16))
+
+    dl2 = io.DataLoader(ds, batch_size=16, shuffle=True, seed=0)
+    b1 = list(dl2)
+    assert not np.allclose(b1[0][1], np.arange(16))
+    # epoch 2 reshuffles differently
+    b2 = list(dl2)
+    assert not np.allclose(b1[0][1], b2[0][1])
+
+
+def test_dataloader_prefetch_thread():
+    ds = io.TensorDataset(np.random.rand(64, 3).astype("f4"))
+    dl = io.DataLoader(ds, batch_size=8, num_workers=1, prefetch_factor=2)
+    total = sum(b[0].shape[0] for b in dl)
+    assert total == 64
+
+
+def test_reader_decorators():
+    def reader():
+        for i in range(10):
+            yield (np.float32(i),)
+    br = io.batch_reader(reader, 3)
+    batches = list(br())
+    assert len(batches) == 4
+    sr = io.shuffle_reader(reader, buf_size=10, seed=1)
+    vals = [v[0] for v in sr()]
+    assert sorted(vals) == list(range(10))

@@ -1,0 +1,200 @@
+"""Layer construction/forward shapes + Layer-base machinery (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def rand(*shape):
+    return pt.to_tensor(np.random.randn(*shape).astype("f4"))
+
+
+def test_linear():
+    fc = nn.Linear(8, 4)
+    out = fc(rand(2, 8))
+    assert out.shape == [2, 4]
+    fc2 = nn.Linear(8, 4, bias_attr=False)
+    assert fc2.bias is None
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(3, 16, 3, stride=2, padding=1)
+    out = conv(rand(2, 3, 32, 32))
+    assert out.shape == [2, 16, 16, 16]
+    convg = nn.Conv2D(16, 16, 3, groups=4, padding=1)
+    assert convg(out).shape == [2, 16, 16, 16]
+
+
+def test_conv2d_matches_numpy():
+    """3x3 conv vs naive numpy (NCHW)."""
+    x = np.random.randn(1, 2, 5, 5).astype("f4")
+    w = np.random.randn(3, 2, 3, 3).astype("f4")
+    from paddle_tpu.nn import functional as F
+    out = F.conv2d(pt.to_tensor(x), pt.to_tensor(w)).numpy()
+    ref = np.zeros((1, 3, 3, 3), "f4")
+    for o in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[0, o, i, j] = (x[0, :, i:i + 3, j:j + 3] * w[o]).sum()
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_conv2d_transpose():
+    deconv = nn.Conv2DTranspose(8, 4, 2, stride=2)
+    out = deconv(rand(2, 8, 7, 7))
+    assert out.shape == [2, 4, 14, 14]
+
+
+def test_pools():
+    x = rand(2, 4, 8, 8)
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 4, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 4, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 4, 1, 1]
+    g = nn.Pool2D(global_pooling=True, pool_type="avg")(x)
+    assert g.shape == [2, 4, 1, 1]
+
+
+def test_avg_pool_matches_numpy():
+    x = np.random.randn(1, 1, 4, 4).astype("f4")
+    out = nn.AvgPool2D(2, 2)(pt.to_tensor(x)).numpy()
+    ref = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_batch_norm_train_eval():
+    bn = nn.BatchNorm2D(4)
+    x = rand(8, 4, 5, 5)
+    bn.train()
+    out = bn(x)
+    assert out.shape == [8, 4, 5, 5]
+    # batch-normalized output:近 zero mean unit var per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 0.1
+    # running stats moved off init
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [8, 4, 5, 5]
+
+
+def test_layer_norm():
+    ln = nn.LayerNorm(16)
+    out = ln(rand(4, 16))
+    o = out.numpy()
+    np.testing.assert_allclose(o.mean(-1), 0.0, atol=1e-4)
+    np.testing.assert_allclose(o.std(-1), 1.0, atol=1e-2)
+
+
+def test_group_instance_norm():
+    x = rand(2, 8, 4, 4)
+    assert nn.GroupNorm(2, 8)(x).shape == [2, 8, 4, 4]
+    assert nn.InstanceNorm2D(8)(x).shape == [2, 8, 4, 4]
+
+
+def test_embedding():
+    emb = nn.Embedding(100, 16, padding_idx=0)
+    ids = pt.to_tensor(np.array([[1, 2, 0], [4, 0, 6]]))
+    out = emb(ids)
+    assert out.shape == [2, 3, 16]
+    np.testing.assert_allclose(out.numpy()[0, 2], np.zeros(16))
+
+
+def test_dropout_modes():
+    x = rand(1000)
+    drop = nn.Dropout(0.5)
+    drop.train()
+    y = drop(x).numpy()
+    frac_zero = (y == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+    drop.eval()
+    np.testing.assert_allclose(drop(x).numpy(), x.numpy())
+
+
+def test_containers():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert seq(rand(3, 4)).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(4, 4) for _ in range(3)])
+    ll.append(nn.Linear(4, 4))
+    assert len(ll) == 4
+    x = rand(2, 4)
+    for l in ll:
+        x = l(x)
+    assert x.shape == [2, 4]
+    named = nn.Sequential(("a", nn.Linear(2, 2)), ("b", nn.ReLU()))
+    assert named(rand(1, 2)).shape == [1, 2]
+
+
+def test_state_dict_roundtrip():
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    sd = m1.state_dict()
+    assert any("weight" in k for k in sd)
+    m2.set_state_dict(sd)
+    for (k1, v1), (k2, v2) in zip(sorted(m1.state_dict().items()),
+                                  sorted(m2.state_dict().items())):
+        np.testing.assert_allclose(v1.numpy(), v2.numpy())
+
+
+def test_named_parameters_and_apply():
+    m = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert "0.weight" in names and "1.bias" in names
+    m.eval()
+    assert all(not l.training for l in m.sublayers())
+
+
+def test_sublayer_attr_plumbing():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(2, 2)
+            self.w = self.create_parameter((2,))
+
+        def forward(self, x):
+            return self.fc(x) + self.w
+
+    m = M()
+    assert len(m.parameters()) == 3
+    assert m(rand(1, 2)).shape == [1, 2]
+    # replacing a sublayer updates the registry
+    m.fc = nn.Linear(2, 2, bias_attr=False)
+    assert len(m.parameters()) == 2
+
+
+def test_spectral_norm_and_misc_layers():
+    sn = nn.SpectralNorm((4, 3))
+    w = rand(4, 3)
+    out = sn(w)
+    assert out.shape == [4, 3]
+    # largest singular value ≈ 1 after normalization (power iters converge)
+    for _ in range(20):
+        out = sn(w)
+    s = np.linalg.svd(out.numpy(), compute_uv=False)[0]
+    assert abs(s - 1.0) < 0.1
+
+    btp = nn.BilinearTensorProduct(3, 4, 5)
+    assert btp(rand(2, 3), rand(2, 4)).shape == [2, 5]
+
+    gru = nn.GRUUnit(3 * 6)
+    h, _, _ = gru(rand(2, 18), rand(2, 6))
+    assert h.shape == [2, 6]
+
+    pr = nn.PRelu(mode="channel", channel=4)
+    assert pr(rand(2, 4, 3, 3)).shape == [2, 4, 3, 3]
+
+
+def test_activation_layers():
+    x = rand(4, 4)
+    for cls in [nn.ReLU, nn.GELU, nn.Sigmoid, nn.Tanh, nn.LeakyReLU,
+                nn.Softmax, nn.Swish, nn.Hardswish, nn.ELU, nn.Mish]:
+        assert cls()(x).shape == [4, 4]
+
+
+def test_upsample_and_pad():
+    x = rand(1, 2, 4, 4)
+    up = nn.Upsample(scale_factor=2, mode="nearest")
+    assert up(x).shape == [1, 2, 8, 8]
+    pad = nn.Pad2D([1, 1, 2, 2])
+    assert pad(x).shape == [1, 2, 8, 6]

@@ -1,0 +1,76 @@
+"""Metrics (SURVEY §4; reference metrics.py unittests)."""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import metric
+
+
+def test_accuracy():
+    m = metric.Accuracy()
+    pred = np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "f4")
+    label = np.array([1, 0, 0])
+    m.update(pred, label)
+    assert abs(m.accumulate() - 2 / 3) < 1e-6
+    m.reset()
+    assert m.accumulate() == 0.0
+
+
+def test_accuracy_topk():
+    m = metric.Accuracy(topk=(1, 2))
+    pred = np.array([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]], "f4")
+    label = np.array([1, 1])
+    m.update(pred, label)
+    a1, a2 = m.accumulate()
+    assert abs(a1 - 0.0) < 1e-6 and abs(a2 - 1.0) < 1e-6
+
+
+def test_precision_recall():
+    p = metric.Precision()
+    r = metric.Recall()
+    preds = np.array([1, 1, 0, 1])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+
+def test_auc_perfect_and_random():
+    auc = metric.Auc()
+    preds = np.array([0.9, 0.8, 0.2, 0.1], "f4")
+    labels = np.array([1, 1, 0, 0])
+    auc.update(preds, labels)
+    assert auc.accumulate() > 0.99
+    auc.reset()
+    auc.update(np.array([0.5, 0.5, 0.5, 0.5], "f4"), labels)
+    assert abs(auc.accumulate() - 0.5) < 0.01
+
+
+def test_chunk_evaluator():
+    ce = metric.ChunkEvaluator()
+    ce.update(np.array([10]), np.array([8]), np.array([6]))
+    p, r, f1 = ce.accumulate()
+    assert abs(p - 0.6) < 1e-6 and abs(r - 0.75) < 1e-6
+
+
+def test_edit_distance():
+    ed = metric.EditDistance()
+    ed.update(["kitten"], ["sitting"])
+    avg, err = ed.accumulate()
+    assert abs(avg - 3 / 7) < 1e-6 and err == 1.0
+
+
+def test_composite():
+    cm = metric.CompositeMetric()
+    cm.add_metric(metric.Precision())
+    cm.add_metric(metric.Recall())
+    cm.update(np.array([1, 0]), np.array([1, 1]))
+    p, r = cm.accumulate()
+    assert p == 1.0 and r == 0.5
+
+
+def test_functional_accuracy():
+    pred = pt.to_tensor(np.array([[0.9, 0.1], [0.4, 0.6]], "f4"))
+    label = pt.to_tensor(np.array([0, 1]))
+    acc = metric.accuracy(pred, label)
+    assert abs(float(acc.numpy()) - 1.0) < 1e-6

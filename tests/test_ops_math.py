@@ -1,0 +1,146 @@
+"""Per-op numeric tests vs numpy (SURVEY §4; mirrors reference
+unittests/test_*_op.py) including finite-difference gradient checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def fd_grad(fn, x, eps=1e-3):
+    """Central finite differences of scalar fn at x (numpy)."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+])
+def test_binary_ops(op, npop):
+    a = np.random.rand(3, 4).astype("f4") + 0.5
+    b = np.random.rand(3, 4).astype("f4") + 0.5
+    out = getattr(pt, op)(pt.to_tensor(a), pt.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), npop(a, b), rtol=1e-5)
+
+
+def test_broadcasting():
+    a = np.random.rand(3, 1, 4).astype("f4")
+    b = np.random.rand(5, 1).astype("f4")
+    out = pt.add(pt.to_tensor(a), pt.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a + b, rtol=1e-6)
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt),
+    ("tanh", np.tanh), ("abs", np.abs), ("square", np.square),
+    ("floor", np.floor), ("ceil", np.ceil), ("sign", np.sign),
+])
+def test_unary_ops(op, npop):
+    a = np.random.rand(3, 4).astype("f4") + 0.5
+    out = getattr(pt, op)(pt.to_tensor(a))
+    np.testing.assert_allclose(out.numpy(), npop(a), rtol=1e-5)
+
+
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False),
+                                          (1, True), ((0, 1), False)])
+def test_reductions(axis, keepdim):
+    a = np.random.rand(3, 4, 2).astype("f4")
+    for op, npop in [("sum", np.sum), ("mean", np.mean), ("max", np.max),
+                     ("min", np.min)]:
+        out = getattr(pt, op)(pt.to_tensor(a), axis=axis, keepdim=keepdim)
+        np.testing.assert_allclose(out.numpy(),
+                                   npop(a, axis=axis, keepdims=keepdim),
+                                   rtol=1e-5)
+
+
+def test_matmul_transpose_flags():
+    a = np.random.rand(4, 3).astype("f4")
+    b = np.random.rand(4, 5).astype("f4")
+    out = pt.matmul(pt.to_tensor(a), pt.to_tensor(b), transpose_x=True)
+    np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+
+def test_matmul_gradient_fd():
+    a = np.random.rand(3, 4).astype("f8")
+    b = np.random.rand(4, 2).astype("f8")
+    ta = pt.to_tensor(a.astype("f4"), stop_gradient=False)
+    tb = pt.to_tensor(b.astype("f4"), stop_gradient=False)
+    pt.matmul(ta, tb).sum().backward()
+    ga = fd_grad(lambda x: (x @ b).sum(), a)
+    gb = fd_grad(lambda y: (a @ y).sum(), b)
+    np.testing.assert_allclose(ta.grad, ga, atol=1e-2)
+    np.testing.assert_allclose(tb.grad, gb, atol=1e-2)
+
+
+def test_softmax_xent_gradient_fd():
+    logits = np.random.randn(4, 5).astype("f8")
+    labels = np.array([1, 0, 3, 2])
+    t = pt.to_tensor(logits.astype("f4"), stop_gradient=False)
+    loss = pt.ops.loss.softmax_with_cross_entropy(
+        t, pt.to_tensor(labels)).mean()
+    loss.backward()
+
+    def ref(lg):
+        m = lg - lg.max(-1, keepdims=True)
+        lse = np.log(np.exp(m).sum(-1)) + lg.max(-1)
+        picked = lg[np.arange(4), labels]
+        return (lse - picked).mean()
+
+    np.testing.assert_allclose(t.grad, fd_grad(ref, logits), atol=1e-2)
+
+
+def test_topk_argmax():
+    a = np.random.rand(3, 6).astype("f4")
+    vals, idx = pt.topk(pt.to_tensor(a), k=2)
+    ref_idx = np.argsort(-a, axis=-1)[:, :2]
+    np.testing.assert_allclose(np.sort(vals.numpy(), -1),
+                               np.sort(np.take_along_axis(a, ref_idx, -1), -1),
+                               rtol=1e-6)
+    am = pt.argmax(pt.to_tensor(a), axis=1)
+    np.testing.assert_array_equal(am.numpy(), a.argmax(1))
+
+
+def test_comparisons_nondiff():
+    a = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = pt.to_tensor([2.0, 1.0])
+    out = a < b
+    assert out.stop_gradient
+    np.testing.assert_array_equal(out.numpy(), [True, False])
+
+
+def test_where_clip():
+    a = np.random.randn(4, 4).astype("f4")
+    out = pt.clip(pt.to_tensor(a), -0.5, 0.5)
+    np.testing.assert_allclose(out.numpy(), np.clip(a, -0.5, 0.5))
+    cond = a > 0
+    w = pt.where(pt.to_tensor(cond), pt.to_tensor(a), pt.to_tensor(-a))
+    np.testing.assert_allclose(w.numpy(), np.abs(a), rtol=1e-6)
+
+
+def test_cumsum_norm():
+    a = np.random.rand(3, 4).astype("f4")
+    np.testing.assert_allclose(pt.cumsum(pt.to_tensor(a), axis=1).numpy(),
+                               np.cumsum(a, axis=1), rtol=1e-5)
+    np.testing.assert_allclose(pt.norm(pt.to_tensor(a)).numpy(),
+                               np.linalg.norm(a), rtol=1e-5)
+
+
+def test_tensor_methods_and_operators():
+    a = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_allclose((a + 1).numpy(), [[2, 3], [4, 5]])
+    np.testing.assert_allclose((2 * a).numpy(), [[2, 4], [6, 8]])
+    np.testing.assert_allclose((-a).numpy(), [[-1, -2], [-3, -4]])
+    np.testing.assert_allclose((a ** 2).numpy(), [[1, 4], [9, 16]])
+    np.testing.assert_allclose(a.reshape([4]).numpy(), [1, 2, 3, 4])
+    np.testing.assert_allclose(a[0].numpy(), [1, 2])
+    np.testing.assert_allclose(a[:, 1].numpy(), [2, 4])
+    np.testing.assert_allclose(a.t().numpy() if hasattr(a, 't')
+                               else a.transpose([1, 0]).numpy(),
+                               [[1, 3], [2, 4]])

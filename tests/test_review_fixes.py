@@ -1,0 +1,95 @@
+"""Regression tests for code-review findings (round 1)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, io
+from paddle_tpu.nn import functional as F
+
+
+def test_conv2d_transpose_nhwc_matches_nchw():
+    x = np.random.randn(2, 8, 5, 5).astype("f4")
+    w = np.random.randn(8, 4, 3, 3).astype("f4")  # IOHW
+    ref = F.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w),
+                             stride=2).numpy()
+    out = F.conv2d_transpose(pt.to_tensor(x.transpose(0, 2, 3, 1)),
+                             pt.to_tensor(w), stride=2,
+                             data_format="NHWC").numpy()
+    np.testing.assert_allclose(out.transpose(0, 3, 1, 2), ref, atol=1e-4)
+
+
+def test_cross_entropy_negative_ignore_index():
+    logits = np.random.randn(6, 4).astype("f4")
+    labels = np.array([0, 1, -1, 2, -1, 3])
+    loss = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels),
+                           ignore_index=-1)
+    # equals mean over the 4 valid positions only
+    valid = labels >= 0
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    ref = -logp[np.arange(6), np.clip(labels, 0, 3)][valid].mean()
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+
+def test_cross_entropy_class_weight():
+    logits = np.random.randn(4, 3).astype("f4")
+    labels = np.array([0, 1, 2, 1])
+    w = np.array([1.0, 2.0, 0.5], "f4")
+    loss = F.cross_entropy(pt.to_tensor(logits), pt.to_tensor(labels),
+                           weight=pt.to_tensor(w))
+    logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    per = -logp[np.arange(4), labels] * w[labels]
+    ref = per.sum() / w[labels].sum()
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+
+def test_multinomial_without_replacement_unique():
+    probs = pt.to_tensor(np.ones((3, 10), "f4") / 10)
+    out = pt.multinomial(probs, num_samples=8, replacement=False).numpy()
+    for row in out:
+        assert len(set(row.tolist())) == 8
+
+
+def test_adaptive_pool_non_divisible():
+    x = pt.to_tensor(np.random.randn(1, 2, 7, 7).astype("f4"))
+    out = F.adaptive_avg_pool2d(x, 3)
+    assert out.shape == [1, 2, 3, 3]
+    # paddle formula: bucket [floor(i*H/os), ceil((i+1)*H/os))
+    xn = x.numpy()
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0],
+                               xn[0, 0, 0:3, 0:3].mean(), rtol=1e-5)
+    outm = F.adaptive_max_pool2d(x, 3)
+    np.testing.assert_allclose(outm.numpy()[0, 1, 2, 2],
+                               xn[0, 1, 4:7, 4:7].max(), rtol=1e-5)
+
+
+def test_save_dygraph_routes_opt_state(tmp_path):
+    from paddle_tpu import optimizer as opt
+    m = nn.Linear(2, 2)
+    o = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+    m(pt.to_tensor(np.ones((1, 2), "f4"))).mean().backward()
+    o.step()
+    base = str(tmp_path / "ck")
+    io.save_dygraph(m.state_dict(), base)
+    io.save_dygraph(o.state_dict(), base)
+    params, optstate = io.load_dygraph(base)
+    assert params is not None and "weight" in params
+    assert optstate is not None and "lr" in optstate
+
+
+def test_double_backward_shared_subgraph_raises():
+    w = pt.Parameter(np.ones(2, "f4"))
+    shared = w * 2.0
+    l1 = (shared * 3.0).sum()
+    l2 = (shared * 5.0).sum()
+    l1.backward()
+    with pytest.raises(RuntimeError, match="freed"):
+        l2.backward()
+
+
+def test_bce_elementwise_weight():
+    p = pt.to_tensor(np.array([0.9, 0.1], "f4"))
+    y = pt.to_tensor(np.array([1.0, 0.0], "f4"))
+    w = pt.to_tensor(np.array([2.0, 1.0], "f4"))
+    loss = F.binary_cross_entropy(p, y, weight=w, reduction="sum")
+    ref = -(2.0 * np.log(0.9) + 1.0 * np.log(0.9))
+    np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-4)

@@ -1,0 +1,86 @@
+"""Static Program/Executor parity with dygraph (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, static, optimizer as opt
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    static.reset_default_programs()
+    pt.enable_static()
+    yield
+    pt.disable_static()
+
+
+def test_forward_parity_with_dygraph():
+    pt.seed(3)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+    x = static.data("x", [None, 4], "float32")
+    out = model(x)
+
+    exe = static.Executor()
+    xv = np.random.randn(6, 4).astype("f4")
+    (res,) = exe.run(feed={"x": xv}, fetch_list=[out])
+
+    pt.disable_static()
+    ref = model(pt.to_tensor(xv)).numpy()
+    np.testing.assert_allclose(res, ref, atol=1e-5)
+
+
+def test_static_training_converges():
+    pt.seed(0)
+    model = nn.Linear(2, 1)
+    x = static.data("x", [None, 2], "float32")
+    y = static.data("y", [None, 1], "float32")
+    pred = model(x)
+    loss = (pred - y).square().mean()
+    o = opt.SGD(learning_rate=0.1)
+    o.minimize(loss)
+
+    exe = static.Executor()
+    rng = np.random.RandomState(0)
+    w_true = np.array([[2.0], [-1.0]], "f4")
+    losses = []
+    for _ in range(60):
+        xv = rng.randn(32, 2).astype("f4")
+        yv = xv @ w_true
+        (lv,) = exe.run(feed={"x": xv, "y": yv}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.01
+    np.testing.assert_allclose(model.weight.numpy(), w_true, atol=0.05)
+
+
+def test_program_guard_isolation():
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 3], "float32")
+        y = x * 2.0
+        assert y.program is main
+    assert not static.default_main_program().global_block().ops
+
+
+def test_executor_cache_reuse():
+    model = nn.Linear(3, 3)
+    x = static.data("x", [None, 3], "float32")
+    out = model(x)
+    exe = static.Executor()
+    xv = np.random.randn(4, 3).astype("f4")
+    r1 = exe.run(feed={"x": xv}, fetch_list=[out])[0]
+    r2 = exe.run(feed={"x": xv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(r1, r2)
+    assert len(exe._cache) == 1
+
+
+def test_clone_for_test_drops_optimizer():
+    model = nn.Linear(2, 1)
+    x = static.data("x", [None, 2], "float32")
+    loss = model(x).mean()
+    o = opt.SGD(learning_rate=0.1)
+    o.minimize(loss)
+    prog = static.default_main_program()
+    test_prog = prog.clone(for_test=True)
+    assert prog.optimizers and not test_prog.optimizers
