@@ -1,0 +1,111 @@
+"""Benchmark: BERT-base train tokens/sec/chip + ResNet-50 train images/sec
+(SURVEY §6). Runs on the real chip, bf16 compute, donated buffers; prints
+ONE JSON line.
+
+Baselines (BASELINE.json "north star": within 10% of Paddle's own V100
+numbers): Paddle-era V100 fp32 ResNet-50 ≈ 360 images/s; BERT-base seq128
+≈ 25k tokens/s. vs_baseline is ours ÷ that reference.
+"""
+import json
+import time
+
+import numpy as np
+
+BERT_BASELINE_TOKENS_S = 25000.0   # Paddle V100 BERT-base seq128 approx
+RESNET_BASELINE_IMG_S = 360.0      # Paddle V100 fp32 ResNet-50 approx
+
+
+def bench_bert(batch=16, seq=128, steps=20):
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as opt, jit, amp
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    pt.seed(0)
+    cfg = BertConfig.base()
+    model = BertForPretraining(cfg)
+    o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("i4")
+    mlm = np.where(rng.rand(batch, seq) < 0.15,
+                   rng.randint(0, cfg.vocab_size, (batch, seq)), -1
+                   ).astype("i4")
+    nsp = rng.randint(0, 2, (batch,)).astype("i4")
+
+    def step(ids, mlm, nsp):
+        with amp.auto_cast(dtype="bfloat16"):
+            logits, nsp_logits = model(ids)
+        loss = model.loss(logits.astype("float32"),
+                          nsp_logits.astype("float32"), mlm, nsp)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    fn = jit.to_static(step, models=[model], optimizers=[o])
+    t_ids, t_mlm, t_nsp = pt.to_tensor(ids), pt.to_tensor(mlm), \
+        pt.to_tensor(nsp)
+    fn(t_ids, t_mlm, t_nsp)  # compile
+    loss = fn(t_ids, t_mlm, t_nsp)
+    loss.numpy()  # sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = fn(t_ids, t_mlm, t_nsp)
+    loss.numpy()
+    dt = (time.perf_counter() - t0) / steps
+    return batch * seq / dt, float(loss.numpy())
+
+
+def bench_resnet(batch=64, steps=10):
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as opt, jit, amp
+    from paddle_tpu.models.resnet import resnet50
+
+    pt.seed(0)
+    model = resnet50()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 3, 224, 224).astype("f4")
+    y = rng.randint(0, 1000, (batch,)).astype("i4")
+
+    def step(xb, yb):
+        with amp.auto_cast(dtype="bfloat16"):
+            logits = model(xb)
+        loss = pt.nn.functional.cross_entropy(logits.astype("float32"), yb)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    fn = jit.to_static(step, models=[model], optimizers=[o])
+    tx, ty = pt.to_tensor(x), pt.to_tensor(y)
+    fn(tx, ty)  # compile
+    loss = fn(tx, ty)
+    loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = fn(tx, ty)
+    loss.numpy()
+    dt = (time.perf_counter() - t0) / steps
+    return batch / dt, float(loss.numpy())
+
+
+def main():
+    bert_tps, bert_loss = bench_bert()
+    rn_ips, rn_loss = bench_resnet()
+    result = {
+        "metric": "bert_base_tokens/sec/chip",
+        "value": round(bert_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(bert_tps / BERT_BASELINE_TOKENS_S, 3),
+        "resnet50_images_per_sec": round(rn_ips, 1),
+        "resnet50_vs_baseline": round(rn_ips / RESNET_BASELINE_IMG_S, 3),
+        "bert_loss": round(bert_loss, 4),
+        "resnet50_loss": round(rn_loss, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,7 +11,8 @@ __all__ = ["LeNet"]
 
 def __getattr__(name):
     # lazy imports keep `import paddle_tpu` light
-    if name in ("ResNet", "resnet50", "resnet18", "resnet34", "resnet101"):
+    if name in ("ResNet", "resnet50", "resnet18", "resnet34", "resnet101",
+                "resnet152"):
         from . import resnet
         return getattr(resnet, name)
     if name in ("VGG", "vgg16", "vgg19"):

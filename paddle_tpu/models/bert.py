@@ -1,0 +1,174 @@
+"""BERT (reference: the ERNIE/BERT fluid implementations used with this
+Paddle generation — static Program transformer encoder with
+fused layer_norm + softmax_with_cross_entropy; see also
+paddle/fluid/operators/fused/ for the fused kernels it relied on).
+
+TPU-first rebuild: one jitted train step; attention is a batched einsum
+(MXU) with optional Pallas flash-attention; bf16 compute via amp; the
+sequence axis can be sharded for long-context (parallel.ring_attention).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import nn, ops
+from ..ops import nn_ops as F
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 layer_norm_eps=1e-12, use_flash_attention=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.layer_norm_eps = layer_norm_eps
+        self.use_flash_attention = use_flash_attention
+
+    @staticmethod
+    def base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        d = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                 num_attention_heads=2, intermediate_size=512,
+                 max_position_embeddings=128)
+        d.update(kw)
+        return BertConfig(**d)
+
+
+class MultiHeadAttention(nn.Layer):
+    """Self-attention: fused QKV projection (one MXU matmul) + sdpa."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        d = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = d // self.num_heads
+        self.qkv = nn.Linear(d, 3 * d)
+        self.out = nn.Linear(d, d)
+        self.dropout_p = config.attention_probs_dropout_prob
+        self.use_flash = config.use_flash_attention
+
+    def forward(self, x, attn_mask=None):
+        b, s, d = x.shape
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        qkv = qkv.transpose([2, 0, 3, 1, 4])  # 3, B, H, S, D
+        q, k, v = qkv[0], qkv[1], qkv[2]
+        if self.use_flash:
+            from ..ops.pallas import flash_attention
+            ctx = flash_attention(q, k, v, attn_mask=attn_mask,
+                                  dropout_p=self.dropout_p,
+                                  training=self.training)
+        else:
+            ctx = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=self.dropout_p,
+                training=self.training)
+        ctx = ctx.transpose([0, 2, 1, 3]).reshape([b, s, d])
+        return self.out(ctx)
+
+
+class TransformerEncoderLayer(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        d = config.hidden_size
+        self.attention = MultiHeadAttention(config)
+        self.attn_norm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
+        self.ffn1 = nn.Linear(d, config.intermediate_size)
+        self.ffn2 = nn.Linear(config.intermediate_size, d)
+        self.ffn_norm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
+        h = self.ffn2(F.gelu(self.ffn1(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        d = config.hidden_size
+        self.word_embeddings = nn.Embedding(config.vocab_size, d)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, d)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size, d)
+        self.norm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = ops.arange(0, s, dtype="int32").unsqueeze(0)
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.norm(emb))
+
+
+class Bert(nn.Layer):
+    """Encoder stack + pooler (reference ERNIE/BERT encoder)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [TransformerEncoderLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        if attention_mask is not None:
+            # [B, S] -> additive [B, 1, 1, S]
+            am = (1.0 - attention_mask.astype("float32")) * -1e9
+            am = am.unsqueeze(1).unsqueeze(1)
+        else:
+            am = None
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, am)
+        pooled = ops.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference: the train.py of the fluid BERT repo)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = Bert(config)
+        d = config.hidden_size
+        self.mlm_transform = nn.Linear(d, d)
+        self.mlm_norm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
+        self.mlm_bias = self.create_parameter((config.vocab_size,),
+                                              is_bias=True)
+        self.nsp = nn.Linear(d, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        # tied output embedding: reuse word embedding table (one big MXU gemm)
+        logits = ops.matmul(
+            h, self.bert.embeddings.word_embeddings.weight,
+            transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return logits, nsp_logits
+
+    def loss(self, logits, nsp_logits, mlm_labels, nsp_labels,
+             ignore_index=-1):
+        mlm = ops.loss.cross_entropy(logits, mlm_labels,
+                                     ignore_index=ignore_index)
+        nsp = ops.loss.cross_entropy(nsp_logits, nsp_labels)
+        return mlm + nsp

@@ -1,0 +1,109 @@
+"""CTR models: Wide&Deep and DeepFM (reference: the fluid parameter-server
+CTR examples under fluid/incubate/fleet/parameter_server + PaddleRec-era
+configs — sparse lookup_table + fc tower trained via the distributed
+transpiler).
+
+TPU-first redesign: there is no parameter server — the big embedding tables
+are *mesh-sharded* (parallel.embedding.ShardedEmbedding shards rows over a
+mesh axis and resolves lookups with collectives), and training is pure
+data-parallel all-reduce. Dense towers are ordinary MXU matmuls.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn, ops
+from ..ops import nn_ops as F
+
+
+class SparseFeatureEmbedding(nn.Layer):
+    """Embedding for ID features; swaps in a sharded table when a mesh is
+    active and `sharded=True` (the PS replacement)."""
+
+    def __init__(self, num_embeddings, embedding_dim, sharded=False,
+                 axis_name="mp"):
+        super().__init__()
+        if sharded:
+            from ..parallel.embedding import ShardedEmbedding
+            self.table = ShardedEmbedding(num_embeddings, embedding_dim,
+                                          axis_name=axis_name)
+        else:
+            self.table = nn.Embedding(num_embeddings, embedding_dim)
+
+    def forward(self, ids):
+        return self.table(ids)
+
+
+class WideDeep(nn.Layer):
+    """Wide (linear over sparse ids) + Deep (embeddings -> MLP)."""
+
+    def __init__(self, sparse_feature_number=10000, sparse_num_field=26,
+                 dense_feature_dim=13, embedding_size=16,
+                 layer_sizes=(400, 400, 400), sharded=False):
+        super().__init__()
+        self.wide = SparseFeatureEmbedding(sparse_feature_number, 1,
+                                           sharded=sharded)
+        self.embedding = SparseFeatureEmbedding(sparse_feature_number,
+                                                embedding_size,
+                                                sharded=sharded)
+        dims = [sparse_num_field * embedding_size + dense_feature_dim] + \
+            list(layer_sizes)
+        mlp = []
+        for i in range(len(layer_sizes)):
+            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        mlp.append(nn.Linear(dims[-1], 1))
+        self.deep = nn.Sequential(*mlp)
+        self.dense_fc = nn.Linear(dense_feature_dim, dense_feature_dim)
+
+    def forward(self, sparse_ids, dense_features):
+        # sparse_ids: [B, F] int ids; dense: [B, D]
+        wide_logit = self.wide(sparse_ids).squeeze(-1).sum(axis=1,
+                                                           keepdim=True)
+        emb = self.embedding(sparse_ids).flatten(1)
+        deep_in = ops.concat([emb, F.relu(self.dense_fc(dense_features))],
+                             axis=1)
+        deep_logit = self.deep(deep_in)
+        return wide_logit + deep_logit
+
+    def loss(self, logit, label):
+        return ops.loss.binary_cross_entropy_with_logits(
+            logit, label.astype("float32").reshape(logit.shape))
+
+
+class DeepFM(nn.Layer):
+    """FM (1st + 2nd order) + deep tower (reference PaddleRec deepfm)."""
+
+    def __init__(self, sparse_feature_number=10000, sparse_num_field=26,
+                 dense_feature_dim=13, embedding_size=16,
+                 layer_sizes=(400, 400, 400), sharded=False):
+        super().__init__()
+        self.first_order = SparseFeatureEmbedding(sparse_feature_number, 1,
+                                                  sharded=sharded)
+        self.embedding = SparseFeatureEmbedding(sparse_feature_number,
+                                                embedding_size,
+                                                sharded=sharded)
+        self.dense_w = self.create_parameter((1, dense_feature_dim))
+        dims = [sparse_num_field * embedding_size + dense_feature_dim] + \
+            list(layer_sizes)
+        mlp = []
+        for i in range(len(layer_sizes)):
+            mlp += [nn.Linear(dims[i], dims[i + 1]), nn.ReLU()]
+        mlp.append(nn.Linear(dims[-1], 1))
+        self.deep = nn.Sequential(*mlp)
+
+    def forward(self, sparse_ids, dense_features):
+        first = self.first_order(sparse_ids).squeeze(-1).sum(
+            axis=1, keepdim=True)
+        first = first + (dense_features * self.dense_w).sum(axis=1,
+                                                            keepdim=True)
+        emb = self.embedding(sparse_ids)  # [B, F, K]
+        sum_sq = emb.sum(axis=1).square()
+        sq_sum = emb.square().sum(axis=1)
+        second = 0.5 * (sum_sq - sq_sum).sum(axis=1, keepdim=True)
+        deep_in = ops.concat([emb.flatten(1), dense_features], axis=1)
+        deep = self.deep(deep_in)
+        return first + second + deep
+
+    def loss(self, logit, label):
+        return ops.loss.binary_cross_entropy_with_logits(
+            logit, label.astype("float32").reshape(logit.shape))

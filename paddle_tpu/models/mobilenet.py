@@ -1,0 +1,88 @@
+"""MobileNet V1/V2 (reference: fluid benchmark configs mobilenet_ssd /
+image classification mobilenet).
+
+TPU note: depthwise convs map to feature_group_count convolutions; XLA
+lowers them efficiently, though they are HBM-bound rather than MXU-bound.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+def _conv_bn(cin, cout, k, stride=1, padding=0, groups=1, relu6=True):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=padding,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(cout),
+        nn.ReLU6() if relu6 else nn.ReLU(),
+    )
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, num_classes=1000, scale=1.0, in_channels=3):
+        super().__init__()
+        def c(ch):
+            return max(8, int(ch * scale))
+        cfg = [(c(32), c(64), 1), (c(64), c(128), 2), (c(128), c(128), 1),
+               (c(128), c(256), 2), (c(256), c(256), 1), (c(256), c(512), 2)]
+        cfg += [(c(512), c(512), 1)] * 5
+        cfg += [(c(512), c(1024), 2), (c(1024), c(1024), 1)]
+        layers = [_conv_bn(in_channels, c(32), 3, stride=2, padding=1,
+                           relu6=False)]
+        for cin, cout, s in cfg:
+            layers.append(_conv_bn(cin, cin, 3, stride=s, padding=1,
+                                   groups=cin, relu6=False))  # depthwise
+            layers.append(_conv_bn(cin, cout, 1, relu6=False))  # pointwise
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(c(1024), num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.fc(x.flatten(1))
+
+
+class InvertedResidual(nn.Layer):
+    def __init__(self, cin, cout, stride, expand):
+        super().__init__()
+        hidden = cin * expand
+        self.use_res = stride == 1 and cin == cout
+        layers = []
+        if expand != 1:
+            layers.append(_conv_bn(cin, hidden, 1))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride=stride, padding=1,
+                     groups=hidden),
+            nn.Conv2D(hidden, cout, 1, bias_attr=False),
+            nn.BatchNorm2D(cout),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    def __init__(self, num_classes=1000, scale=1.0, in_channels=3):
+        super().__init__()
+        def c(ch):
+            return max(8, int(ch * scale))
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        layers = [_conv_bn(in_channels, c(32), 3, stride=2, padding=1)]
+        cin = c(32)
+        for t, ch, n, s in cfg:
+            for i in range(n):
+                layers.append(InvertedResidual(cin, c(ch),
+                                               s if i == 0 else 1, t))
+                cin = c(ch)
+        layers.append(_conv_bn(cin, c(1280), 1))
+        self.features = nn.Sequential(*layers)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(c(1280), num_classes)
+
+    def forward(self, x):
+        x = self.pool(self.features(x))
+        return self.fc(x.flatten(1))

@@ -1,0 +1,121 @@
+"""ResNet family (reference: the fluid image-classification configs used by
+the book / benchmarks, e.g. PaddleClas-era ResNet-50 in
+python/paddle/fluid/tests + paddle/fluid/inference tests resnet50).
+
+TPU notes: convs lower to single MXU convolutions; BN+ReLU fuse into the
+conv epilogue under XLA. Train in bf16 via amp.auto_cast for the benchmark
+path. Layout is NCHW at the API (reference parity) — XLA's TPU layout
+assignment picks the internal layout.
+"""
+from __future__ import annotations
+
+from .. import nn
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_channels, channels, stride=1, downsample=None):
+        super().__init__()
+        self.conv0 = nn.Conv2D(in_channels, channels, 1, bias_attr=False)
+        self.bn0 = nn.BatchNorm2D(channels)
+        self.conv1 = nn.Conv2D(channels, channels, 3, stride=stride,
+                               padding=1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(channels)
+        self.conv2 = nn.Conv2D(channels, channels * 4, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(channels * 4)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn0(self.conv0(x)))
+        out = self.relu(self.bn1(self.conv1(out)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, in_channels, channels, stride=1, downsample=None):
+        super().__init__()
+        self.conv0 = nn.Conv2D(in_channels, channels, 3, stride=stride,
+                               padding=1, bias_attr=False)
+        self.bn0 = nn.BatchNorm2D(channels)
+        self.conv1 = nn.Conv2D(channels, channels, 3, padding=1,
+                               bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(channels)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn0(self.conv0(x)))
+        out = self.bn1(self.conv1(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class ResNet(nn.Layer):
+    def __init__(self, block, depths, num_classes=1000, in_channels=3):
+        super().__init__()
+        self.stem = nn.Sequential(
+            nn.Conv2D(in_channels, 64, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(64),
+            nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+        )
+        self.in_ch = 64
+        layers = []
+        for i, (channels, n) in enumerate(zip([64, 128, 256, 512], depths)):
+            stride = 1 if i == 0 else 2
+            layers.append(self._make_layer(block, channels, n, stride))
+        self.layers = nn.Sequential(*layers)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.flatten = nn.Flatten(1)
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block, channels, blocks, stride):
+        downsample = None
+        if stride != 1 or self.in_ch != channels * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self.in_ch, channels * block.expansion, 1,
+                          stride=stride, bias_attr=False),
+                nn.BatchNorm2D(channels * block.expansion),
+            )
+        layers = [block(self.in_ch, channels, stride, downsample)]
+        self.in_ch = channels * block.expansion
+        for _ in range(1, blocks):
+            layers.append(block(self.in_ch, channels))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.layers(x)
+        x = self.flatten(self.avgpool(x))
+        return self.fc(x)
+
+
+def resnet18(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes, **kw)
+
+
+def resnet34(num_classes=1000, **kw):
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet50(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes, **kw)
+
+
+def resnet101(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes, **kw)
+
+
+def resnet152(num_classes=1000, **kw):
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes, **kw)

@@ -1,0 +1,156 @@
+"""Transformer seq2seq for WMT en-de (reference: the fluid Transformer
+"big"/"base" machine-translation model — static Program with fused
+layer_norm, label_smooth + softmax_with_cross_entropy; e.g.
+fluid/tests/../transformer configs).
+
+TPU-first rebuild: pre-norm encoder-decoder, einsum attention on the MXU,
+lax.scan-free (full teacher forcing in one computation), label smoothing
+fused into the loss.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn, ops
+from ..ops import nn_ops as F
+
+
+def sinusoid_position_encoding(max_len, d_model):
+    pos = np.arange(max_len)[:, None].astype("f4")
+    i = np.arange(d_model // 2)[None, :].astype("f4")
+    angle = pos / np.power(10000.0, 2 * i / d_model)
+    enc = np.zeros((max_len, d_model), "f4")
+    enc[:, 0::2] = np.sin(angle)
+    enc[:, 1::2] = np.cos(angle)
+    return enc
+
+
+class CrossAttention(nn.Layer):
+    def __init__(self, d_model, num_heads, dropout=0.1):
+        super().__init__()
+        self.h = num_heads
+        self.dk = d_model // num_heads
+        self.q_proj = nn.Linear(d_model, d_model)
+        self.kv_proj = nn.Linear(d_model, 2 * d_model)
+        self.out = nn.Linear(d_model, d_model)
+        self.dropout_p = dropout
+
+    def forward(self, q_in, kv_in, mask=None, is_causal=False):
+        b, sq, d = q_in.shape
+        sk = kv_in.shape[1]
+        q = self.q_proj(q_in).reshape([b, sq, self.h, self.dk]).transpose(
+            [0, 2, 1, 3])
+        kv = self.kv_proj(kv_in).reshape([b, sk, 2, self.h, self.dk])
+        kv = kv.transpose([2, 0, 3, 1, 4])
+        k, v = kv[0], kv[1]
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, is_causal=is_causal,
+            dropout_p=self.dropout_p, training=self.training)
+        return self.out(ctx.transpose([0, 2, 1, 3]).reshape([b, sq, d]))
+
+
+class EncoderLayer(nn.Layer):
+    def __init__(self, d_model, num_heads, d_ff, dropout=0.1):
+        super().__init__()
+        self.self_attn = CrossAttention(d_model, num_heads, dropout)
+        self.norm1 = nn.LayerNorm(d_model)
+        self.norm2 = nn.LayerNorm(d_model)
+        self.ffn1 = nn.Linear(d_model, d_ff)
+        self.ffn2 = nn.Linear(d_ff, d_model)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        h = self.norm1(x)
+        x = x + self.dropout(self.self_attn(h, h, mask))
+        h = self.norm2(x)
+        return x + self.dropout(self.ffn2(F.relu(self.ffn1(h))))
+
+
+class DecoderLayer(nn.Layer):
+    def __init__(self, d_model, num_heads, d_ff, dropout=0.1):
+        super().__init__()
+        self.self_attn = CrossAttention(d_model, num_heads, dropout)
+        self.cross_attn = CrossAttention(d_model, num_heads, dropout)
+        self.norm1 = nn.LayerNorm(d_model)
+        self.norm2 = nn.LayerNorm(d_model)
+        self.norm3 = nn.LayerNorm(d_model)
+        self.ffn1 = nn.Linear(d_model, d_ff)
+        self.ffn2 = nn.Linear(d_ff, d_model)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x, memory, self_mask=None, cross_mask=None):
+        h = self.norm1(x)
+        x = x + self.dropout(self.self_attn(h, h, self_mask,
+                                            is_causal=True))
+        h = self.norm2(x)
+        x = x + self.dropout(self.cross_attn(h, memory, cross_mask))
+        h = self.norm3(x)
+        return x + self.dropout(self.ffn2(F.relu(self.ffn1(h))))
+
+
+class Transformer(nn.Layer):
+    """Pre-norm Transformer (base: d=512,h=8,L=6,ff=2048; big: d=1024,h=16,
+    ff=4096 — the reference benchmark config)."""
+
+    def __init__(self, src_vocab_size=32000, tgt_vocab_size=32000,
+                 d_model=512, num_heads=8, num_encoder_layers=6,
+                 num_decoder_layers=6, d_ff=2048, dropout=0.1,
+                 max_length=1024, label_smooth_eps=0.1,
+                 weight_sharing=False):
+        super().__init__()
+        self.d_model = d_model
+        self.label_smooth_eps = label_smooth_eps
+        self.src_embed = nn.Embedding(src_vocab_size, d_model)
+        self.tgt_embed = (self.src_embed if weight_sharing
+                          else nn.Embedding(tgt_vocab_size, d_model))
+        from ..tensor import Tensor
+        self.register_buffer(
+            "pos_enc", Tensor(sinusoid_position_encoding(max_length,
+                                                         d_model)))
+        self.encoder = nn.LayerList([
+            EncoderLayer(d_model, num_heads, d_ff, dropout)
+            for _ in range(num_encoder_layers)])
+        self.enc_norm = nn.LayerNorm(d_model)
+        self.decoder = nn.LayerList([
+            DecoderLayer(d_model, num_heads, d_ff, dropout)
+            for _ in range(num_decoder_layers)])
+        self.dec_norm = nn.LayerNorm(d_model)
+        self.out_proj = nn.Linear(d_model, tgt_vocab_size)
+        self.dropout = nn.Dropout(dropout)
+        self.scale = float(np.sqrt(d_model))
+
+    def _embed(self, table, ids):
+        s = ids.shape[1]
+        x = table(ids) * self.scale + self.pos_enc[:s]
+        return self.dropout(x)
+
+    def encode(self, src_ids, src_mask=None):
+        x = self._embed(self.src_embed, src_ids)
+        for layer in self.encoder:
+            x = layer(x, src_mask)
+        return self.enc_norm(x)
+
+    def decode(self, tgt_ids, memory, cross_mask=None):
+        x = self._embed(self.tgt_embed, tgt_ids)
+        for layer in self.decoder:
+            x = layer(x, memory, cross_mask=cross_mask)
+        return self.out_proj(self.dec_norm(x))
+
+    def forward(self, src_ids, tgt_ids, src_mask=None):
+        cross_mask = None
+        if src_mask is not None:
+            cross_mask = ((1.0 - src_mask.astype("float32")) * -1e9
+                          ).unsqueeze(1).unsqueeze(1)
+        memory = self.encode(src_ids, cross_mask)
+        return self.decode(tgt_ids, memory, cross_mask)
+
+    def loss(self, logits, labels, pad_id=0):
+        """Label-smoothed CE averaged over non-pad tokens (reference:
+        label_smooth + softmax_with_cross_entropy(soft_label=True))."""
+        vocab = logits.shape[-1]
+        soft = F.label_smooth(ops.one_hot(labels, vocab),
+                              epsilon=self.label_smooth_eps)
+        token_loss = ops.loss.softmax_with_cross_entropy(
+            logits, soft, soft_label=True)
+        mask = (labels != pad_id).astype("float32").unsqueeze(-1)
+        return (token_loss * mask).sum() / mask.sum()

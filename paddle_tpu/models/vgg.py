@@ -1,0 +1,52 @@
+"""VGG (reference: the Book image-classification chapter vgg_bn_drop /
+fluid tests vgg16)."""
+from __future__ import annotations
+
+from .. import nn
+
+_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512,
+         "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512,
+         512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(nn.Layer):
+    def __init__(self, depth=16, num_classes=1000, batch_norm=True,
+                 in_channels=3, image_size=224):
+        super().__init__()
+        layers = []
+        c = in_channels
+        for v in _CFGS[depth]:
+            if v == "M":
+                layers.append(nn.MaxPool2D(2, 2))
+            else:
+                layers.append(nn.Conv2D(c, v, 3, padding=1))
+                if batch_norm:
+                    layers.append(nn.BatchNorm2D(v))
+                layers.append(nn.ReLU())
+                c = v
+        self.features = nn.Sequential(*layers)
+        spatial = image_size // 32
+        self.classifier = nn.Sequential(
+            nn.Flatten(1),
+            nn.Linear(512 * spatial * spatial, 4096), nn.ReLU(),
+            nn.Dropout(0.5),
+            nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(0.5),
+            nn.Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        return self.classifier(self.features(x))
+
+
+def vgg16(num_classes=1000, **kw):
+    return VGG(16, num_classes, **kw)
+
+
+def vgg19(num_classes=1000, **kw):
+    return VGG(19, num_classes, **kw)

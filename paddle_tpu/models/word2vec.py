@@ -1,0 +1,39 @@
+"""word2vec skip-gram with negative sampling (reference: the Book word2vec
+chapter + fluid distributed word2vec example using nce/lookup_table)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn, ops
+from ..ops import nn_ops as F
+
+
+class SkipGram(nn.Layer):
+    def __init__(self, vocab_size=10000, embedding_dim=128, neg_num=5):
+        super().__init__()
+        self.emb_in = nn.Embedding(vocab_size, embedding_dim)
+        self.emb_out = nn.Embedding(vocab_size, embedding_dim)
+        self.neg_num = neg_num
+        self.vocab_size = vocab_size
+
+    def forward(self, center, target, label):
+        """center,target: [B] ids; label: [B] 1 for true pair, 0 for
+        negative (reference feeds pre-sampled negatives)."""
+        c = self.emb_in(center)
+        t = self.emb_out(target)
+        logit = (c * t).sum(axis=-1)
+        return ops.loss.binary_cross_entropy_with_logits(
+            logit, label.astype("float32"))
+
+    def train_batch_loss(self, center, context):
+        """Convenience: sample neg_num negatives uniformly per positive."""
+        b = center.shape[0]
+        neg = ops.randint(0, self.vocab_size, (b * self.neg_num,))
+        centers = ops.concat([center] * (1 + self.neg_num), axis=0)
+        targets = ops.concat([context, neg], axis=0)
+        labels = ops.concat([ops.ones((b,)), ops.zeros((b * self.neg_num,))],
+                            axis=0)
+        return self.forward(centers, targets, labels)
+
+
+Word2Vec = SkipGram

@@ -260,12 +260,13 @@ class LayerNorm(Layer):
     """reference: dygraph/nn.py:LayerNorm (fused kernel → XLA/Pallas)."""
 
     def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
-                 bias_attr=None):
+                 bias_attr=None, use_pallas=False):
         super().__init__()
         if isinstance(normalized_shape, int):
             normalized_shape = (normalized_shape,)
         self._normalized_shape = tuple(normalized_shape)
         self._epsilon = epsilon
+        self._use_pallas = use_pallas and len(self._normalized_shape) == 1
         if weight_attr is False:
             self.weight = None
         else:
@@ -279,6 +280,10 @@ class LayerNorm(Layer):
                                               attr=bias_attr, is_bias=True)
 
     def forward(self, x):
+        if self._use_pallas and self.weight is not None \
+                and self.bias is not None:
+            from ..ops.pallas.layer_norm import layer_norm as pallas_ln
+            return pallas_ln(x, self.weight, self.bias, self._epsilon)
         return F.layer_norm(x, self._normalized_shape, self.weight,
                             self.bias, self._epsilon)
 

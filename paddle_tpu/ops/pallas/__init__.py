@@ -1,0 +1,27 @@
+"""paddle_tpu.ops.pallas — hand-fused TPU kernels.
+
+TPU-native rebuild of the reference's fused CUDA kernels
+(reference: paddle/fluid/operators/fused/fused_elemwise_activation_op.cu,
+layer_norm_op.cu, softmax_with_cross_entropy_op.cu, optimizers/adam_op.cu
+multi-tensor path). Each kernel runs compiled on TPU and in interpret mode
+on CPU (tests), and exposes a custom VJP so the tape/jit path differentiates
+through it.
+"""
+import jax
+
+
+def interpret_mode():
+    """Pallas interpret=True off-TPU (CPU tests); compiled on TPU."""
+    return jax.default_backend() not in ("tpu",) and not any(
+        d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+from . import layer_norm as layer_norm_mod
+from . import softmax_xent as softmax_xent_mod
+from . import flash_attention as flash_attention_mod
+from . import fused_adam as fused_adam_mod
+
+from .layer_norm import layer_norm
+from .softmax_xent import softmax_cross_entropy
+from .flash_attention import flash_attention
+from .fused_adam import fused_adam_update

@@ -1,0 +1,156 @@
+"""Flash attention Pallas kernel (reference: the fused attention the
+reference approximates with fused_elemwise + softmax kernels; modern
+flash-style tiling is the TPU-native formulation).
+
+Forward: grid (batch*heads, q-blocks); for each q-block a fori_loop walks
+k/v-blocks with the online-softmax recurrence (running max m, normalizer l,
+accumulator acc in VMEM scratch) — attention never materializes the S×S
+matrix in HBM. Backward currently recomputes with the standard einsum
+formulation under XLA (documented trade-off; a full flash backward kernel
+is a later-round optimization).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, causal, scale,
+                block_q):
+    # q_ref: (1, BQ, D); k_ref/v_ref: (1, SK, D)
+    q = q_ref[0].astype(jnp.float32) * scale
+    qi = pl.program_id(1)
+
+    m0 = jnp.full((q.shape[0], 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((q.shape[0], 1), jnp.float32)
+    acc0 = jnp.zeros((q.shape[0], q_ref.shape[2]), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        # zero padded v rows: p is 0 there, but 0 * NaN-padding would
+        # still poison the accumulator
+        row_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)
+        v = jnp.where(row_pos < sk, v, 0.0)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        # mask keys past the true sequence end (tail block when
+        # sk % block_k != 0 reads padding)
+        s = jnp.where(k_pos < sk, s, -jnp.inf)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(p, v,
+                                       preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    nk = pl.cdiv(sk, block_k)
+    nk_needed = nk if not causal else jnp.minimum(
+        nk, pl.cdiv((qi + 1) * block_q, block_k))
+    m, l, acc = jax.lax.fori_loop(0, nk_needed, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    from . import interpret_mode
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    q3 = q.reshape(b * h, sq, d)
+    k3 = k.reshape(b * h, sk, d)
+    v3 = v.reshape(b * h, sk, d)
+    # pad K/V up to a block multiple: a manual pl.ds read past the end
+    # CLAMPS its start (dynamic-slice semantics) and would silently re-read
+    # earlier rows; the kernel masks positions >= true sk
+    sk_pad = -(-sk // bk) * bk
+    if sk_pad != sk:
+        padw = [(0, 0), (0, sk_pad - sk), (0, 0)]
+        k3 = jnp.pad(k3, padw)
+        v3 = jnp.pad(v3, padw)
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=bk, sk=sk, causal=causal,
+                          scale=s, block_q=bq),
+        grid=(b * h, pl.cdiv(sq, bq)),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret_mode(),
+    )(q3, k3, v3)
+    return out.reshape(b, h, sq, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k):
+    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, res, g):
+    # recompute-based backward (XLA): standard attention gradients
+    q, k, v = res
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+
+    def ref_attn(q, k, v):
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * s
+        if causal:
+            sq, sk = logits.shape[-2:]
+            mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+            logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+
+    _, vjp = jax.vjp(ref_attn, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_fwd, _bwd)
+
+
+def flash_attention(q, k, v, attn_mask=None, causal=False, scale=None,
+                    block_q=256, block_k=256, dropout_p=0.0, training=False,
+                    name=None):
+    """Framework op: flash attention over (B, H, S, D). attn_mask and
+    attention dropout are not fused — both fall back to plain sdpa so
+    behavior matches the unfused path exactly."""
+    from ...dispatch import apply
+    if attn_mask is not None or (dropout_p > 0.0 and training):
+        from ..nn_ops import scaled_dot_product_attention
+        return scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=causal, scale=scale,
+            dropout_p=dropout_p, training=training)
+
+    def impl(q, k, v):
+        return _flash(q, k, v, causal, scale, block_q, block_k)
+
+    return apply(impl, (q, k, v), name="pallas_flash_attention")

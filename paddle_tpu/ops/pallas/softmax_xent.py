@@ -1,0 +1,110 @@
+"""Fused softmax + cross-entropy Pallas kernel (reference: the fused CUDA
+softmax_with_cross_entropy_op.cu).
+
+One VMEM pass per row-block: row max, exp-sum, and the picked logit produce
+the loss directly — the [N, V] softmax matrix is never materialized in HBM
+on the forward pass. Backward recomputes softmax in-kernel and writes
+(p - onehot) * g, again one pass.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _block_rows(v):
+    target = 1 << 20
+    br = max(8, min(512, target // max(v, 1)))
+    return int(8 * max(1, br // 8))
+
+
+def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, v):
+    x = logits_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    lse = jnp.log(jnp.sum(e, axis=1, keepdims=True)) + m
+    labels = labels_ref[:]
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = cols == labels
+    picked = jnp.sum(jnp.where(onehot, x, 0.0), axis=1, keepdims=True)
+    loss_ref[:] = (lse - picked)
+
+
+def _bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref, *, v):
+    x = logits_ref[:].astype(jnp.float32)
+    m = jnp.max(x, axis=1, keepdims=True)
+    e = jnp.exp(x - m)
+    p = e / jnp.sum(e, axis=1, keepdims=True)
+    labels = labels_ref[:]
+    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (cols == labels).astype(jnp.float32)
+    dx_ref[:] = ((p - onehot) * g_ref[:]).astype(dx_ref.dtype)
+
+
+def _run(kernel, logits2, labels2, extra=None, out_shape=None):
+    from . import interpret_mode
+    n, v = logits2.shape
+    br = _block_rows(v)
+    grid = (pl.cdiv(n, br),)
+    in_specs = [
+        pl.BlockSpec((br, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+    ]
+    args = [logits2, labels2]
+    if extra is not None:
+        in_specs.append(pl.BlockSpec((br, 1), lambda i: (i, 0),
+                                     memory_space=pltpu.VMEM))
+        args.append(extra)
+    wide = out_shape[1] == v
+    return pl.pallas_call(
+        functools.partial(kernel, v=v),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((br, v) if wide else (br, 1),
+                               lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            out_shape, logits2.dtype if wide else jnp.float32),
+        interpret=interpret_mode(),
+    )(*args)
+
+
+@jax.custom_vjp
+def _softmax_xent2(logits2, labels2):
+    n, v = logits2.shape
+    return _run(_fwd_kernel, logits2, labels2, out_shape=(n, 1))
+
+
+def _fwd(logits2, labels2):
+    loss = _softmax_xent2(logits2, labels2)
+    return loss, (logits2, labels2)
+
+
+def _bwd(res, g):
+    logits2, labels2 = res
+    n, v = logits2.shape
+    dx = _run(_bwd_kernel, logits2, labels2, extra=g.astype(jnp.float32),
+              out_shape=(n, v))
+    return dx, None
+
+
+_softmax_xent2.defvjp(_fwd, _bwd)
+
+
+def softmax_cross_entropy(logits, label):
+    """Framework op: fused per-position softmax cross-entropy with hard
+    labels; returns loss with shape label.shape + (1,)."""
+    from ...dispatch import apply
+
+    def impl(logits, label):
+        v = logits.shape[-1]
+        lead = logits.shape[:-1]
+        l2 = logits.reshape(-1, v)
+        lab2 = label.reshape(-1, 1).astype(jnp.int32)
+        loss = _softmax_xent2(l2, lab2)
+        return loss.reshape(*lead, 1)
+
+    return apply(impl, (logits, label), name="pallas_softmax_xent")

@@ -308,12 +308,16 @@ class Adadelta(Optimizer):
 
 
 class Adam(Optimizer):
-    """reference: AdamOptimizer / adam_op.cc (incl. beta-pow accumulators)."""
+    """reference: AdamOptimizer / adam_op.cc (incl. beta-pow accumulators).
+    use_fused=True routes the update through the Pallas fused-adam kernel
+    (reference: the fused multi-tensor adam CUDA path)."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, parameters=None, lazy_mode=False, **kw):
+                 epsilon=1e-8, parameters=None, lazy_mode=False,
+                 use_fused=False, **kw):
         super().__init__(learning_rate, parameters, **kw)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._use_fused = use_fused
 
     def _pre_param(self, p):
         self._slot(p, "moment1")
@@ -325,6 +329,13 @@ class Adam(Optimizer):
         b1, b2, eps = self._beta1, self._beta2, self._eps
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2
+        if self._use_fused:
+            from ..ops.pallas.fused_adam import fused_adam_update
+            new_p, m, v = fused_adam_update(
+                p, g, slots["moment1"], slots["moment2"], lr, b1p, b2p,
+                beta1=b1, beta2=b2, eps=eps)
+            return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
+                           "beta2_pow": b2p}
         m = b1 * slots["moment1"] + (1 - b1) * g
         v = b2 * slots["moment2"] + (1 - b2) * g * g
         mhat = m / (1 - b1p)

@@ -9,3 +9,5 @@ from .env import ParallelEnv, prepare_context
 from . import fleet as fleet_mod
 from .fleet import fleet, DistributedStrategy, PaddleCloudRoleMaker, init
 from .data_parallel import DataParallel
+from .ring_attention import ring_attention
+from .embedding import ShardedEmbedding, sharded_lookup

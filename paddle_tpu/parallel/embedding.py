@@ -1,0 +1,72 @@
+"""paddle_tpu.parallel.embedding — mesh-sharded embedding tables.
+
+TPU-native redesign of the reference's parameter-server sparse tables
+(reference: fluid/incubate/fleet/parameter_server + distributed transpiler
+splitting lookup_table over pservers; operators/distributed lookup ops).
+
+A TPU pod has no parameter-server role, so the big table is *row-sharded
+over a mesh axis*:
+
+* **GSPMD path (default)**: the weight carries a NamedSharding of
+  P(axis, None). A plain gather inside a jitted step is partitioned by
+  XLA, which inserts the needed ICI collectives — zero manual code.
+* **shard_map path**: `sharded_lookup` does the classic mask-gather-psum
+  dance explicitly for code running inside shard_map (each device gathers
+  hits in its row range, others contribute zeros, one psum combines).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P, NamedSharding
+
+from ..tensor import Tensor
+from ..dispatch import apply
+from .. import nn
+from .. import initializer as I
+from . import collective
+
+
+class ShardedEmbedding(nn.Layer):
+    """Row-sharded embedding table (drop-in for nn.Embedding)."""
+
+    def __init__(self, num_embeddings, embedding_dim, axis_name="mp",
+                 weight_attr=None, mesh=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.axis_name = axis_name
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0 / np.sqrt(embedding_dim)))
+        mesh = mesh or collective.get_mesh()
+        if mesh is not None and axis_name in mesh.axis_names:
+            self.weight.data = jax.device_put(
+                self.weight.data, NamedSharding(mesh, P(axis_name, None)))
+
+    def forward(self, ids):
+        if collective.in_spmd_context(self.axis_name):
+            return sharded_lookup(ids, self.weight, self.axis_name)
+        # GSPMD path: plain gather; XLA partitions it over the sharded table
+        def impl(ids, w):
+            return jnp.take(w, ids, axis=0)
+        return apply(impl, (ids, self.weight), name="sharded_embedding")
+
+
+def sharded_lookup(ids, weight, axis_name="mp"):
+    """Explicit lookup for shard_map regions: `weight` is the LOCAL row
+    shard; out-of-range ids contribute zeros; one psum merges."""
+    def impl(ids, w):
+        n = lax.axis_size(axis_name)
+        r = lax.axis_index(axis_name)
+        rows = w.shape[0]
+        lo = r * rows
+        local = ids - lo
+        in_range = (local >= 0) & (local < rows)
+        safe = jnp.clip(local, 0, rows - 1)
+        out = jnp.take(w, safe, axis=0)
+        out = jnp.where(in_range[..., None], out, 0.0)
+        return lax.psum(out, axis_name)
+    return apply(impl, (ids, weight), name="c_sharded_lookup")

@@ -1,0 +1,83 @@
+"""paddle_tpu.parallel.ring_attention — sequence-parallel attention.
+
+Long-context attention over a sequence-sharded mesh axis (SURVEY §2 #30 —
+beyond the reference, required for TPU long-context parity). Each device
+holds a local [B, H, S/n, D] block of Q/K/V; K/V blocks rotate around the
+ICI ring via `lax.ppermute` while a flash-style online softmax accumulates
+(running max m, normalizer l, weighted sum acc), so the full S×S attention
+is computed with S/n-sized working sets and no all-gather of K/V.
+
+Use inside shard_map with the sequence axis bound, e.g.:
+
+    out = shard_map(lambda q,k,v: ring_attention(q,k,v,axis_name='sp'),
+                    mesh=mesh, in_specs=P(None,None,'sp',None), ...)
+
+Causal masking accounts for the global positions of rotating blocks.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor, as_tensor
+from ..dispatch import apply
+
+
+def _ring_attention_impl(q, k, v, axis_name, causal, scale):
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    sl = q.shape[-2]  # local seq block
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = me * sl + jnp.arange(sl)  # global positions of my queries
+
+    def block(carry, step):
+        m, l, acc, kb, vb = carry
+        src = (me - step) % n  # which global block this kb/vb came from
+        logits = jnp.einsum("...qd,...kd->...qk", q, kb) * s
+        if causal:
+            k_pos = src * sl + jnp.arange(sl)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            logits = jnp.where(mask, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # guard fully-masked rows (m_new = -inf): exp(-inf - -inf) -> nan
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(logits - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(logits), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("...qk,...kd->...qd",
+                                                     p, vb)
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (m_new, l_new, acc_new, kb, vb), None
+
+    # derive the initial carry from q so it inherits q's varying-axis type
+    # under shard_map (a plain jnp.zeros would be axis-invariant and fail
+    # lax.scan's carry type check)
+    m0 = jnp.full_like(q[..., 0], -jnp.inf)
+    l0 = jnp.zeros_like(q[..., 0])
+    acc0 = jnp.zeros_like(q)
+    (m, l, acc, _, _), _ = lax.scan(block, (m0, l0, acc0, k, v),
+                                    jnp.arange(n))
+    return acc / jnp.maximum(l, 1e-20)[..., None]
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                   name=None):
+    """Sequence-parallel attention (framework op: differentiable via the
+    tape like every other op). Outside an SPMD region it degrades to plain
+    attention (n=1 ring)."""
+    from . import collective
+    if not collective.in_spmd_context(axis_name):
+        # single-block fallback: ordinary attention
+        from ..ops.nn_ops import scaled_dot_product_attention
+        return scaled_dot_product_attention(q, k, v, is_causal=causal,
+                                            scale=scale, training=False)
+    return apply(_ring_attention_impl, (q, k, v),
+                 dict(axis_name=axis_name, causal=causal, scale=scale),
+                 name="ring_attention")

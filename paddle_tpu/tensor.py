@@ -172,13 +172,18 @@ class Tensor:
 
     # -- in-place-ish helpers (dygraph parity) ------------------------------
     def set_value(self, value):
-        """Overwrite the payload in place (reference: Variable.set_value)."""
+        """Overwrite the payload in place (reference: Variable.set_value).
+        Copies device arrays so the holder never aliases a buffer that a
+        donated compiled step may later invalidate."""
         if isinstance(value, Tensor):
             value = value.data
+        was_jax = isinstance(value, jax.Array)
         value = jnp.asarray(value, dtype=self.data.dtype)
         if tuple(value.shape) != tuple(self.data.shape):
             raise ValueError(
                 f"set_value shape mismatch: {value.shape} vs {self.data.shape}")
+        if was_jax and not isinstance(value, jax.core.Tracer):
+            value = jnp.array(value, copy=True)
         self.data = value
         return self
 

@@ -1,0 +1,171 @@
+"""Pallas fused kernels vs reference math (interpret mode on CPU,
+SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops.pallas import (layer_norm, softmax_cross_entropy,
+                                   flash_attention, fused_adam_update)
+
+
+def test_layer_norm_forward_matches():
+    x = np.random.randn(32, 128).astype("f4")
+    w = np.random.rand(128).astype("f4") + 0.5
+    b = np.random.randn(128).astype("f4")
+    out = layer_norm(pt.to_tensor(x), pt.to_tensor(w), pt.to_tensor(b))
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5) * w + b
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-4)
+
+
+def test_layer_norm_grad_matches_xla():
+    x = np.random.randn(16, 64).astype("f4")
+    w = np.random.rand(64).astype("f4") + 0.5
+    b = np.random.randn(64).astype("f4")
+
+    tx = pt.to_tensor(x, stop_gradient=False)
+    tw = pt.Parameter(w)
+    tb = pt.Parameter(b)
+    (layer_norm(tx, tw, tb) * pt.to_tensor(np.arange(64, dtype="f4"))
+     ).sum().backward()
+
+    tx2 = pt.to_tensor(x, stop_gradient=False)
+    tw2 = pt.Parameter(w)
+    tb2 = pt.Parameter(b)
+    from paddle_tpu.nn import functional as F
+    (F.layer_norm(tx2, 64, tw2, tb2) *
+     pt.to_tensor(np.arange(64, dtype="f4"))).sum().backward()
+
+    np.testing.assert_allclose(np.asarray(tx.grad), np.asarray(tx2.grad),
+                               atol=2e-3)
+    np.testing.assert_allclose(np.asarray(tw.grad), np.asarray(tw2.grad),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(np.asarray(tb.grad), np.asarray(tb2.grad),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_softmax_xent_matches_and_grads():
+    logits = np.random.randn(24, 50).astype("f4")
+    labels = np.random.randint(0, 50, (24,))
+
+    t = pt.to_tensor(logits, stop_gradient=False)
+    loss = softmax_cross_entropy(t, pt.to_tensor(labels))
+    lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) + \
+        logits.max(-1)
+    ref = lse - logits[np.arange(24), labels]
+    np.testing.assert_allclose(loss.numpy().ravel(), ref, atol=1e-4)
+
+    loss.mean().backward()
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    onehot = np.eye(50, dtype="f4")[labels]
+    ref_grad = (p - onehot) / 24
+    np.testing.assert_allclose(np.asarray(t.grad), ref_grad, atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_matches_sdpa(causal):
+    b, h, s, d = 2, 2, 64, 16
+    rng = np.random.RandomState(0)
+    q = rng.randn(b, h, s, d).astype("f4")
+    k = rng.randn(b, h, s, d).astype("f4")
+    v = rng.randn(b, h, s, d).astype("f4")
+    out = flash_attention(pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
+                          causal=causal, block_q=32, block_k=32)
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        mask = np.tril(np.ones((s, s), bool))
+        logits = np.where(mask, logits, -1e30)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-3)
+
+
+def test_flash_attention_backward():
+    b, h, s, d = 1, 2, 32, 8
+    rng = np.random.RandomState(1)
+    q = pt.to_tensor(rng.randn(b, h, s, d).astype("f4"), stop_gradient=False)
+    k = pt.to_tensor(rng.randn(b, h, s, d).astype("f4"), stop_gradient=False)
+    v = pt.to_tensor(rng.randn(b, h, s, d).astype("f4"), stop_gradient=False)
+    flash_attention(q, k, v, causal=True, block_q=16,
+                    block_k=16).sum().backward()
+    from paddle_tpu.nn import functional as F
+    q2 = pt.to_tensor(q.numpy(), stop_gradient=False)
+    k2 = pt.to_tensor(k.numpy(), stop_gradient=False)
+    v2 = pt.to_tensor(v.numpy(), stop_gradient=False)
+    F.scaled_dot_product_attention(q2, k2, v2,
+                                   is_causal=True).sum().backward()
+    np.testing.assert_allclose(np.asarray(q.grad), np.asarray(q2.grad),
+                               atol=3e-3)
+    np.testing.assert_allclose(np.asarray(k.grad), np.asarray(k2.grad),
+                               atol=3e-3)
+    np.testing.assert_allclose(np.asarray(v.grad), np.asarray(v2.grad),
+                               atol=3e-3)
+
+
+def test_fused_adam_matches_rule():
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    p = rng.randn(37, 5).astype("f4")  # deliberately unaligned size
+    g = rng.randn(37, 5).astype("f4")
+    m = np.zeros_like(p)
+    v = np.zeros_like(p)
+    lr, b1, b2, eps = 0.01, 0.9, 0.999, 1e-8
+    b1p, b2p = b1, b2
+    new_p, new_m, new_v = fused_adam_update(
+        jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v),
+        lr, b1p, b2p, beta1=b1, beta2=b2, eps=eps)
+    m_ref = (1 - b1) * g
+    v_ref = (1 - b2) * g * g
+    p_ref = p - lr * (m_ref / (1 - b1p)) / (
+        np.sqrt(v_ref / (1 - b2p)) + eps)
+    np.testing.assert_allclose(np.asarray(new_p), p_ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_m), m_ref, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_v), v_ref, atol=1e-6)
+
+
+def test_fused_adam_in_optimizer():
+    from paddle_tpu import optimizer as opt
+    w1 = pt.Parameter(np.ones((8, 4), "f4"))
+    w2 = pt.Parameter(np.ones((8, 4), "f4"))
+    o1 = opt.Adam(learning_rate=0.1, parameters=[w1], use_fused=True)
+    o2 = opt.Adam(learning_rate=0.1, parameters=[w2])
+    for o, w in ((o1, w1), (o2, w2)):
+        (w * w).sum().backward()
+        o.step()
+        o.clear_grad()
+    np.testing.assert_allclose(w1.numpy(), w2.numpy(), atol=1e-5)
+
+
+def test_pallas_layer_norm_layer_flag():
+    from paddle_tpu import nn
+    ln = nn.LayerNorm(32, use_pallas=True)
+    x = pt.to_tensor(np.random.randn(4, 32).astype("f4"))
+    out = ln(x)
+    o = out.numpy()
+    np.testing.assert_allclose(o.mean(-1), 0.0, atol=1e-4)
+
+
+def test_flash_attention_unaligned_seq():
+    """Regression: tail K/V block must not be dropped (seq % block_k != 0)."""
+    b, h, s, d = 1, 2, 40, 16
+    rng = np.random.RandomState(3)
+    q = rng.randn(b, h, s, d).astype("f4")
+    k = rng.randn(b, h, s, d).astype("f4")
+    v = rng.randn(b, h, s, d).astype("f4")
+    out = flash_attention(pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
+                          block_q=32, block_k=32)
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-3)
+
+
+def test_flash_attention_dropout_falls_back():
+    b, h, s, d = 1, 1, 16, 8
+    q = pt.to_tensor(np.random.randn(b, h, s, d).astype("f4"))
+    out = flash_attention(q, q, q, dropout_p=0.5, training=True)
+    assert out.shape == [b, h, s, d]
