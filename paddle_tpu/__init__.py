@@ -44,6 +44,8 @@ from . import parallel
 from . import distributed
 from . import models
 from . import utils
+from . import inference
+from . import fluid
 
 # dygraph/static mode management (reference: fluid.enable_dygraph /
 # paddle.enable_static). Dygraph is the default here (modern surface).

@@ -1,0 +1,293 @@
+// paddle_tpu native host runtime core.
+//
+// TPU-native rebuild of the reference's C++ host-side memory + input
+// pipeline (reference: paddle/fluid/memory/detail/buddy_allocator.cc +
+// allocation/auto_growth_best_fit_allocator.cc for the arena;
+// paddle/fluid/operators/reader/buffered_reader.cc + fluid/framework/
+// data_feed.cc for the threaded feeding pipeline).
+//
+// On TPU, device memory belongs to XLA's arena, so the native runtime's
+// job is the HOST side: a pinned, aligned arena for staging batches, and a
+// background-thread batcher that shuffles + assembles contiguous batches
+// off the GIL so the Python step loop never blocks on memcpy.
+//
+// Built as libpaddle_tpu_core.so (plain C ABI, driven via ctypes — the
+// reference used pybind11; ctypes keeps the build dependency-free).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// arena allocator: bump allocator over one big aligned region with reset
+// semantics (the reference's auto-growth allocator reduced to the staging
+// use-case: per-step transient host buffers).
+
+struct Arena {
+  char* base;
+  size_t capacity;
+  std::atomic<size_t> offset;
+  std::atomic<size_t> peak;
+};
+
+void* ptc_arena_create(size_t bytes) {
+  Arena* a = new Arena();
+  if (posix_memalign(reinterpret_cast<void**>(&a->base), 4096, bytes) != 0) {
+    delete a;
+    return nullptr;
+  }
+  a->capacity = bytes;
+  a->offset.store(0);
+  a->peak.store(0);
+  return a;
+}
+
+void ptc_arena_destroy(void* arena) {
+  Arena* a = static_cast<Arena*>(arena);
+  if (a == nullptr) return;
+  free(a->base);
+  delete a;
+}
+
+void* ptc_arena_alloc(void* arena, size_t bytes, size_t align) {
+  Arena* a = static_cast<Arena*>(arena);
+  if (align == 0) align = 64;
+  size_t cur, aligned, next;
+  do {
+    cur = a->offset.load(std::memory_order_relaxed);
+    aligned = (cur + align - 1) & ~(align - 1);
+    next = aligned + bytes;
+    if (next > a->capacity) return nullptr;
+  } while (!a->offset.compare_exchange_weak(cur, next));
+  size_t prev_peak = a->peak.load(std::memory_order_relaxed);
+  while (next > prev_peak &&
+         !a->peak.compare_exchange_weak(prev_peak, next)) {
+  }
+  return a->base + aligned;
+}
+
+void ptc_arena_reset(void* arena) {
+  static_cast<Arena*>(arena)->offset.store(0);
+}
+
+size_t ptc_arena_used(void* arena) {
+  return static_cast<Arena*>(arena)->offset.load();
+}
+
+size_t ptc_arena_peak(void* arena) {
+  return static_cast<Arena*>(arena)->peak.load();
+}
+
+// ---------------------------------------------------------------------------
+// multithreaded row gather: dst[i] = src[idx[i]] for row-major tables.
+
+void ptc_gather_rows(const char* src, size_t row_bytes, const int64_t* idx,
+                     size_t n_idx, char* dst, int n_threads) {
+  if (n_threads <= 1 || n_idx < 1024) {
+    for (size_t i = 0; i < n_idx; ++i) {
+      memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+    }
+    return;
+  }
+  std::vector<std::thread> threads;
+  size_t chunk = (n_idx + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    size_t lo = t * chunk;
+    size_t hi = lo + chunk < n_idx ? lo + chunk : n_idx;
+    if (lo >= hi) break;
+    threads.emplace_back([=]() {
+      for (size_t i = lo; i < hi; ++i) {
+        memcpy(dst + i * row_bytes, src + idx[i] * row_bytes, row_bytes);
+      }
+    });
+  }
+  for (auto& t : threads) t.join();
+}
+
+// ---------------------------------------------------------------------------
+// batcher: background thread shuffles indices (Fisher-Yates over a
+// xoshiro256** stream) and assembles batches for every feature array into
+// slot buffers; the consumer pops finished slots from a bounded queue.
+
+struct Slot {
+  std::vector<char*> buffers;  // one per feature array
+  size_t rows;
+};
+
+struct Batcher {
+  std::vector<const char*> arrays;
+  std::vector<size_t> row_bytes;
+  size_t n_rows;
+  size_t batch;
+  bool shuffle;
+  bool drop_last;
+  uint64_t seed;
+  uint64_t epoch;
+
+  std::vector<Slot> slots;
+  std::queue<int> free_q;
+  std::queue<int> ready_q;
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+  std::thread worker;
+  std::atomic<bool> stop;
+  std::atomic<bool> epoch_done;
+  std::vector<int64_t> perm;
+
+  ~Batcher() {
+    stop.store(true);
+    cv_free.notify_all();
+    if (worker.joinable()) worker.join();
+    for (auto& s : slots)
+      for (auto* b : s.buffers) free(b);
+  }
+};
+
+static uint64_t splitmix64(uint64_t& x) {
+  uint64_t z = (x += 0x9E3779B97f4A7C15ULL);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+  return z ^ (z >> 31);
+}
+
+static void fill_perm(Batcher* b) {
+  b->perm.resize(b->n_rows);
+  for (size_t i = 0; i < b->n_rows; ++i) b->perm[i] = (int64_t)i;
+  if (b->shuffle) {
+    uint64_t s = b->seed + 0x9E3779B97f4A7C15ULL * (b->epoch + 1);
+    for (size_t i = b->n_rows - 1; i > 0; --i) {
+      size_t j = splitmix64(s) % (i + 1);
+      std::swap(b->perm[i], b->perm[j]);
+    }
+  }
+}
+
+static void worker_loop(Batcher* b) {
+  fill_perm(b);
+  size_t n_batches =
+      b->drop_last ? b->n_rows / b->batch
+                   : (b->n_rows + b->batch - 1) / b->batch;
+  for (size_t bi = 0; bi < n_batches && !b->stop.load(); ++bi) {
+    int slot_id;
+    {
+      std::unique_lock<std::mutex> lk(b->mu);
+      b->cv_free.wait(lk, [&] { return !b->free_q.empty() || b->stop; });
+      if (b->stop.load()) return;
+      slot_id = b->free_q.front();
+      b->free_q.pop();
+    }
+    Slot& s = b->slots[slot_id];
+    size_t lo = bi * b->batch;
+    size_t hi = lo + b->batch < b->n_rows ? lo + b->batch : b->n_rows;
+    s.rows = hi - lo;
+    for (size_t ai = 0; ai < b->arrays.size(); ++ai) {
+      char* dst = s.buffers[ai];
+      const char* src = b->arrays[ai];
+      size_t rb = b->row_bytes[ai];
+      for (size_t r = 0; r < s.rows; ++r) {
+        memcpy(dst + r * rb, src + b->perm[lo + r] * rb, rb);
+      }
+    }
+    {
+      std::lock_guard<std::mutex> lk(b->mu);
+      b->ready_q.push(slot_id);
+    }
+    b->cv_ready.notify_one();
+  }
+  {
+    std::lock_guard<std::mutex> lk(b->mu);
+    b->ready_q.push(-1);  // end-of-epoch sentinel
+  }
+  b->cv_ready.notify_one();
+}
+
+void* ptc_batcher_create(const void** arrays, const size_t* row_bytes,
+                         int n_arrays, size_t n_rows, size_t batch_size,
+                         int shuffle, int drop_last, uint64_t seed,
+                         int prefetch_slots) {
+  Batcher* b = new Batcher();
+  for (int i = 0; i < n_arrays; ++i) {
+    b->arrays.push_back(static_cast<const char*>(arrays[i]));
+    b->row_bytes.push_back(row_bytes[i]);
+  }
+  b->n_rows = n_rows;
+  b->batch = batch_size;
+  b->shuffle = shuffle != 0;
+  b->drop_last = drop_last != 0;
+  b->seed = seed;
+  b->epoch = 0;
+  b->stop.store(false);
+  if (prefetch_slots < 2) prefetch_slots = 2;
+  b->slots.resize(prefetch_slots);
+  for (int s = 0; s < prefetch_slots; ++s) {
+    for (int i = 0; i < n_arrays; ++i) {
+      char* buf;
+      if (posix_memalign(reinterpret_cast<void**>(&buf), 4096,
+                         batch_size * row_bytes[i]) != 0) {
+        delete b;
+        return nullptr;
+      }
+      b->slots[s].buffers.push_back(buf);
+    }
+    b->free_q.push(s);
+  }
+  b->worker = std::thread(worker_loop, b);
+  return b;
+}
+
+// Returns slot id >= 0 with per-array pointers in out_ptrs and row count
+// in out_rows; returns -1 at end of epoch.
+int ptc_batcher_next(void* batcher, void** out_ptrs, size_t* out_rows) {
+  Batcher* b = static_cast<Batcher*>(batcher);
+  int slot_id;
+  {
+    std::unique_lock<std::mutex> lk(b->mu);
+    b->cv_ready.wait(lk, [&] { return !b->ready_q.empty(); });
+    slot_id = b->ready_q.front();
+    b->ready_q.pop();
+  }
+  if (slot_id < 0) return -1;
+  Slot& s = b->slots[slot_id];
+  for (size_t i = 0; i < s.buffers.size(); ++i) out_ptrs[i] = s.buffers[i];
+  *out_rows = s.rows;
+  return slot_id;
+}
+
+void ptc_batcher_release(void* batcher, int slot_id) {
+  Batcher* b = static_cast<Batcher*>(batcher);
+  {
+    std::lock_guard<std::mutex> lk(b->mu);
+    b->free_q.push(slot_id);
+  }
+  b->cv_free.notify_one();
+}
+
+void ptc_batcher_new_epoch(void* batcher) {
+  Batcher* b = static_cast<Batcher*>(batcher);
+  if (b->worker.joinable()) b->worker.join();
+  b->epoch += 1;
+  // drain queues back to a clean state
+  {
+    std::lock_guard<std::mutex> lk(b->mu);
+    while (!b->ready_q.empty()) {
+      int s = b->ready_q.front();
+      b->ready_q.pop();
+      if (s >= 0) b->free_q.push(s);
+    }
+  }
+  b->worker = std::thread(worker_loop, b);
+}
+
+void ptc_batcher_destroy(void* batcher) {
+  delete static_cast<Batcher*>(batcher);
+}
+
+}  // extern "C"

@@ -1,0 +1,47 @@
+"""paddle_tpu.distributed.launch — multi-host launch CLI.
+
+TPU-native rebuild of reference python/paddle/distributed/launch.py. The
+reference spawns one worker process per GPU and wires NCCL endpoints; on a
+TPU pod each HOST runs one process that owns its local chips, so launch
+degenerates to: set the coordinator env, call jax.distributed.initialize,
+exec the training script. Usage:
+
+    python -m paddle_tpu.distributed.launch \
+        --coordinator 10.0.0.1:8476 --num_hosts 4 --host_id 0 train.py ...
+
+Single-host (the common case, incl. this repo's CI): just runs the script.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--coordinator", default=None,
+                   help="coordinator address host:port (multi-host)")
+    p.add_argument("--num_hosts", type=int, default=1)
+    p.add_argument("--host_id", type=int, default=None)
+    p.add_argument("script", help="training script to run")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.coordinator and args.num_hosts > 1:
+        os.environ["COORDINATOR_ADDRESS"] = args.coordinator
+        os.environ["PADDLE_TRAINERS_NUM"] = str(args.num_hosts)
+        if args.host_id is not None:
+            os.environ["PADDLE_TRAINER_ID"] = str(args.host_id)
+        from . import init_parallel_env
+        init_parallel_env()
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""paddle_tpu.fluid — reference-API compatibility namespace.
+
+Mirrors the `paddle.fluid` surface of the reference (python/paddle/fluid/
+__init__.py) so code written against it ports with an import swap:
+Program/Executor/program_guard, fluid.data, fluid.layers.*, fluid.dygraph.*,
+optimizer/initializer/regularizer/clip/metrics, CPUPlace/CUDAPlace.
+
+The implementations are the TPU-native ones — this module only re-shapes
+the API.
+"""
+from __future__ import annotations
+
+from ..static import (Program, Executor, program_guard, data,
+                      default_main_program, default_startup_program,
+                      CompiledProgram, ParallelExecutor, BuildStrategy,
+                      ExecutionStrategy, global_scope, name_scope,
+                      append_backward)
+from ..device import CPUPlace, CUDAPlace, TPUPlace
+from ..param_attr import ParamAttr, WeightNormParamAttr
+from .. import initializer
+from .. import regularizer
+from .. import clip
+from .. import optimizer
+from .. import metric as metrics
+from .. import io
+from ..tensor import Tensor
+from ..static import enable_static, disable_static
+from . import layers
+from . import dygraph
+
+
+class Variable(Tensor):
+    """Alias for parity with fluid.framework.Variable."""
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield scope
+    return guard()
+
+
+def memory_optimize(program=None, **kw):
+    """reference: transpiler memory_optimize — XLA buffer assignment +
+    donation already performs this; no-op kept for parity."""
+
+
+def release_memory(program=None, **kw):
+    pass
+
+
+def set_flags(flags):
+    """reference: fluid.set_flags (FLAGS_*) — map the known ones."""
+    import jax
+    for k, v in (flags or {}).items():
+        if k == "FLAGS_check_nan_inf":
+            jax.config.update("jax_debug_nans", bool(v))
+
+
+def is_compiled_with_cuda():
+    from ..device import is_compiled_with_cuda as f
+    return f()
+
+
+def cuda_places(device_ids=None):
+    import jax
+    devs = jax.devices()
+    ids = device_ids if device_ids is not None else range(len(devs))
+    return [TPUPlace(i) for i in ids]
+
+
+def cpu_places(device_count=1):
+    return [CPUPlace() for _ in range(device_count)]

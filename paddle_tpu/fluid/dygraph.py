@@ -1,0 +1,46 @@
+"""paddle_tpu.fluid.dygraph — fluid.dygraph compatibility surface.
+
+Mirrors reference python/paddle/fluid/dygraph/__init__.py: guard,
+to_variable, Layer + the layer zoo, no_grad, TracedLayer, save/load,
+DataParallel, to_static.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..tensor import Tensor, Parameter
+from ..nn import (Layer, Sequential, LayerList, ParameterList, Linear,
+                  Conv2D, Conv2DTranspose, Conv3D, Pool2D, BatchNorm,
+                  LayerNorm, GroupNorm, InstanceNorm2D, SpectralNorm,
+                  Embedding, Dropout, PRelu, BilinearTensorProduct, GRUUnit)
+from ..autograd import no_grad
+from ..jit import to_static, TracedLayer
+from ..io import save_dygraph, load_dygraph
+from ..parallel import DataParallel
+from ..parallel.env import ParallelEnv, prepare_context
+from ..optimizer import lr as learning_rate_scheduler  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """reference: fluid.dygraph.guard — dygraph is this framework's
+    default mode; the guard just ensures static mode is off inside."""
+    from .. import static as _static
+    was_static = _static.in_static_mode()
+    if was_static:
+        _static.disable_static()
+    try:
+        yield
+    finally:
+        if was_static:
+            _static.enable_static()
+
+
+def to_variable(value, name=None, zero_copy=None):
+    """reference: dygraph/base.py:to_variable."""
+    return Tensor(value, stop_gradient=True, name=name)
+
+
+def enabled():
+    from .. import static as _static
+    return not _static.in_static_mode()

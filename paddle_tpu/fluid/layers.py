@@ -1,0 +1,238 @@
+"""paddle_tpu.fluid.layers — the fluid.layers functional surface.
+
+Mirrors reference python/paddle/fluid/layers/{nn,tensor,ops,loss,
+control_flow}.py. Param-creating functions (fc, conv2d, batch_norm,
+embedding, ...) follow the reference's LayerHelper pattern: parameters are
+created on first call and recorded into the active static Program (these
+are primarily for static-graph code; dygraph code uses paddle_tpu.nn Layer
+classes, as in the reference).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor, Parameter, convert_dtype
+from .. import ops
+from ..ops import nn_ops as F
+from ..ops import loss as L
+from .. import initializer as I
+from ..param_attr import ParamAttr
+from ..static import data  # noqa: F401 (fluid.layers.data parity)
+from ..ops.control_flow import cond, while_loop, case, switch_case  # noqa
+from .. import metric as _metric
+
+# re-export the whole functional op surface
+from ..ops.math import *  # noqa: F401,F403
+from ..ops.manip import *  # noqa: F401,F403
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.nn_ops import *  # noqa: F401,F403
+from ..ops.loss import (softmax_with_cross_entropy,  # noqa: F401
+                        sigmoid_cross_entropy_with_logits,
+                        square_error_cost, huber_loss, kl_div, log_loss,
+                        rank_loss, margin_ranking_loss, bpr_loss,
+                        hinge_loss, smooth_l1_loss)
+
+reduce_sum = ops.sum
+reduce_mean = ops.mean
+reduce_max = ops.max
+reduce_min = ops.min
+reduce_prod = ops.prod
+elementwise_add = ops.add
+elementwise_sub = ops.subtract
+elementwise_mul = ops.multiply
+elementwise_div = ops.divide
+fill_constant = ops.full
+
+
+def _act(x, act):
+    if act is None:
+        return x
+    return getattr(F, act)(x)
+
+
+def _param(attr, shape, dtype, default_init, is_bias=False):
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = None
+    if isinstance(attr, ParamAttr) and isinstance(attr.initializer,
+                                                  I.Initializer):
+        init = attr.initializer
+    init = init or default_init
+    p = Parameter(init(shape, convert_dtype(dtype)),
+                  name=attr.name if isinstance(attr, ParamAttr) else None)
+    if isinstance(attr, ParamAttr):
+        p.regularizer = attr.regularizer
+        if not attr.trainable:
+            p.stop_gradient = True
+            p.trainable = False
+    return p
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """reference: layers/nn.py:fc."""
+    in_dim = int(np.prod(input.shape[num_flatten_dims:]))
+    w = _param(param_attr, (in_dim, size), "float32", I.XavierUniform())
+    b = _param(bias_attr, (size,), "float32", I.Constant(0.0), is_bias=True)
+    lead = tuple(-1 if (d is None or d < 0) else d
+                 for d in input.shape[:num_flatten_dims])
+    x = input if len(input.shape) == num_flatten_dims + 1 else ops.reshape(
+        input, lead + (in_dim,))
+    out = F.linear(x, w, b)
+    return _act(out, act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """reference: layers/nn.py:embedding."""
+    w = _param(param_attr, tuple(size), dtype,
+               I.Normal(0.0, 1.0 / np.sqrt(size[1])))
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=1, param_attr=None, bias_attr=None, act=None,
+           data_format="NCHW", name=None):
+    """reference: layers/nn.py:conv2d."""
+    ks = F._pair(filter_size, 2)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    fan_in = cin * ks[0] * ks[1] // groups
+    w = _param(param_attr, (num_filters, cin // groups, ks[0], ks[1]),
+               "float32", I.Normal(0.0, float(np.sqrt(2.0 / fan_in))))
+    b = _param(bias_attr, (num_filters,), "float32", I.Constant(0.0),
+               is_bias=True)
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    return _act(out, act)
+
+
+_bn_counter = [0]
+_bn_stats = {}
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None):
+    """reference: layers/nn.py:batch_norm. Running stats are persistable
+    Tensors registered with the program's param store (non-trainable)."""
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = _param(param_attr, (c,), "float32", I.Constant(1.0))
+    b = _param(bias_attr, (c,), "float32", I.Constant(0.0), is_bias=True)
+    _bn_counter[0] += 1
+    key = name or f"bn_{_bn_counter[0]}"
+    if key not in _bn_stats:
+        import jax.numpy as jnp
+        rm = Parameter(jnp.zeros((c,)), name=key + "_mean", trainable=False)
+        rv = Parameter(jnp.ones((c,)), name=key + "_var", trainable=False)
+        _bn_stats[key] = (rm, rv)
+    rm, rv = _bn_stats[key]
+    out, new_rm, new_rv = F.batch_norm(
+        input, rm, rv, w, b, training=not is_test, momentum=momentum,
+        epsilon=epsilon, data_format=data_layout)
+    if not is_test and not hasattr(out, "program"):
+        rm.data, rv.data = new_rm.data, new_rv.data
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference: layers/nn.py:layer_norm."""
+    shape = tuple(input.shape[begin_norm_axis:])
+    w = _param(param_attr, shape, "float32", I.Constant(1.0)) if scale \
+        else None
+    b = _param(bias_attr, shape, "float32", I.Constant(0.0), is_bias=True) \
+        if shift else None
+    out = F.layer_norm(input, shape, w, b, epsilon)
+    return _act(out, act)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """reference: layers/loss.py:cross_entropy — input is PROBABILITIES
+    (post-softmax), per the fluid-era semantics."""
+    return L.cross_entropy(input, label, soft_label=soft_label,
+                           ignore_index=ignore_index, use_softmax=False,
+                           reduction="none")
+
+
+def mean(x, name=None):
+    return ops.mean(x)
+
+
+def accuracy(input, label, k=1):
+    """reference: layers/metric_op.py:accuracy (works eagerly and in
+    static graphs via the op path)."""
+    from ..ops.math import accuracy_top1
+    if k == 1:
+        return accuracy_top1(input, label)
+    def impl(pred, lbl):
+        import jax.numpy as jnp
+        import jax
+        topk_idx = jax.lax.top_k(pred, k)[1]
+        return jnp.mean(jnp.any(
+            topk_idx == lbl.reshape(-1, 1), axis=-1).astype(jnp.float32))
+    from ..dispatch import apply
+    return apply(impl, (input, label), nondiff=True, name="accuracy")
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, data_format="NCHW",
+           name=None):
+    return F.pool2d(input, pool_size, pool_type, pool_stride, pool_padding,
+                    global_pooling, data_format)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None,
+            dropout_implementation="downgrade_in_infer", name=None):
+    """reference: layers/nn.py:dropout."""
+    mode = ("upscale_in_train"
+            if dropout_implementation == "upscale_in_train"
+            else "downscale_in_infer")
+    return F.dropout(x, p=dropout_prob, training=not is_test, mode=mode)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """reference: sequence_mask op — [B] lengths -> [B, maxlen] mask.
+    maxlen=None derives it from the data, which requires a concrete tensor
+    (XLA needs static shapes): under jit/static tracing pass maxlen."""
+    from ..dispatch import apply
+    import jax
+    import jax.numpy as jnp
+    dt = convert_dtype(dtype)
+    if maxlen is None:
+        from ..tensor import as_tensor
+        data = as_tensor(x).data
+        if data is None or isinstance(data, jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) needs a concrete lengths "
+                "tensor; pass an explicit maxlen under jit/static mode "
+                "(output shape must be static on TPU)")
+        maxlen = int(np.asarray(jax.device_get(data)).max())
+
+    def impl(lengths, maxlen):
+        rng = jnp.arange(maxlen)
+        return (rng[None, :] < lengths[:, None]).astype(dt)
+    return apply(impl, (x,), dict(maxlen=maxlen), nondiff=True,
+                 name="sequence_mask")
+
+
+def softmax(input, axis=-1, name=None):
+    return F.softmax(input, axis=axis)
+
+
+def relu(x, name=None):
+    return F.relu(x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, alpha=1.0,
+           name=None):
+    return ops.matmul(x, y, transpose_x, transpose_y, alpha)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """reference: mul_op — flatten then matmul."""
+    xf = ops.reshape(x, (int(np.prod(x.shape[:x_num_col_dims])), -1))
+    yf = ops.reshape(y, (int(np.prod(y.shape[:y_num_col_dims])), -1))
+    return ops.matmul(xf, yf)

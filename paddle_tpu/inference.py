@@ -1,0 +1,107 @@
+"""paddle_tpu.inference — the inference engine.
+
+TPU-native rebuild of the reference's inference stack
+(reference: paddle/fluid/inference/api/analysis_predictor.cc +
+paddle_inference_api.h; TensorRT subgraph pass). On TPU the optimizing
+compiler IS XLA: a Predictor functionalizes the saved Layer and AOT-
+compiles `jit(...).lower().compile()` per input signature — the analogue
+of the reference's analysis passes + engine build, with bf16 as the
+TensorRT-precision analogue.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor
+from .nn.layer import Layer, functional_call, state_pytree
+
+
+class Config:
+    """reference: AnalysisConfig — precision / model path knobs."""
+
+    def __init__(self, model_path=None):
+        self.model_path = model_path
+        self.precision = "float32"   # or "bfloat16"
+        self.donate_inputs = False
+
+    def enable_bf16(self):
+        self.precision = "bfloat16"
+        return self
+
+
+class Predictor:
+    """reference: AnalysisPredictor. Wraps an eval-mode Layer; each input
+    signature is lowered + compiled once (AOT) and cached."""
+
+    def __init__(self, model_or_config, config=None):
+        if isinstance(model_or_config, Config):
+            config = model_or_config
+            from . import io as pio
+            model = pio.load_inference_model(config.model_path)
+        else:
+            model = model_or_config
+        self.config = config or Config()
+        self.model = model.eval()
+        self.state = state_pytree(model)
+        if self.config.precision == "bfloat16":
+            self.state = {k: (v.astype(jnp.bfloat16)
+                              if jnp.issubdtype(v.dtype, jnp.floating)
+                              else v)
+                          for k, v in self.state.items()}
+        self._compiled = {}
+
+    def _signature(self, args):
+        return tuple((a.shape, str(a.dtype)) for a in args)
+
+    def run(self, *inputs):
+        """Run inference; inputs are numpy arrays / Tensors. Returns
+        numpy outputs (list when the model returns several)."""
+        arrays = []
+        for x in inputs:
+            if isinstance(x, Tensor):
+                x = x.data
+            arrays.append(jnp.asarray(x))
+        key = self._signature(arrays)
+        if key not in self._compiled:
+            self._compiled[key] = self._build(arrays)
+        out = self._compiled[key](self.state, *arrays)
+        if isinstance(out, (tuple, list)):
+            return [np.asarray(jax.device_get(o)) for o in out]
+        return np.asarray(jax.device_get(out))
+
+    def _build(self, arrays):
+        model = self.model
+
+        def fn(state, *xs):
+            from . import autograd as _ag
+            with _ag.no_grad():
+                out, _ = functional_call(model, state,
+                                         *[Tensor(x) for x in xs])
+            flat, tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda t: isinstance(t, Tensor))
+            arr = [t.data if isinstance(t, Tensor) else t for t in flat]
+            return tuple(arr) if len(arr) > 1 else arr[0]
+
+        # AOT: lower + compile now, not on first call
+        lowered = jax.jit(fn).lower(self.state, *arrays)
+        return lowered.compile()
+
+    def compile_report(self, *inputs):
+        """Expose the compiled executable's cost analysis (profiling aid)."""
+        arrays = [jnp.asarray(x.data if isinstance(x, Tensor) else x)
+                  for x in inputs]
+        key = self._signature(arrays)
+        if key not in self._compiled:
+            self._compiled[key] = self._build(arrays)
+        exe = self._compiled[key]
+        try:
+            return exe.cost_analysis()
+        except Exception:
+            return {}
+
+
+def create_predictor(config):
+    """reference: paddle_infer.create_predictor."""
+    return Predictor(config)

@@ -262,10 +262,17 @@ class DataLoader:
         self.prefetch = max(1, prefetch_factor)
         self.num_workers = num_workers
         self._native = None
+        self._native_epoch = None
         if use_native and isinstance(dataset, TensorDataset):
             try:
                 from .native import NativeBatcher
                 self._native = NativeBatcher(dataset.arrays)
+                if collate_fn is None and batch_sampler is None:
+                    # full native path: C++ worker shuffles + assembles
+                    self._native_epoch = NativeBatcher(
+                        dataset.arrays, batch_size=batch_size,
+                        shuffle=shuffle, drop_last=drop_last,
+                        seed=seed or 0)
             except Exception:
                 self._native = None
 
@@ -284,6 +291,9 @@ class DataLoader:
             q.put(_WorkerError(e))
 
     def __iter__(self):
+        if self._native_epoch is not None:
+            yield from self._native_epoch
+            return
         if self.num_workers == 0 and self.prefetch <= 1:
             for idx in self.batch_sampler:
                 if self._native is not None:

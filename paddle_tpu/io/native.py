@@ -1,0 +1,196 @@
+"""paddle_tpu.io.native — ctypes bindings to the C++ host runtime core.
+
+TPU-native rebuild of the reference's C++ feeding pipeline bindings
+(reference: paddle/fluid/pybind/reader_py.cc over buffered_reader.cc; here
+ctypes over paddle_tpu/csrc/core.cpp — see that file for the design).
+
+The library auto-builds on first import (g++, no external deps); failures
+degrade gracefully to the pure-Python DataLoader path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), "..", "csrc")
+_LIB_PATH = os.path.join(_DIR, "libpaddle_tpu_core.so")
+_lib = None
+
+
+def _build():
+    subprocess.run(["make", "-s", "-C", _DIR], check=True,
+                   capture_output=True)
+
+
+def get_lib():
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) <
+            os.path.getmtime(os.path.join(_DIR, "core.cpp"))):
+        _build()
+    lib = ctypes.CDLL(_LIB_PATH)
+    lib.ptc_arena_create.restype = ctypes.c_void_p
+    lib.ptc_arena_create.argtypes = [ctypes.c_size_t]
+    lib.ptc_arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptc_arena_alloc.restype = ctypes.c_void_p
+    lib.ptc_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_size_t,
+                                    ctypes.c_size_t]
+    lib.ptc_arena_reset.argtypes = [ctypes.c_void_p]
+    lib.ptc_arena_used.restype = ctypes.c_size_t
+    lib.ptc_arena_used.argtypes = [ctypes.c_void_p]
+    lib.ptc_arena_peak.restype = ctypes.c_size_t
+    lib.ptc_arena_peak.argtypes = [ctypes.c_void_p]
+    lib.ptc_gather_rows.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_size_t, ctypes.c_void_p,
+        ctypes.c_int]
+    lib.ptc_batcher_create.restype = ctypes.c_void_p
+    lib.ptc_batcher_create.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_size_t),
+        ctypes.c_int, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int,
+        ctypes.c_int, ctypes.c_uint64, ctypes.c_int]
+    lib.ptc_batcher_next.restype = ctypes.c_int
+    lib.ptc_batcher_next.argtypes = [ctypes.c_void_p,
+                                     ctypes.POINTER(ctypes.c_void_p),
+                                     ctypes.POINTER(ctypes.c_size_t)]
+    lib.ptc_batcher_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptc_batcher_new_epoch.argtypes = [ctypes.c_void_p]
+    lib.ptc_batcher_destroy.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class Arena:
+    """Host staging arena (bump allocator with reset; reference:
+    auto-growth allocator)."""
+
+    def __init__(self, capacity_bytes):
+        self._lib = get_lib()
+        self._handle = self._lib.ptc_arena_create(capacity_bytes)
+        if not self._handle:
+            raise MemoryError("arena allocation failed")
+        self.capacity = capacity_bytes
+
+    def alloc_array(self, shape, dtype, align=64):
+        """Allocate a numpy view into the arena (no per-step malloc)."""
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        ptr = self._lib.ptc_arena_alloc(self._handle, nbytes, align)
+        if not ptr:
+            raise MemoryError(
+                f"arena exhausted: {self.used}B used of {self.capacity}B")
+        buf = (ctypes.c_char * nbytes).from_address(ptr)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    @property
+    def used(self):
+        return self._lib.ptc_arena_used(self._handle)
+
+    @property
+    def peak(self):
+        return self._lib.ptc_arena_peak(self._handle)
+
+    def reset(self):
+        self._lib.ptc_arena_reset(self._handle)
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.ptc_arena_destroy(self._handle)
+            self._handle = None
+
+
+def gather_rows(src, idx, out=None, n_threads=4):
+    """Multithreaded dst[i] = src[idx[i]] for a C-contiguous 2D+ table."""
+    lib = get_lib()
+    src = np.ascontiguousarray(src)
+    idx = np.ascontiguousarray(idx, dtype=np.int64)
+    row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:]))
+    if out is None:
+        out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    lib.ptc_gather_rows(
+        src.ctypes.data_as(ctypes.c_void_p), row_bytes,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), len(idx),
+        out.ctypes.data_as(ctypes.c_void_p), n_threads)
+    return out
+
+
+class NativeBatcher:
+    """Background-thread shuffling batcher over contiguous feature arrays
+    (reference: buffered_reader + data_feed)."""
+
+    def __init__(self, arrays, batch_size=None, shuffle=False,
+                 drop_last=False, seed=0, prefetch_slots=3):
+        self._lib = get_lib()
+        self.arrays = [np.ascontiguousarray(a) for a in arrays]
+        self.n_rows = len(self.arrays[0])
+        self.row_bytes = [a.dtype.itemsize * int(np.prod(a.shape[1:]))
+                          for a in self.arrays]
+        self.batch_size = batch_size
+        self._handle = None
+        self._cfg = (shuffle, drop_last, seed, prefetch_slots)
+        if batch_size is not None:
+            self._start()
+
+    def _start(self):
+        shuffle, drop_last, seed, slots = self._cfg
+        n = len(self.arrays)
+        ptrs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in self.arrays])
+        rbs = (ctypes.c_size_t * n)(*self.row_bytes)
+        self._handle = self._lib.ptc_batcher_create(
+            ptrs, rbs, n, self.n_rows, self.batch_size,
+            1 if shuffle else 0, 1 if drop_last else 0, seed, slots)
+        if not self._handle:
+            raise MemoryError("batcher allocation failed")
+
+    def gather(self, idx):
+        """Index-batch fast path used by DataLoader samplers."""
+        return tuple(gather_rows(a, idx) for a in self.arrays)
+
+    def __iter__(self):
+        if self._handle is None and self.batch_size is None:
+            raise RuntimeError("NativeBatcher built without batch_size")
+        # A dirty iterator (previous epoch abandoned mid-way, e.g. a `break`
+        # in the consumer loop) would otherwise resume with leftover
+        # batches — rebuild the C++ batcher for a clean epoch.
+        if getattr(self, "_mid_epoch", False):
+            self._lib.ptc_batcher_destroy(self._handle)
+            self._handle = None
+            self._cfg = (self._cfg[0], self._cfg[1],
+                         self._cfg[2] + 1, self._cfg[3])  # new shuffle seed
+            self._start()
+        self._mid_epoch = True
+        n = len(self.arrays)
+        out_ptrs = (ctypes.c_void_p * n)()
+        rows = ctypes.c_size_t()
+        try:
+            while True:
+                slot = self._lib.ptc_batcher_next(self._handle, out_ptrs,
+                                                  ctypes.byref(rows))
+                if slot < 0:
+                    self._lib.ptc_batcher_new_epoch(self._handle)
+                    self._mid_epoch = False
+                    return
+                r = rows.value
+                batch = []
+                for i, a in enumerate(self.arrays):
+                    shape = (r,) + a.shape[1:]
+                    nbytes = self.row_bytes[i] * r
+                    buf = (ctypes.c_char * nbytes).from_address(out_ptrs[i])
+                    # copy out: the slot is recycled after release
+                    batch.append(np.frombuffer(buf, dtype=a.dtype)
+                                 .reshape(shape).copy())
+                self._lib.ptc_batcher_release(self._handle, slot)
+                yield tuple(batch)
+        except GeneratorExit:
+            pass  # _mid_epoch stays True; next __iter__ rebuilds
+
+    def __del__(self):
+        if getattr(self, "_handle", None):
+            self._lib.ptc_batcher_destroy(self._handle)
+            self._handle = None

@@ -16,5 +16,8 @@ from .layers import (
 )
 from .loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, BCELoss,
                    BCEWithLogitsLoss, KLDivLoss, NLLLoss, MarginRankingLoss)
+from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, LSTM,
+                  GRU, SimpleRNN, StaticRNN)
 from . import functional
 from . import functional as F
+from .layers import NCE

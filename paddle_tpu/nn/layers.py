@@ -526,3 +526,57 @@ Mish = _act_layer("Mish", F.mish)
 ELU = _act_layer("ELU", F.elu)
 SELU = _act_layer("SELU", F.selu)
 Hardtanh = _act_layer("Hardtanh", F.hardtanh)
+
+
+class NCE(Layer):
+    """reference: dygraph/nn.py:NCE — noise-contrastive estimation loss for
+    large-vocab softmax. Samples `num_neg_samples` noise classes per batch
+    (uniform or custom_dist) and returns the NCE logistic loss."""
+
+    def __init__(self, num_total_classes, dim, sample_weight=None,
+                 param_attr=None, bias_attr=None, num_neg_samples=10,
+                 sampler="uniform", custom_dist=None, seed=0):
+        super().__init__()
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.weight = self.create_parameter((num_total_classes, dim),
+                                            attr=param_attr)
+        self.bias = self.create_parameter((num_total_classes,),
+                                          attr=bias_attr, is_bias=True)
+        self._custom_dist = (np.asarray(custom_dist, dtype="f4")
+                             if custom_dist is not None else None)
+
+    def forward(self, input, label):
+        from ..dispatch import apply
+        from .. import random as prandom
+        import jax
+        k = self.num_neg_samples
+        n_cls = self.num_total_classes
+        key = prandom.next_key()
+        custom = self._custom_dist
+
+        def impl(x, label, w, b, key):
+            if custom is not None:
+                dist = jnp.asarray(custom)
+                noise = jax.random.categorical(key, jnp.log(dist + 1e-12),
+                                               shape=(k,))
+                noise_p = dist[noise]
+            else:
+                dist = None
+                noise = jax.random.randint(key, (k,), 0, n_cls)
+                noise_p = jnp.full((k,), 1.0 / n_cls)
+            lbl = label.reshape(-1)
+            pos_logit = jnp.sum(x * w[lbl], axis=-1) + b[lbl]
+            # NCE logistic loss: each logit is corrected by log(k·q(class))
+            # under the SAME noise distribution q for positives and
+            # negatives
+            pos_q = dist[lbl] if dist is not None else 1.0 / n_cls
+            pos_loss = jax.nn.softplus(-(pos_logit -
+                                         jnp.log(k * pos_q + 1e-12)))
+            neg_logit = x @ w[noise].T + b[noise]  # [B, k]
+            neg_loss = jax.nn.softplus(neg_logit -
+                                       jnp.log(k * noise_p + 1e-12))
+            return (pos_loss + jnp.sum(neg_loss, axis=-1)).reshape(-1, 1)
+
+        return apply(impl, (input, label, self.weight, self.bias),
+                     dict(key=key), name="nce")

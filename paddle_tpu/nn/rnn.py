@@ -1,0 +1,330 @@
+"""paddle_tpu.nn.rnn — recurrent layers.
+
+TPU-native rebuild of the reference's RNN stack
+(reference: python/paddle/fluid/layers/rnn.py LSTMCell/GRUCell/rnn +
+dygraph/rnn.py; C++ recurrent ops). The reference unrolls dynamic RNNs with
+a C++ while-op over LoD tensors; on TPU the driver is `lax.scan` — one
+compiled loop, static shapes, weights resident in VMEM across steps.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor, as_tensor
+from ..dispatch import apply
+from .. import initializer as I
+from .layer import Layer
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_size, dtype="float32"):
+        import jax.numpy as jnp
+        from ..tensor import convert_dtype
+        shape = (batch_size, self.hidden_size)
+        if self.state_components == 1:
+            return Tensor(jnp.zeros(shape, convert_dtype(dtype)))
+        return tuple(Tensor(jnp.zeros(shape, convert_dtype(dtype)))
+                     for _ in range(self.state_components))
+
+
+class SimpleRNNCell(RNNCellBase):
+    """reference: layers/rnn.py simple rnn — h' = act(Wx + Uh + b)."""
+
+    state_components = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_attr=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((input_size, hidden_size),
+                                               attr=weight_ih_attr)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               attr=weight_hh_attr)
+        self.bias = self.create_parameter((hidden_size,), attr=bias_attr,
+                                          is_bias=True)
+        self._act = activation
+
+    def forward(self, x, h):
+        if isinstance(h, (tuple, list)):
+            h = h[0]
+        act = self._act
+
+        def impl(x, h, wi, wh, b):
+            pre = x @ wi + h @ wh + b
+            return jnp.tanh(pre) if act == "tanh" else jnp.maximum(pre, 0)
+
+        out = apply(impl, (x, h, self.weight_ih, self.weight_hh, self.bias),
+                    name="simple_rnn_cell")
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    """reference: layers/rnn.py:LSTMCell (i,f,c,o gate order)."""
+
+    state_components = 2
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_attr=None,
+                 forget_bias=1.0):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((input_size, 4 * hidden_size),
+                                               attr=weight_ih_attr)
+        self.weight_hh = self.create_parameter((hidden_size, 4 * hidden_size),
+                                               attr=weight_hh_attr)
+        self.bias = self.create_parameter((4 * hidden_size,), attr=bias_attr,
+                                          is_bias=True)
+        self._forget_bias = forget_bias
+
+    def forward(self, x, state):
+        h, c = state
+        fb = self._forget_bias
+
+        def impl(x, h, c, wi, wh, b):
+            gates = x @ wi + h @ wh + b
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f + fb)
+            g = jnp.tanh(g)
+            o = jax.nn.sigmoid(o)
+            new_c = f * c + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply(impl, (x, h, c, self.weight_ih, self.weight_hh,
+                                    self.bias), n_out=2, name="lstm_cell")
+        return new_h, (new_h, new_c)
+
+
+class GRUCell(RNNCellBase):
+    """reference: layers/rnn.py:GRUCell."""
+
+    state_components = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_attr=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.weight_ih = self.create_parameter((input_size, 3 * hidden_size),
+                                               attr=weight_ih_attr)
+        self.weight_hh = self.create_parameter((hidden_size, 3 * hidden_size),
+                                               attr=weight_hh_attr)
+        self.bias_ih = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_attr, is_bias=True)
+        self.bias_hh = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_attr, is_bias=True)
+
+    def forward(self, x, h):
+        if isinstance(h, (tuple, list)):
+            h = h[0]
+
+        def impl(x, h, wi, wh, bi, bh):
+            gi = x @ wi + bi
+            gh = h @ wh + bh
+            i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+            h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(i_r + h_r)
+            z = jax.nn.sigmoid(i_z + h_z)
+            n = jnp.tanh(i_n + r * h_n)
+            return (1 - z) * n + z * h
+
+        out = apply(impl, (x, h, self.weight_ih, self.weight_hh,
+                           self.bias_ih, self.bias_hh), name="gru_cell")
+        return out, out
+
+
+class RNN(Layer):
+    """Scan driver over any cell (reference: layers/rnn.py:rnn /
+    dygraph RNN wrapper). One `lax.scan` — static shapes, no per-step
+    dispatch. Sequence-major internally; accepts batch-major."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        batch = inputs.shape[1 if self.time_major else 0]
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(batch)
+
+        cell = self.cell
+        names = sorted(dict(cell.named_parameters()))
+        param_map = dict(cell.named_parameters())
+        time_major = self.time_major
+        reverse = self.is_reverse
+        multi = not isinstance(initial_states, Tensor)
+        states0 = tuple(s.data for s in initial_states) if multi else \
+            (initial_states.data,)
+        has_len = sequence_length is not None
+
+        from .layer import bind_state
+
+        def impl(x, *rest):
+            if has_len:
+                seq_len, rest = rest[0], rest[1:]
+            states = rest[:len(states0)]
+            pvals = rest[len(states0):]
+            if not time_major:
+                x = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+            T = x.shape[0]
+            if reverse:
+                if has_len:
+                    # valid-prefix reverse (padding stays in place), so the
+                    # backward pass starts at each row's LAST REAL step
+                    t_idx = jnp.arange(T)[None, :]
+                    rev = jnp.where(t_idx < seq_len[:, None],
+                                    seq_len[:, None] - 1 - t_idx, t_idx)
+                    x = jnp.take_along_axis(
+                        jnp.swapaxes(x, 0, 1),
+                        rev.reshape(rev.shape + (1,) * (x.ndim - 2)
+                                    ).astype(jnp.int32), axis=1)
+                    x = jnp.swapaxes(x, 0, 1)
+                else:
+                    x = jnp.flip(x, axis=0)
+
+            with bind_state(cell, dict(zip(names, pvals))):
+                from .. import autograd as _ag
+
+                def step(carry, xt_t):
+                    xt, t = xt_t
+                    st = tuple(Tensor(c) for c in carry)
+                    with _ag.no_grad():
+                        out, new_state = cell(
+                            Tensor(xt), st if multi else st[0])
+                    if isinstance(new_state, (tuple, list)):
+                        new_c = tuple(s.data for s in new_state)
+                    else:
+                        new_c = (new_state.data,)
+                    if has_len:
+                        # freeze state and zero outputs past each row's len
+                        alive = (t < seq_len)[:, None]
+                        new_c = tuple(jnp.where(alive, n, c)
+                                      for n, c in zip(new_c, carry))
+                        y = jnp.where(alive, out.data, 0.0)
+                    else:
+                        y = out.data
+                    return new_c, y
+
+                final, ys = lax.scan(step, tuple(states),
+                                     (x, jnp.arange(T)))
+            if reverse:
+                if has_len:
+                    t_idx = jnp.arange(T)[None, :]
+                    rev = jnp.where(t_idx < seq_len[:, None],
+                                    seq_len[:, None] - 1 - t_idx, t_idx)
+                    ys = jnp.swapaxes(ys, 0, 1)
+                    ys = jnp.take_along_axis(
+                        ys, rev.reshape(rev.shape + (1,) * (ys.ndim - 2)
+                                        ).astype(jnp.int32), axis=1)
+                    ys = jnp.swapaxes(ys, 0, 1)
+                else:
+                    ys = jnp.flip(ys, axis=0)
+            if not time_major:
+                ys = jnp.swapaxes(ys, 0, 1)
+            return (ys,) + final
+
+        extra = (as_tensor(sequence_length),) if has_len else ()
+        args = (inputs,) + extra + tuple(
+            initial_states if multi else [initial_states]) + tuple(
+            param_map[n] for n in names)
+        out = apply(impl, args, n_out=1 + len(states0), name="rnn_scan")
+        ys = out[0]
+        final = out[1:]
+        final_states = tuple(final) if multi else final[0]
+        return ys, final_states
+
+
+class _MultiLayerRNN(Layer):
+    """Stacked (optionally bidirectional) recurrent network."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0):
+        super().__init__()
+        self.mode = mode
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_layers = num_layers
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        cells_fw, cells_bw = [], []
+        cell_cls = {"LSTM": LSTMCell, "GRU": GRUCell,
+                    "RNN": SimpleRNNCell}[mode]
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else hidden_size * (
+                2 if self.bidirectional else 1)
+            cells_fw.append(cell_cls(in_sz, hidden_size))
+            if self.bidirectional:
+                cells_bw.append(cell_cls(in_sz, hidden_size))
+        from .container import LayerList
+        self.cells_fw = LayerList(cells_fw)
+        self.cells_bw = LayerList(cells_bw) if self.bidirectional else None
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import manip as M
+        x = inputs
+        finals = []
+        for li in range(self.num_layers):
+            fw = RNN(self.cells_fw[li], time_major=self.time_major)
+            y_fw, s_fw = fw(x, sequence_length=sequence_length)
+            if self.bidirectional:
+                bw = RNN(self.cells_bw[li], is_reverse=True,
+                         time_major=self.time_major)
+                y_bw, s_bw = bw(x, sequence_length=sequence_length)
+                x = M.concat([y_fw, y_bw], axis=-1)
+                finals.append((s_fw, s_bw))
+            else:
+                x = y_fw
+                finals.append(s_fw)
+            if self.dropout > 0 and li < self.num_layers - 1:
+                from ..ops import nn_ops as F
+                x = F.dropout(x, p=self.dropout, training=self.training)
+        return x, finals
+
+
+class LSTM(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class SimpleRNN(_MultiLayerRNN):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class StaticRNN(Layer):
+    """reference: layers/control_flow.py:StaticRNN parity — a python-level
+    step recorder; on TPU prefer RNN/lax.scan (this exists for API parity
+    and simply unrolls)."""
+
+    def __init__(self):
+        super().__init__()
+        self._steps = []
+
+    def step(self, fn):
+        self._steps.append(fn)
+        return fn
+
+    def forward(self, xs, init):
+        h = init
+        outs = []
+        for x in xs:
+            for fn in self._steps:
+                h = fn(x, h)
+            outs.append(h)
+        return outs, h

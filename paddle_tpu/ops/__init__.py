@@ -19,6 +19,7 @@ from .math import *  # noqa: F401,F403
 from .manip import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .nn_ops import *  # noqa: F401,F403
+from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
 from . import loss  # noqa: F401
 from . import math as math_ops
 from . import manip as manip_ops
@@ -135,3 +136,7 @@ def _patch():
 
 _patch()
 del _patch
+from . import sequence  # noqa: F401
+from .sequence import (sequence_pool, sequence_softmax,  # noqa: F401
+                       sequence_reverse, sequence_expand, sequence_pad,
+                       sequence_unpad, sequence_concat)

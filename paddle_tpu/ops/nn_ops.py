@@ -325,8 +325,6 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 
 def _pool(x, kind, kernel, stride, padding, data_format, ceil_mode=False,
           exclusive=True, global_pool=False):
-    nsp = x.data.ndim - 2 if isinstance(x, Tensor) else 2
-
     def impl(x, kernel, stride, padding, data_format, global_pool):
         nd = x.ndim
         nsp = nd - 2

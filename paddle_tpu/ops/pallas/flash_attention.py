@@ -123,8 +123,10 @@ def _bwd(causal, scale, block_q, block_k, res, g):
         logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                             k.astype(jnp.float32)) * s
         if causal:
+            # top-left aligned (query i sees keys j <= i), matching the
+            # forward kernel's absolute-position mask for sq != sk
             sq, sk = logits.shape[-2:]
-            mask = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+            mask = jnp.tril(jnp.ones((sq, sk), bool))
             logits = jnp.where(mask, logits, -1e30)
         p = jax.nn.softmax(logits, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p,

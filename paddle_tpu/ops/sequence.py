@@ -1,0 +1,131 @@
+"""paddle_tpu.ops.sequence — sequence ops (padded-tensor semantics).
+
+TPU-native rebuild of the reference's LoD sequence operators
+(reference: paddle/fluid/operators/sequence_ops/* — sequence_pool,
+sequence_softmax, sequence_expand, sequence_reverse, sequence_pad/unpad;
+python surface fluid/layers/sequence_lod.py).
+
+Redesign: LoD (ragged) tensors are hostile to XLA's static shapes, so the
+TPU formulation is the padded batch + length vector the reference's
+sequence_pad produced anyway: every op takes `[B, T, ...]` data plus
+`length: [B]` and masks internally. This matches how the reference models
+fed RNNs after padding.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import as_tensor, convert_dtype
+from ..dispatch import apply
+
+
+def _mask(length, t, extra_dims=0):
+    m = jnp.arange(t)[None, :] < length[:, None]
+    for _ in range(extra_dims):
+        m = m[..., None]
+    return m
+
+
+def sequence_pool(x, pool_type, length=None, name=None):
+    """reference: sequence_pool_op. x: [B, T, D], length: [B] (None = all
+    timesteps valid). pool_type in sum/average/max/min/last/first/sqrt."""
+    pool_type = pool_type.lower()
+
+    def impl(x, length, pool_type):
+        b, t = x.shape[:2]
+        ln = length if length is not None else jnp.full((b,), t, jnp.int32)
+        m = _mask(ln, t, x.ndim - 2)
+        if pool_type == "sum":
+            return jnp.sum(jnp.where(m, x, 0), axis=1)
+        if pool_type in ("average", "mean"):
+            return jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.maximum(
+                ln[:, None].astype(x.dtype), 1)
+        if pool_type == "sqrt":
+            return jnp.sum(jnp.where(m, x, 0), axis=1) / jnp.sqrt(
+                jnp.maximum(ln[:, None].astype(x.dtype), 1))
+        if pool_type == "max":
+            return jnp.max(jnp.where(m, x, -jnp.inf), axis=1)
+        if pool_type == "min":
+            return jnp.min(jnp.where(m, x, jnp.inf), axis=1)
+        if pool_type == "first":
+            return x[:, 0]
+        if pool_type == "last":
+            idx = jnp.maximum(ln - 1, 0)
+            return jnp.take_along_axis(
+                x, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        raise ValueError(pool_type)
+
+    args = (x,) if length is None else (x, as_tensor(length))
+    if length is None:
+        return apply(lambda x, pool_type: impl(x, None, pool_type), (x,),
+                     dict(pool_type=pool_type), name="sequence_pool")
+    return apply(lambda x, ln, pool_type: impl(x, ln, pool_type), args,
+                 dict(pool_type=pool_type), name="sequence_pool")
+
+
+def sequence_softmax(x, length=None, name=None):
+    """reference: sequence_softmax_op — softmax over valid timesteps."""
+    def impl(x, *maybe_len):
+        b, t = x.shape[:2]
+        ln = maybe_len[0] if maybe_len else jnp.full((b,), t, jnp.int32)
+        m = _mask(ln, t, x.ndim - 2)
+        z = jnp.where(m, x, -jnp.inf)
+        out = jax.nn.softmax(z, axis=1)
+        return jnp.where(m, out, 0.0)
+    args = (x,) if length is None else (x, as_tensor(length))
+    return apply(impl, args, name="sequence_softmax")
+
+
+def sequence_reverse(x, length=None, name=None):
+    """reference: sequence_reverse_op — reverse valid prefix per row."""
+    def impl(x, *maybe_len):
+        b, t = x.shape[:2]
+        ln = maybe_len[0] if maybe_len else jnp.full((b,), t, jnp.int32)
+        idx = jnp.arange(t)[None, :]
+        rev = jnp.where(idx < ln[:, None], ln[:, None] - 1 - idx, idx)
+        return jnp.take_along_axis(
+            x, rev.reshape(b, t, *([1] * (x.ndim - 2))).astype(jnp.int32),
+            axis=1)
+    args = (x,) if length is None else (x, as_tensor(length))
+    return apply(impl, args, name="sequence_reverse")
+
+
+def sequence_expand(x, repeat_times, name=None):
+    """reference: sequence_expand_op simplified: repeat each row k times
+    (uniform k keeps static shapes on TPU)."""
+    def impl(x, k):
+        return jnp.repeat(x, k, axis=0)
+    return apply(impl, (x,), dict(k=repeat_times), name="sequence_expand")
+
+
+def sequence_pad(sequences, maxlen=None, pad_value=0.0, name=None):
+    """Host-side helper (ragged python list -> padded [B, T, ...] + length),
+    the analogue of the reference's sequence_pad preprocessing."""
+    arrs = [np.asarray(s) for s in sequences]
+    t = maxlen or max(len(a) for a in arrs)
+    b = len(arrs)
+    trailing = arrs[0].shape[1:]
+    out = np.full((b, t) + trailing, pad_value, dtype=arrs[0].dtype)
+    lens = np.zeros((b,), np.int32)
+    for i, a in enumerate(arrs):
+        n = min(len(a), t)
+        out[i, :n] = a[:n]
+        lens[i] = n
+    from ..tensor import Tensor
+    return Tensor(out), Tensor(lens)
+
+
+def sequence_unpad(x, length, name=None):
+    """Padded [B, T, ...] + lengths -> list of numpy arrays (host-side,
+    dynamic shapes)."""
+    x = as_tensor(x)
+    ln = np.asarray(jax.device_get(as_tensor(length).data))
+    arr = np.asarray(jax.device_get(x.data))
+    return [arr[i, :ln[i]] for i in range(arr.shape[0])]
+
+
+def sequence_concat(xs, name=None):
+    from .manip import concat
+    return concat(xs, axis=1)

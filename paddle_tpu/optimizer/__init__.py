@@ -639,3 +639,18 @@ RMSPropOptimizer = RMSProp
 FtrlOptimizer = Ftrl
 DpsgdOptimizer = Dpsgd
 LookaheadOptimizer = LookAhead
+
+
+class PipelineOptimizer:
+    """reference: optimizer.py:PipelineOptimizer — pipeline-parallel
+    training. On TPU, pipeline parallelism is a mesh axis, not an optimizer
+    wrapper: see paddle_tpu.parallel.megatron (GPipe microbatch ring over
+    ppermute). This class keeps API parity and delegates stepping to the
+    inner optimizer."""
+
+    def __init__(self, optimizer, num_microbatches=1, **kw):
+        self.inner = optimizer
+        self.num_microbatches = num_microbatches
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)

@@ -86,13 +86,18 @@ def init_params(cfg: MegatronConfig, mesh: Mesh, seed=0):
         return (rng.randn(*shape) * scale).astype("f4")
 
     L = cfg.layers_per_stage
+    nh = cfg.n_heads
+    hd = h // nh
     params = {
         "embed": w(cfg.vocab_size, h, scale=0.02),
         "pos": w(cfg.seq_len, h, scale=0.02),
-        # stage-stacked block params: leading axis pp, then per-stage layers
-        "qkv_w": w(pp, L, h, 3 * h),
-        "qkv_b": np.zeros((pp, L, 3 * h), "f4"),
-        "attn_out_w": w(pp, L, h, h),
+        # stage-stacked block params: leading axis pp, then per-stage
+        # layers. QKV carries an explicit heads axis so tp shards HEADS —
+        # naively column-splitting a [q|k|v]-packed matrix would hand rank 0
+        # all of Q plus part of K.
+        "qkv_w": w(pp, L, h, 3, nh, hd, scale=1.0 / np.sqrt(h)),
+        "qkv_b": np.zeros((pp, L, 3, nh, hd), "f4"),
+        "attn_out_w": w(pp, L, nh, hd, h, scale=1.0 / np.sqrt(h)),
         "attn_out_b": np.zeros((pp, L, h), "f4"),
         "ln1_w": np.ones((pp, L, h), "f4"),
         "ln1_b": np.zeros((pp, L, h), "f4"),
@@ -114,9 +119,9 @@ def init_params(cfg: MegatronConfig, mesh: Mesh, seed=0):
     specs = {
         "embed": P(None, None),
         "pos": P(None, None),
-        "qkv_w": P("pp", None, None, "tp"),
-        "qkv_b": P("pp", None, "tp"),
-        "attn_out_w": P("pp", None, "tp", None),
+        "qkv_w": P("pp", None, None, None, "tp", None),
+        "qkv_b": P("pp", None, None, "tp", None),
+        "attn_out_w": P("pp", None, "tp", None, None),
         "attn_out_b": P("pp", None, None),
         "ln1_w": P("pp", None, None), "ln1_b": P("pp", None, None),
         "ffn1_w": P("pp", None, None, "tp"),
@@ -139,6 +144,45 @@ def init_params(cfg: MegatronConfig, mesh: Mesh, seed=0):
 
 
 # ---------------------------------------------------------------------------
+# Megatron f/g collective pair: the key to correct manual-SPMD gradients.
+# f: forward identity, backward psum — placed where a REPLICATED activation
+#    enters a tensor-split region (column-parallel entry), so the partial
+#    cotangents coming back from each rank's weight slice are summed and
+#    every rank sees the COMPLETE gradient for the replicated upstream.
+# g: forward psum, backward identity — row-parallel exit.
+# With these in place, replicated parameters (layer norms, embeddings)
+# receive identical, complete gradients on every rank of the axis, and
+# sharded parameters receive exactly their local-slice gradients — no
+# after-the-fact reduction guessing.
+
+def _make_fg(axis_name):
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    def f_fwd(x):
+        return x, None
+
+    def f_bwd(_, ct):
+        return (lax.psum(ct, axis_name),)
+
+    f.defvjp(f_fwd, f_bwd)
+
+    @jax.custom_vjp
+    def g(x):
+        return lax.psum(x, axis_name)
+
+    def g_fwd(x):
+        return lax.psum(x, axis_name), None
+
+    def g_bwd(_, ct):
+        return (ct,)
+
+    g.defvjp(g_fwd, g_bwd)
+    return f, g
+
+
+# ---------------------------------------------------------------------------
 # the per-device compute (runs INSIDE shard_map: all axes are bound)
 
 def _ln(x, w, b, eps=1e-5):
@@ -155,32 +199,49 @@ def _ring_attention(q, k, v, causal=True):
 
 def _block(x, p, li, cfg):
     """One transformer block on LOCAL tensors. x: [mb, s_local, h].
-    tp splits hidden projections; exit projections psum over tp."""
-    h = cfg.hidden
-    heads_local = cfg.n_heads // lax.axis_size("tp") if \
-        cfg.n_heads % lax.axis_size("tp") == 0 else 1
-    # attention
+    Megatron column/row parallel over tp with the f/g collective pair;
+    row-parallel biases are added AFTER the psum (adding before would scale
+    them by the tp size)."""
+    f_tp, g_tp = _make_fg("tp")
+    # attention — head-parallel over tp
     xa = _ln(x, p["ln1_w"][li], p["ln1_b"][li])
-    qkv = xa @ p["qkv_w"][li] + p["qkv_b"][li]  # [mb, s, 3h/tp]
-    mb, s = qkv.shape[0], qkv.shape[1]
-    hl = qkv.shape[-1] // 3
-    hd = hl // heads_local
-    q, k, v = jnp.split(qkv, 3, axis=-1)
-
-    def heads(t):
-        return t.reshape(mb, s, heads_local, hd).transpose(0, 2, 1, 3)
-
-    ctx = _ring_attention(heads(q), heads(k), heads(v), causal=True)
-    ctx = ctx.transpose(0, 2, 1, 3).reshape(mb, s, hl)
-    attn = ctx @ p["attn_out_w"][li] + p["attn_out_b"][li]
-    attn = lax.psum(attn, "tp")  # row-parallel exit (Megatron)
+    xa = f_tp(xa)  # column-parallel entry
+    wqkv = p["qkv_w"][li]           # [h, 3, nh_local, hd]
+    qkv = jnp.einsum("bsh,hknd->bsknd", xa, wqkv) + p["qkv_b"][li]
+    q = qkv[:, :, 0].transpose(0, 2, 1, 3)  # [mb, nh_local, s, hd]
+    k = qkv[:, :, 1].transpose(0, 2, 1, 3)
+    v = qkv[:, :, 2].transpose(0, 2, 1, 3)
+    ctx = _ring_attention(q, k, v, causal=True)  # [mb, nh_local, s, hd]
+    attn = g_tp(jnp.einsum("bnsd,ndh->bsh", ctx,
+                           p["attn_out_w"][li]))  # row-parallel exit
+    attn = attn + p["attn_out_b"][li]
     x = x + attn
     # ffn
     xf = _ln(x, p["ln2_w"][li], p["ln2_b"][li])
+    xf = f_tp(xf)
     ff = jax.nn.gelu(xf @ p["ffn1_w"][li] + p["ffn1_b"][li])
-    ff = ff @ p["ffn2_w"][li] + p["ffn2_b"][li]
-    ff = lax.psum(ff, "tp")
+    ff = g_tp(ff @ p["ffn2_w"][li])
+    ff = ff + p["ffn2_b"][li]
     return x + ff
+
+
+def _scale_grad(x, factor):
+    """Forward identity, backward ct*factor — used to correct the ep-fold
+    overcounting of expert-weight gradients (tokens are replicated over ep,
+    so every rank's local loss reaches each expert through the all_to_all
+    transpose; one copy's worth is the true gradient)."""
+    @jax.custom_vjp
+    def s(x):
+        return x
+
+    def s_fwd(x):
+        return x, None
+
+    def s_bwd(_, ct):
+        return (jax.tree_util.tree_map(lambda c: c * factor, ct),)
+
+    s.defvjp(s_fwd, s_bwd)
+    return s(x)
 
 
 def _moe_ffn(x, p, cfg):
@@ -212,10 +273,14 @@ def _moe_ffn(x, p, cfg):
     expert_in = lax.all_to_all(buf, "ep", split_axis=0, concat_axis=0,
                                tiled=True)
     expert_in = expert_in.reshape(ep, n_exp_local, cap, h)
-    # run local experts over every sender's bucket
+    # run local experts over every sender's bucket (expert weights carry a
+    # 1/ep grad scale — see _scale_grad)
+    w1 = _scale_grad(p["moe_w1"], 1.0 / ep)
+    w2 = _scale_grad(p["moe_w2"], 1.0 / ep)
+
     def run_expert(e, t):  # t: [ep(sender), cap, h]
-        hdn = jax.nn.gelu(t @ p["moe_w1"][e])
-        return hdn @ p["moe_w2"][e]
+        hdn = jax.nn.gelu(t @ w1[e])
+        return hdn @ w2[e]
     outs = jnp.stack([run_expert(e, expert_in[:, e])
                       for e in range(n_exp_local)], axis=1)
     # route results back: sender axis -> dest-rank axis again
@@ -264,9 +329,12 @@ def _pipeline(x_micro, p_local, cfg):
     buf0 = jnp.zeros_like(x_micro[0])
     out0 = jnp.zeros_like(x_micro)
     (_, outputs), _ = lax.scan(tick, (buf0, out0), jnp.arange(T))
-    # replicate final outputs to every pp rank (loss computed everywhere)
-    outputs = lax.psum(jnp.where(is_last, outputs,
-                                 jnp.zeros_like(outputs)), "pp")
+    # Replicate final outputs to every pp rank (loss computed everywhere).
+    # MUST be the g-collective, not a raw psum: with check_vma off, the
+    # transpose of a raw psum re-psums the already-replicated cotangent and
+    # every upstream gradient gets multiplied by the pp size.
+    _, g_pp = _make_fg("pp")
+    outputs = g_pp(jnp.where(is_last, outputs, jnp.zeros_like(outputs)))
     return outputs
 
 
@@ -280,9 +348,15 @@ def _loss_fn(params_local, tokens, cfg):
     s_local = tokens.shape[-1]
     h = cfg.hidden
 
-    # embedding (replicated table, local positions offset by sp rank)
+    # embedding (replicated table, local positions offset by sp rank).
+    # f_pp: the pipeline injects this only on pp rank 0, so the injection
+    # gradient exists only there — psum on the backward pass hands the
+    # complete embed/pos gradient to every pp rank, keeping the replicated
+    # tables in sync.
+    f_pp, _ = _make_fg("pp")
     pos_idx = sp_r * s_local + jnp.arange(s_local)
     x = params_local["embed"][tokens] + params_local["pos"][pos_idx]
+    x = f_pp(x)
 
     # pipeline over stacked stage params: shard_map gives each pp rank its
     # stage slice with leading dim 1 — drop it

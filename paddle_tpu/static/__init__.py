@@ -33,9 +33,10 @@ class StaticVar(Tensor):
     Tensor so layer code paths treat it uniformly; payload is None until the
     Executor materializes it."""
 
-    __slots__ = ("_shape", "_dtype", "program", "is_feed")
+    __slots__ = ("_shape", "_shape2", "_dtype", "program", "is_feed")
 
-    def __init__(self, name, shape, dtype, program, is_feed=False):
+    def __init__(self, name, shape, dtype, program, is_feed=False,
+                 shape2=None):
         # bypass Tensor.__init__ array coercion
         self.data = None
         self.stop_gradient = True
@@ -45,6 +46,10 @@ class StaticVar(Tensor):
         self.name = name
         self.persistable = False
         self._shape = tuple(shape)
+        # second probe shape: symbolic (None/-1) dims get a DIFFERENT
+        # placeholder so shape inference can tell static from dynamic dims
+        self._shape2 = tuple(shape2) if shape2 is not None else tuple(
+            2 if (s is None or s < 0) else s for s in self._shape)
         self._dtype = jnp.dtype(convert_dtype(dtype) or jnp.float32)
         self.program = program
         self.is_feed = is_feed
@@ -64,6 +69,9 @@ class StaticVar(Tensor):
     def aval(self):
         shape = tuple(1 if (s is None or s < 0) else s for s in self._shape)
         return jax.ShapeDtypeStruct(shape, self._dtype)
+
+    def aval2(self):
+        return jax.ShapeDtypeStruct(self._shape2, self._dtype)
 
     def __repr__(self):
         return f"StaticVar(name={self.name}, shape={self._shape}, dtype={self._dtype})"
@@ -241,29 +249,38 @@ def _record(impl, tensors, attrs, nondiff, n_out, name):
     prog = default_main_program()
     block = prog.current_block()
 
-    in_names, in_avals = [], []
+    in_names, in_avals, in_avals2 = [], [], []
     for t in tensors:
         gv = _as_graph_var(t, block, prog)
         if isinstance(gv, StaticVar):
             in_names.append(gv.name)
             in_avals.append(gv.aval())
+            in_avals2.append(gv.aval2())
         else:
             in_names.append(gv)
             holder = prog.param_vars.get(gv)
             if holder is None:
                 holder = prog.const_vars[gv]
             payload = holder.data
-            in_avals.append(jax.ShapeDtypeStruct(payload.shape,
-                                                 payload.dtype))
+            av = jax.ShapeDtypeStruct(payload.shape, payload.dtype)
+            in_avals.append(av)
+            in_avals2.append(av)
 
+    # two shape-inference probes: dims that differ between them are
+    # dynamic (batch-like) and stay symbolic in the out vars
     out_avals = jax.eval_shape(lambda *xs: impl(*xs, **attrs), *in_avals)
+    out_avals2 = jax.eval_shape(lambda *xs: impl(*xs, **attrs), *in_avals2)
     single = not isinstance(out_avals, (tuple, list))
     outs_seq = (out_avals,) if single else tuple(out_avals)
+    outs_seq2 = (out_avals2,) if single else tuple(out_avals2)
 
     out_vars = []
-    for av in outs_seq:
-        v = block.create_var(av.shape, av.dtype,
-                             name=prog._unique_name(name or "op"))
+    for av, av2 in zip(outs_seq, outs_seq2):
+        shape = tuple(None if a != b else a
+                      for a, b in zip(av.shape, av2.shape))
+        v = StaticVar(prog._unique_name(name or "op"), shape, av.dtype,
+                      prog, shape2=av2.shape)
+        block.vars[v.name] = v
         v.stop_gradient = nondiff
         out_vars.append(v)
 

@@ -1,0 +1,92 @@
+"""fluid compatibility namespace: reference-style user code must run
+(mirrors the reference book examples, e.g. test_recognize_digits)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.fluid as fluid
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    from paddle_tpu import static
+    static.reset_default_programs()
+    fluid.layers._bn_stats.clear()
+    yield
+    fluid.disable_static()
+
+
+def test_fluid_static_mnist_style_program():
+    """The reference book's recognize_digits flow, fluid API verbatim."""
+    fluid.enable_static()
+    pt.seed(0)
+    img = fluid.data("img", [None, 1, 28, 28], "float32")
+    label = fluid.data("label", [None, 1], "int64")
+
+    conv = fluid.layers.conv2d(img, num_filters=8, filter_size=5, act="relu")
+    pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+    hidden = fluid.layers.fc(pool, size=64, act="relu")
+    prediction = fluid.layers.fc(hidden, size=10, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(prediction, label))
+    acc = fluid.layers.accuracy(prediction, label)
+
+    opt = fluid.optimizer.Adam(learning_rate=1e-3)
+    opt.minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    x = (rng.rand(64, 1, 28, 28) * 0.1).astype("f4")
+    y = rng.randint(0, 10, (64, 1))
+    for i in range(64):
+        x[i, 0, 5:15, 5:15] += y[i, 0] / 10.0
+
+    losses = []
+    for _ in range(30):
+        lv, av = exe.run(feed={"img": x, "label": y},
+                         fetch_list=[loss, acc])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0]
+
+
+def test_fluid_dygraph_guard_style():
+    """Reference dygraph user code via fluid.dygraph."""
+    with fluid.dygraph.guard():
+        model = fluid.dygraph.Sequential(
+            fluid.dygraph.Linear(4, 16),
+            fluid.dygraph.Linear(16, 2),
+        )
+        opt = fluid.optimizer.SGDOptimizer(
+            learning_rate=0.1, parameters=model.parameters())
+        x = fluid.dygraph.to_variable(
+            np.random.randn(8, 4).astype("f4"))
+        loss = model(x).square().mean()
+        loss.backward()
+        opt.minimize(loss)
+        model.clear_gradients()
+
+
+def test_fluid_program_guard_and_clone():
+    fluid.enable_static()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.data("x", [None, 3], "float32")
+        out = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    test_prog = main.clone(for_test=True)
+    assert main.optimizers and not test_prog.optimizers
+    exe = fluid.Executor()
+    res = exe.run(test_prog, feed={"x": np.ones((2, 3), "f4")},
+                  fetch_list=[out])
+    assert res[0].shape == (2, 2)
+
+
+def test_fluid_misc_surface():
+    assert fluid.cuda_places()
+    assert fluid.cpu_places(2)
+    fluid.memory_optimize(None)
+    fluid.set_flags({"FLAGS_check_nan_inf": False})
+    m = fluid.layers.sequence_mask(pt.to_tensor(np.array([2, 4])), maxlen=5)
+    np.testing.assert_array_equal(
+        m.numpy(), [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]])

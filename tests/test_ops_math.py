@@ -144,3 +144,24 @@ def test_tensor_methods_and_operators():
     np.testing.assert_allclose(a.t().numpy() if hasattr(a, 't')
                                else a.transpose([1, 0]).numpy(),
                                [[1, 3], [2, 4]])
+
+
+def test_sequence_ops():
+    from paddle_tpu.ops import sequence as S
+    x = np.arange(24, dtype="f4").reshape(2, 4, 3)
+    ln = np.array([2, 4])
+    pooled = S.sequence_pool(pt.to_tensor(x), "sum", pt.to_tensor(ln))
+    np.testing.assert_allclose(pooled.numpy()[0], x[0, :2].sum(0))
+    np.testing.assert_allclose(pooled.numpy()[1], x[1].sum(0))
+    last = S.sequence_pool(pt.to_tensor(x), "last", pt.to_tensor(ln))
+    np.testing.assert_allclose(last.numpy()[0], x[0, 1])
+    sm = S.sequence_softmax(pt.to_tensor(x[..., 0]), pt.to_tensor(ln))
+    np.testing.assert_allclose(sm.numpy().sum(1), [1.0, 1.0], atol=1e-5)
+    assert (sm.numpy()[0, 2:] == 0).all()
+    rev = S.sequence_reverse(pt.to_tensor(x), pt.to_tensor(ln))
+    np.testing.assert_allclose(rev.numpy()[0, 0], x[0, 1])
+    np.testing.assert_allclose(rev.numpy()[0, 2], x[0, 2])  # pad untouched
+    padded, lens = S.sequence_pad([np.ones((2, 3)), np.ones((5, 3))])
+    assert padded.shape == [2, 5, 3] and lens.numpy().tolist() == [2, 5]
+    unp = S.sequence_unpad(padded, lens)
+    assert unp[0].shape == (2, 3) and unp[1].shape == (5, 3)

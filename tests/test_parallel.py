@@ -153,3 +153,74 @@ def test_dataparallel_wrapper(mesh8):
     # params are now mesh-placed (replicated)
     sh = m.weight.data.sharding
     assert getattr(sh, "mesh", None) is not None
+
+
+def test_megatron_dryrun_entry():
+    """__graft_entry__.dryrun_multichip contract: full 5-axis train step."""
+    import importlib, sys
+    sys.path.insert(0, "/root/repo")
+    import __graft_entry__ as g
+    g.dryrun_multichip(8)
+
+
+def test_megatron_loss_decreases():
+    from paddle_tpu.parallel import megatron as M
+    import numpy as np
+    mesh, sizes = M.make_mesh(8)
+    cfg = M.MegatronConfig(lr=5e-3)
+    params, step = M.build_train_step(cfg, mesh)
+    toks = np.random.RandomState(0).randint(
+        0, cfg.vocab_size,
+        (cfg.n_micro, cfg.microbatch * sizes["dp"], cfg.seq_len)).astype("i4")
+    losses = []
+    for _ in range(4):
+        params, loss = step(params, toks)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_megatron_8dev_matches_single_device():
+    """Gold SPMD-correctness test: one train step on the dp2/pp2/tp2 mesh
+    must produce the SAME logical parameters as the identical model run on
+    a 1-device mesh (pp stages folded into one stage). Catches any missing
+    or double-counted cross-rank gradient reduction."""
+    from paddle_tpu.parallel import megatron as M
+    import jax
+
+    # 8-device: pp=2 stages x 2 layers; 1-device: 1 stage x 4 layers.
+    # use_moe off: capacity-based MoE buckets tokens per LOCAL batch, so
+    # its forward differs across dp layouts by design — its gradient
+    # correctness is covered by the loss-decrease test instead.
+    cfg8 = M.MegatronConfig(layers_per_stage=2, lr=1e-2, seq_len=16,
+                            microbatch=2, n_micro=2, hidden=32, n_heads=2,
+                            vocab_size=64, use_moe=False)
+    cfg1 = cfg8._replace(layers_per_stage=4)
+
+    mesh8, sizes8 = M.make_mesh(8)
+    assert sizes8 == {"dp": 2, "pp": 2, "tp": 2, "sp": 1, "ep": 1}
+    mesh1, _ = M.make_mesh(1, devices=jax.devices()[:1])
+
+    p8, step8 = M.build_train_step(cfg8, mesh8)
+    p1, step1 = M.build_train_step(cfg1, mesh1)
+
+    toks = np.random.RandomState(0).randint(
+        0, cfg8.vocab_size, (cfg8.n_micro, cfg8.microbatch * 2,
+                             cfg8.seq_len)).astype("i4")
+
+    # identical logical init (same seed; stage-stacked shapes are row-major
+    # compatible: [2,2,...] vs [1,4,...])
+    for k in p8:
+        a = np.asarray(jax.device_get(p8[k]))
+        b = np.asarray(jax.device_get(p1[k]))
+        np.testing.assert_allclose(a.reshape(b.shape), b, atol=1e-6,
+                                   err_msg=f"init mismatch {k}")
+
+    p8, l8 = step8(p8, toks)
+    p1, l1 = step1(p1, toks)
+    np.testing.assert_allclose(float(l8), float(l1), rtol=1e-4)
+    for k in p8:
+        a = np.asarray(jax.device_get(p8[k]))
+        b = np.asarray(jax.device_get(p1[k]))
+        np.testing.assert_allclose(
+            a.reshape(b.shape), b, atol=5e-4,
+            err_msg=f"param {k} diverged between 8-dev and 1-dev")

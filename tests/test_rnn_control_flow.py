@@ -1,0 +1,236 @@
+"""RNN cells/driver + control flow (SURVEY §2 #9/#10)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, ops
+
+
+def test_lstm_cell_step():
+    cell = nn.LSTMCell(8, 16)
+    x = pt.to_tensor(np.random.randn(4, 8).astype("f4"))
+    h, c = cell.get_initial_states(4)
+    out, (h2, c2) = cell(x, (h, c))
+    assert out.shape == [4, 16] and c2.shape == [4, 16]
+
+
+def test_gru_cell_matches_manual():
+    cell = nn.GRUCell(4, 6)
+    x = np.random.randn(2, 4).astype("f4")
+    h = np.zeros((2, 6), "f4")
+    out, _ = cell(pt.to_tensor(x), pt.to_tensor(h))
+    # manual
+    wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+    bi, bh = cell.bias_ih.numpy(), cell.bias_hh.numpy()
+    gi, gh = x @ wi + bi, h @ wh + bh
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    r = sig(gi[:, :6] + gh[:, :6])
+    z = sig(gi[:, 6:12] + gh[:, 6:12])
+    n = np.tanh(gi[:, 12:] + r * gh[:, 12:])
+    ref = (1 - z) * n + z * h
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_rnn_scan_driver_matches_stepwise():
+    pt.seed(1)
+    cell = nn.LSTMCell(4, 8)
+    xs = np.random.randn(2, 5, 4).astype("f4")  # batch-major [B,T,F]
+    rnn = nn.RNN(cell)
+    ys, (h, c) = rnn(pt.to_tensor(xs))
+    assert ys.shape == [2, 5, 8]
+    # stepwise reference
+    state = cell.get_initial_states(2)
+    outs = []
+    for t in range(5):
+        out, state = cell(pt.to_tensor(xs[:, t]), state)
+        outs.append(out.numpy())
+    np.testing.assert_allclose(ys.numpy(), np.stack(outs, 1), atol=1e-5)
+    np.testing.assert_allclose(h.numpy(), outs[-1], atol=1e-5)
+
+
+def test_rnn_gradients_flow():
+    cell = nn.GRUCell(4, 8)
+    rnn = nn.RNN(cell)
+    xs = pt.to_tensor(np.random.randn(2, 6, 4).astype("f4"),
+                      stop_gradient=False)
+    ys, _ = rnn(xs)
+    ys.sum().backward()
+    assert xs.grad is not None
+    assert cell.weight_ih.grad is not None
+
+
+def test_multilayer_bidirectional_lstm():
+    lstm = nn.LSTM(4, 8, num_layers=2, direction="bidirectional")
+    xs = pt.to_tensor(np.random.randn(3, 7, 4).astype("f4"))
+    ys, finals = lstm(xs)
+    assert ys.shape == [3, 7, 16]
+    assert len(finals) == 2
+
+
+def test_cond_eager_and_traced():
+    # eager concrete: python branch
+    out = ops.cond(pt.to_tensor(True), lambda: pt.to_tensor(1.0),
+                   lambda: pt.to_tensor(2.0))
+    assert float(out.numpy()) == 1.0
+
+    # traced: inside to_static
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(x):
+        return ops.cond(x.sum() > 0,
+                        lambda v: v * 2.0,
+                        lambda v: v - 1.0, operands=(x,))
+
+    a = f(pt.to_tensor(np.array([1.0, 2.0], "f4")))
+    np.testing.assert_allclose(a.numpy(), [2.0, 4.0])
+    b = f(pt.to_tensor(np.array([-5.0, 1.0], "f4")))
+    np.testing.assert_allclose(b.numpy(), [-6.0, 0.0])
+
+
+def test_while_loop_eager():
+    i = pt.to_tensor(0)
+    s = pt.to_tensor(0.0)
+    i2, s2 = ops.while_loop(lambda i, s: i < 5,
+                            lambda i, s: (i + 1, s + 2.0), [i, s])
+    assert int(i2.numpy()) == 5 and float(s2.numpy()) == 10.0
+
+
+def test_while_loop_traced():
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(n):
+        i = pt.zeros((), "int32")
+        acc = pt.zeros((), "float32")
+        i2, acc2 = ops.while_loop(lambda i, a: i < n,
+                                  lambda i, a: (i + 1, a + 3.0), [i, acc])
+        return acc2
+
+    out = f(pt.to_tensor(np.asarray(4, "i4")))
+    assert float(out.numpy()) == 12.0
+
+
+def test_switch_case_and_case():
+    def b0(): return pt.to_tensor(10.0)
+    def b1(): return pt.to_tensor(20.0)
+    def bd(): return pt.to_tensor(-1.0)
+    assert float(ops.switch_case(pt.to_tensor(1), [b0, b1],
+                                 default=bd).numpy()) == 20.0
+    assert float(ops.switch_case(pt.to_tensor(7), [b0, b1],
+                                 default=bd).numpy()) == -1.0
+    out = ops.case([(pt.to_tensor(False), b0), (pt.to_tensor(True), b1)],
+                   default=bd)
+    assert float(out.numpy()) == 20.0
+
+
+def test_inference_predictor():
+    from paddle_tpu.inference import Predictor, Config
+    from paddle_tpu.models import LeNet
+    m = LeNet()
+    pred = Predictor(m)
+    x = np.random.rand(2, 1, 28, 28).astype("f4")
+    out = pred.run(x)
+    assert out.shape == (2, 10)
+    ref = m.eval()(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+    # second call reuses the compiled executable
+    assert len(pred._compiled) == 1
+    pred.run(x)
+    assert len(pred._compiled) == 1
+
+
+def test_native_dataloader_epoch():
+    from paddle_tpu import io
+    ds = io.TensorDataset(np.arange(50, dtype="f4").reshape(50, 1),
+                          np.arange(50, dtype="i4"))
+    dl = io.DataLoader(ds, batch_size=8, shuffle=True, seed=3)
+    assert dl._native_epoch is not None
+    seen = [int(v) for _, yb in dl for v in yb]
+    assert sorted(seen) == list(range(50))
+    seen2 = [int(v) for _, yb in dl for v in yb]
+    assert sorted(seen2) == list(range(50)) and seen != seen2
+
+
+def test_rnn_sequence_length_masks_padding():
+    """Padding steps must not affect outputs or final state."""
+    pt.seed(2)
+    cell = nn.LSTMCell(3, 6)
+    rnn = nn.RNN(cell)
+    xs = np.random.randn(2, 8, 3).astype("f4")
+    lens = np.array([3, 8])
+    ys, (h, c) = rnn(pt.to_tensor(xs), sequence_length=pt.to_tensor(lens))
+    # row 0 outputs beyond t=3 are zero
+    assert np.allclose(ys.numpy()[0, 3:], 0.0)
+    # final state of row 0 equals running only its 3 real steps
+    ys3, (h3, _) = rnn(pt.to_tensor(xs[:1, :3]))
+    np.testing.assert_allclose(h.numpy()[0], h3.numpy()[0], atol=1e-5)
+
+
+def test_reverse_rnn_sequence_length():
+    """Reverse RNN must start each row at its last REAL step."""
+    pt.seed(3)
+    cell = nn.GRUCell(3, 5)
+    rnn_rev = nn.RNN(cell, is_reverse=True)
+    xs = np.random.randn(2, 6, 3).astype("f4")
+    lens = np.array([2, 6])
+    ys, _ = rnn_rev(pt.to_tensor(xs), sequence_length=pt.to_tensor(lens))
+    # row 0: equivalent to reversing just its 2-step prefix
+    ys_ref, _ = rnn_rev(pt.to_tensor(xs[:1, :2]))
+    np.testing.assert_allclose(ys.numpy()[0, :2], ys_ref.numpy()[0],
+                               atol=1e-5)
+
+
+def test_case_traced_requires_default():
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(x):
+        return ops.case([(x.sum() > 0, lambda: x * 2.0)])
+
+    with pytest.raises(ValueError, match="default"):
+        f(pt.to_tensor(np.array([1.0], "f4")))
+
+
+def test_sequence_mask_traced_requires_maxlen():
+    import paddle_tpu.fluid as fluid
+    from paddle_tpu import jit
+
+    @jit.to_static
+    def f(lens):
+        return fluid.layers.sequence_mask(lens)
+
+    with pytest.raises(ValueError, match="maxlen"):
+        f(pt.to_tensor(np.array([2, 3])))
+
+    # explicit maxlen works under trace
+    @jit.to_static
+    def g(lens):
+        return fluid.layers.sequence_mask(lens, maxlen=4)
+
+    np.testing.assert_array_equal(
+        g(pt.to_tensor(np.array([2, 3]))).numpy(),
+        [[1, 1, 0, 0], [1, 1, 1, 0]])
+
+
+def test_dataloader_early_break_restarts_epoch():
+    from paddle_tpu import io
+    ds = io.TensorDataset(np.arange(40, dtype="f4").reshape(40, 1),
+                          np.arange(40, dtype="i4"))
+    dl = io.DataLoader(ds, batch_size=8, shuffle=False)
+    for i, b in enumerate(dl):
+        if i == 1:
+            break
+    # next iteration must be a FULL fresh epoch
+    seen = [int(v) for _, yb in dl for v in yb]
+    assert len(seen) == 40 and sorted(seen) == list(range(40))
+
+
+def test_nce_custom_dist():
+    freqs = np.ones(100, "f4")
+    freqs[:10] = 10.0
+    freqs /= freqs.sum()
+    nce = nn.NCE(100, 8, num_neg_samples=5, custom_dist=freqs)
+    x = pt.to_tensor(np.random.randn(4, 8).astype("f4"))
+    loss = nce(x, pt.to_tensor(np.array([0, 1, 50, 99]))).mean()
+    assert np.isfinite(float(loss.numpy()))
