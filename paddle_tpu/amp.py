@@ -64,39 +64,50 @@ def maybe_cast(*arrays):
 
 class GradScaler:
     """reference: mixed_precision loss scaling (incr/decr dynamic scheme).
-    Needed only for float16; bf16 trains unscaled on TPU."""
+    Needed only for float16; bf16 trains unscaled on TPU.
+
+    Jit-safe design: scale / good / bad counters and the found-inf flag are
+    device scalars, found-inf is ONE fused all-finite reduction over every
+    grad (no per-parameter host sync), and a skipped step is expressed as a
+    ``jnp.where`` select back to the pre-step params/slots — so the whole
+    scaler composes with ``jit.to_static`` (the scaler state rides along as
+    carried Tensors)."""
 
     def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
                  incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
                  decr_every_n_nan_or_inf=1):
         self._enable = enable
-        self._scale = float(init_loss_scaling)
+        self._scale = Tensor(jnp.asarray(init_loss_scaling, jnp.float32),
+                             name="loss_scale")
         self._incr_ratio = incr_ratio
         self._decr_ratio = decr_ratio
         self._incr_every = incr_every_n_steps
         self._decr_every = decr_every_n_nan_or_inf
-        self._good = 0
-        self._bad = 0
+        self._good = Tensor(jnp.zeros((), jnp.int32), name="scaler_good")
+        self._bad = Tensor(jnp.zeros((), jnp.int32), name="scaler_bad")
+
+    def is_enable(self):
+        return self._enable
 
     def scale(self, loss):
         if not self._enable:
             return loss
-        return loss * self._scale
+        return loss * Tensor(self._scale.data)
 
     def unscale_(self, optimizer):
+        """Divide grads by the scale and compute found-inf as a single
+        fused on-device reduction (no host sync, jit-safe)."""
         if not self._enable:
             return
-        import numpy as np
-        inv = 1.0 / self._scale
-        found_inf = False
+        inv = 1.0 / self._scale.data
+        grads = [p._grad for p in optimizer._params() if p._grad is not None]
+        finite = jnp.asarray(True)
+        for g in grads:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
         for p in optimizer._params():
             if p._grad is not None:
-                g = p._grad * inv
-                finite = bool(jax.device_get(jnp.all(jnp.isfinite(g))))
-                if not finite:
-                    found_inf = True
-                p._grad = g
-        self._found_inf = found_inf
+                p._grad = p._grad * inv
+        self._found_inf = jnp.logical_not(finite)
 
     def step(self, optimizer):
         if not self._enable:
@@ -104,20 +115,33 @@ class GradScaler:
             return
         if not hasattr(self, "_found_inf"):
             self.unscale_(optimizer)
-        if self._found_inf:
-            self._bad += 1
-            self._good = 0
-            if self._bad >= self._decr_every:
-                self._scale *= self._decr_ratio
-                self._bad = 0
-            optimizer.clear_grad()
-        else:
-            optimizer.step()
-            self._good += 1
-            self._bad = 0
-            if self._good >= self._incr_every:
-                self._scale *= self._incr_ratio
-                self._good = 0
+        found = self._found_inf  # device bool scalar
+
+        # snapshot, step unconditionally, then select old state back if inf
+        # (slots must exist BEFORE the snapshot or a rolled-back first step
+        # would leave lazily-created accumulators holding the inf update)
+        optimizer._ensure_all_slots()
+        params = [p for p in optimizer._params() if p._grad is not None]
+        old_params = [p.data for p in params]
+        old_slots = [(t, t.data)
+                     for slots in optimizer._accumulators.values()
+                     for t in slots.values()]
+        optimizer.step()
+        for p, old in zip(params, old_params):
+            p.data = jnp.where(found, old, p.data)
+        for t, old in old_slots:
+            t.data = jnp.where(found, old, t.data)
+
+        # dynamic scale bookkeeping, all on device
+        good = jnp.where(found, 0, self._good.data + 1)
+        bad = jnp.where(found, self._bad.data + 1, 0)
+        scale = self._scale.data
+        scale = jnp.where(bad >= self._decr_every, scale * self._decr_ratio,
+                          jnp.where(good >= self._incr_every,
+                                    scale * self._incr_ratio, scale))
+        self._good.data = jnp.where(good >= self._incr_every, 0, good)
+        self._bad.data = jnp.where(bad >= self._decr_every, 0, bad)
+        self._scale.data = scale
         del self._found_inf
 
     def minimize(self, optimizer, scaled_loss):
@@ -129,10 +153,14 @@ class GradScaler:
         pass
 
     def state_dict(self):
-        return {"scale": self._scale, "good": self._good, "bad": self._bad}
+        return {"scale": float(jax.device_get(self._scale.data)),
+                "good": int(jax.device_get(self._good.data)),
+                "bad": int(jax.device_get(self._bad.data))}
 
     def set_state_dict(self, s):
-        self._scale, self._good, self._bad = s["scale"], s["good"], s["bad"]
+        self._scale.data = jnp.asarray(s["scale"], jnp.float32)
+        self._good.data = jnp.asarray(s["good"], jnp.int32)
+        self._bad.data = jnp.asarray(s["bad"], jnp.int32)
 
 
 def decorate(models=None, optimizers=None, level="O1", dtype="bfloat16"):

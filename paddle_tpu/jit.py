@@ -32,11 +32,14 @@ from .optimizer import Optimizer
 from . import random as prandom
 
 
-def _discover_state_objects(fn, models, optimizers):
+def _discover_state_objects(fn, models, optimizers, scalers=None):
+    from .amp import GradScaler
     models = list(models) if models else []
     optimizers = list(optimizers) if optimizers else []
+    scalers = list(scalers) if scalers else []
     seen_m = {id(m) for m in models}
     seen_o = {id(o) for o in optimizers}
+    seen_s = {id(s) for s in scalers}
 
     def visit(obj):
         if isinstance(obj, Layer) and id(obj) not in seen_m:
@@ -45,6 +48,9 @@ def _discover_state_objects(fn, models, optimizers):
         elif isinstance(obj, Optimizer) and id(obj) not in seen_o:
             seen_o.add(id(obj))
             optimizers.append(obj)
+        elif isinstance(obj, GradScaler) and id(obj) not in seen_s:
+            seen_s.add(id(obj))
+            scalers.append(obj)
 
     target = fn
     while hasattr(target, "__wrapped__"):
@@ -58,10 +64,10 @@ def _discover_state_objects(fn, models, optimizers):
                 visit(cell.cell_contents)
             except ValueError:
                 pass
-    return models, optimizers
+    return models, optimizers, scalers
 
 
-def _collect_state(models, optimizers):
+def _collect_state(models, optimizers, scalers=()):
     """Name → Tensor holder map for everything the step may read/mutate."""
     holders = {}
     for mi, m in enumerate(models):
@@ -76,6 +82,10 @@ def _collect_state(models, optimizers):
         for pid, slots in o._accumulators.items():
             for sname, t in slots.items():
                 holders[f"o{oi}.{pid}.{sname}"] = t
+    for si, s in enumerate(scalers):
+        holders[f"s{si}.scale"] = s._scale
+        holders[f"s{si}.good"] = s._good
+        holders[f"s{si}.bad"] = s._bad
     holders["rng"] = prandom.global_key_tensor()
     return holders
 
@@ -84,27 +94,37 @@ class StaticFunction:
     """The compiled callable returned by to_static."""
 
     def __init__(self, fn, models=None, optimizers=None, donate_state=True,
-                 jit_kwargs=None):
+                 jit_kwargs=None, scalers=None):
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__"),
                                  updated=())
         self._fn = fn
         self._models = models
         self._optimizers = optimizers
+        self._scalers = scalers
         self._donate = donate_state
         self._jit_kwargs = jit_kwargs or {}
         self._cache = {}
 
     def _resolve_objects(self):
         if self._models is None or self._optimizers is None:
-            m, o = _discover_state_objects(self._fn, self._models,
-                                           self._optimizers)
+            m, o, s = _discover_state_objects(self._fn, self._models,
+                                              self._optimizers,
+                                              self._scalers)
             self._models, self._optimizers = m, o
-        return self._models, self._optimizers
+            if self._scalers is None:
+                self._scalers = s
+        elif self._scalers is None:
+            # models+optimizers given explicitly: discover ONLY scalers so
+            # closure objects the caller chose to exclude stay excluded
+            _, _, s = _discover_state_objects(self._fn, self._models,
+                                              self._optimizers, None)
+            self._scalers = s
+        return self._models, self._optimizers, self._scalers
 
     def __call__(self, *args, **kwargs):
-        models, optimizers = self._resolve_objects()
-        holders = _collect_state(models, optimizers)
+        models, optimizers, scalers = self._resolve_objects()
+        holders = _collect_state(models, optimizers, scalers)
         state_names = sorted(holders)
 
         # Tensor is a pytree node, so leaves here are raw arrays / scalars.
@@ -152,6 +172,7 @@ class StaticFunction:
     def _make_entry(self, treedef, arr_idx, statics, state_names):
         fn = self._fn
         models, optimizers = self._models, self._optimizers
+        scalers = self._scalers or []
         meta = {}
 
         def traced(state_vals, arrays):
@@ -162,7 +183,7 @@ class StaticFunction:
                 flat[i] = s
             args, kwargs = jax.tree_util.tree_unflatten(treedef, flat)
 
-            hs = _collect_state(models, optimizers)
+            hs = _collect_state(models, optimizers, scalers)
             saved = {}
             try:
                 for name, v in zip(state_names, state_vals):
@@ -200,7 +221,7 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, models=None, optimizers=None,
-              donate_state=True, **kwargs):
+              donate_state=True, scalers=None, **kwargs):
     """Decorator/wrapper: compile a dygraph step into one XLA computation.
 
     reference: paddle.jit.to_static (dygraph_to_static/program_translator.py)
@@ -208,7 +229,7 @@ def to_static(function=None, input_spec=None, models=None, optimizers=None,
     """
     def wrap(fn):
         return StaticFunction(fn, models=models, optimizers=optimizers,
-                              donate_state=donate_state)
+                              donate_state=donate_state, scalers=scalers)
     if function is not None:
         return wrap(function)
     return wrap

@@ -552,7 +552,7 @@ class NCE(Layer):
         import jax
         k = self.num_neg_samples
         n_cls = self.num_total_classes
-        key = prandom.next_key()
+        key = prandom.next_key_graph()  # per-run symbolic key in static
         custom = self._custom_dist
 
         def impl(x, label, w, b, key):
@@ -578,5 +578,5 @@ class NCE(Layer):
                                        jnp.log(k * noise_p + 1e-12))
             return (pos_loss + jnp.sum(neg_loss, axis=-1)).reshape(-1, 1)
 
-        return apply(impl, (input, label, self.weight, self.bias),
-                     dict(key=key), name="nce")
+        return apply(impl, (input, label, self.weight, self.bias, key),
+                     name="nce")

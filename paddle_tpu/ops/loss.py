@@ -143,10 +143,30 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean",
 def binary_cross_entropy_with_logits(logit, label, weight=None,
                                      reduction="mean", pos_weight=None,
                                      name=None):
-    def impl(x, label, reduction):
-        loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    # log-sigmoid formulation: loss = -pos_weight*y*log(sigmoid(x))
+    #                                 - (1-y)*log(1-sigmoid(x)),  then *weight
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+
+    def impl(x, label, *extra, reduction, has_w, has_pw):
+        log_sig = -jnp.log1p(jnp.exp(-jnp.abs(x))) + jnp.minimum(x, 0)
+        log_one_minus = log_sig - x  # log(1 - sigmoid(x)) = log_sigmoid(-x)
+        idx = 0
+        pw = 1.0
+        if has_pw:
+            pw = extra[idx + (1 if has_w else 0)]
+        loss = -(pw * label * log_sig + (1 - label) * log_one_minus)
+        if has_w:
+            loss = loss * extra[0]
         return _reduce(loss, reduction)
-    return apply(impl, (logit, label), dict(reduction=reduction),
+
+    args = [logit, label]
+    if has_w:
+        args.append(weight)
+    if has_pw:
+        args.append(pos_weight)
+    return apply(impl, tuple(args),
+                 dict(reduction=reduction, has_w=has_w, has_pw=has_pw),
                  name="bce_with_logits")
 
 

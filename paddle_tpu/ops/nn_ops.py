@@ -557,7 +557,7 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None,
             return apply(lambda x, p: x * (1 - p), (x,), dict(p=p),
                          name="dropout_infer")
         return x
-    key = prandom.next_key()
+    key = prandom.next_key_graph()  # symbolic per-run key in static mode
 
     def impl(x, key, p, mode, axis):
         shape = x.shape
@@ -569,7 +569,7 @@ def dropout(x, p=0.5, training=True, mode="upscale_in_train", axis=None,
             return jnp.where(keep, x / (1.0 - p), 0.0)
         return jnp.where(keep, x, 0.0)
 
-    return apply(impl, (x,), dict(key=key, p=p, mode=mode, axis=axis),
+    return apply(impl, (x, key), dict(p=p, mode=mode, axis=axis),
                  name="dropout")
 
 

@@ -103,17 +103,22 @@ class Optimizer:
     def step(self):
         """Apply one update from accumulated .grad (reference: dygraph
         minimize path in optimizer.py:Optimizer.apply_gradients)."""
-        params_grads = []
-        for p in self._params():
-            if p.stop_gradient or p._grad is None:
+        params_grads = [(p, p._grad) for p in self._params()
+                        if not (p.stop_gradient or p._grad is None)]
+        # reference order (optimizer.py:apply_gradients): clip raw grads
+        # first, then append the regularization term.
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        regularized = []
+        for p, g in params_grads:
+            if g is None:
+                regularized.append((p, g))
                 continue
-            g = p._grad
             reg = p.regularizer or self._regularization
             if isinstance(reg, WeightDecayRegularizer):
                 g = g + reg.grad_term(p.data)
-            params_grads.append((p, g))
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
+            regularized.append((p, g))
+        params_grads = regularized
         lr = self._lr_tensor.data
         for p, g in params_grads:
             if g is None:
@@ -180,12 +185,19 @@ class Optimizer:
     def set_state_dict(self, state):
         for i, p in enumerate(self._params()):
             pname = p.name or f"param_{i}"
+            if not p.stop_gradient:
+                self._pre_param(p)  # scalar slots (beta pows) get real shapes
             for key, value in state.items():
                 if key.startswith(pname + "@"):
                     sname = key.split("@", 1)[1]
-                    slot = self._slot(p, sname)
-                    slot.set_value(value.data if isinstance(value, Tensor)
-                                   else value)
+                    data = value.data if isinstance(value, Tensor) else value
+                    slots = self._accumulators.setdefault(id(p), {})
+                    if sname in slots:
+                        slots[sname].set_value(data)
+                    else:  # unknown slot: adopt the checkpoint's shape/dtype
+                        arr = jnp.asarray(data)
+                        self._slot(p, sname, shape=arr.shape,
+                                   dtype=arr.dtype).set_value(arr)
         if "__aux__" in state:
             self._aux_state.update(state["__aux__"])
         if "__lr_sched__" in state and self._lr_scheduler is not None:

@@ -49,6 +49,19 @@ def next_key():
     return sub
 
 
+def next_key_graph():
+    """Key for a stochastic *op*: in static-graph mode returns a symbolic
+    key variable that the Executor feeds with a fresh subkey on every run
+    (so recorded dropout masks differ across runs — the reference gets this
+    from stateful curand; XLA needs the key threaded as an input). In
+    dygraph, a concrete subkey."""
+    from . import dispatch
+    if dispatch.in_static_mode():
+        from .static import make_rng_var
+        return make_rng_var()
+    return next_key()
+
+
 def split_keys(n):
     keys = jax.random.split(_global_key.data, n + 1)
     _global_key.data = keys[0]

@@ -119,6 +119,7 @@ class Program:
         self.param_vars = {}      # name -> Parameter (concrete payload)
         self.const_vars = {}      # name -> Tensor (concrete payload)
         self.feed_vars = {}       # name -> StaticVar
+        self.rng_vars = []        # names of per-run PRNG key inputs
         self.optimizers = []      # [(Optimizer, loss_var_name)]
         self.random_seed = None
         Program._counter[0] += 1
@@ -149,6 +150,7 @@ class Program:
         p.param_vars = self.param_vars
         p.const_vars = self.const_vars
         p.feed_vars = self.feed_vars
+        p.rng_vars = self.rng_vars
         p.optimizers = [] if for_test else list(self.optimizers)
         p.random_seed = self.random_seed
         Program._counter[0] += 1
@@ -210,6 +212,21 @@ def data(name, shape, dtype="float32", lod_level=0):
     v = StaticVar(name, shape, dtype, prog, is_feed=True)
     block.vars[name] = v
     prog.feed_vars[name] = v
+    return v
+
+
+def make_rng_var():
+    """Register a per-run PRNG key input (shape (2,) uint32, the raw
+    jax.random.PRNGKey layout). The Executor splits the global key and
+    feeds every rng var a fresh subkey on each run, so stochastic ops
+    recorded in the graph (dropout, …) re-randomize per run instead of
+    baking one mask at record time."""
+    prog = default_main_program()
+    block = prog.global_block()
+    v = StaticVar(prog._unique_name("rng_key"), (2,), jnp.uint32, prog)
+    block.vars[v.name] = v
+    prog.rng_vars.append(v.name)
+    prog.version += 1
     return v
 
 
@@ -390,9 +407,14 @@ class Executor:
                      for oi, pid, sn in slot_names]
         lr_vals = [opt._lr_tensor.data for opt, _ in opt_entries]
         feed_vals = [feed_arrays[k] for k in sorted(feed_arrays)]
+        # fresh subkeys per run for recorded stochastic ops (dropout, …)
+        from .. import random as prandom
+        rng_vals = (list(prandom.split_keys(len(program.rng_vars)))
+                    if program.rng_vars else [])
 
         fetches, new_params, new_slots = compiled(feed_vals, param_vals,
-                                                  slot_vals, lr_vals)
+                                                  slot_vals, lr_vals,
+                                                  rng_vals)
 
         for n, v in zip(param_names, new_params):
             program.param_vars[n].data = v
@@ -408,6 +430,7 @@ class Executor:
         ops = list(program.global_block().ops)
         const_vals = {n: t.data for n, t in program.const_vars.items()}
         opt_entries = program.optimizers
+        rng_names = list(program.rng_vars)
 
         def interpret(env):
             for op in ops:
@@ -420,17 +443,18 @@ class Executor:
                     env[op.outputs[0]] = outs
             return env
 
-        def forward(feed_vals, param_vals):
+        def forward(feed_vals, param_vals, rng_vals):
             env = dict(const_vals)
             env.update(zip(feed_order, feed_vals))
             env.update(zip(param_names, param_vals))
+            env.update(zip(rng_names, rng_vals))
             env = interpret(env)
             return env
 
         trainable_idx = [i for i, n in enumerate(param_names)
                          if not program.param_vars[n].stop_gradient]
 
-        def run_fn(feed_vals, param_vals, slot_vals, lr_vals):
+        def run_fn(feed_vals, param_vals, slot_vals, lr_vals, rng_vals):
             new_params = list(param_vals)
             new_slots = list(slot_vals)
             fetches = None
@@ -443,7 +467,7 @@ class Executor:
                     pv = list(new_params)
                     for j, i in enumerate(trainable_idx):
                         pv[i] = tp[j]
-                    env2 = forward(feed_vals, pv)
+                    env2 = forward(feed_vals, pv, rng_vals)
                     return jnp.sum(env2[loss_name]), env2
 
                 tp = [new_params[i] for i in trainable_idx]
@@ -451,20 +475,23 @@ class Executor:
                 if fetches is None:
                     fetches = [env[n] for n in fetch_names]
 
-                params_grads = []
-                from ..regularizer import WeightDecayRegularizer
-                for j, i in enumerate(trainable_idx):
-                    p = program.param_vars[param_names[i]]
-                    g = grads[j]
-                    reg = p.regularizer or opt._regularization
-                    if isinstance(reg, WeightDecayRegularizer):
-                        g = g + reg.grad_term(new_params[i])
-                    params_grads.append((i, p, g))
+                # reference order: clip raw grads first, then regularize
+                params_grads = [(i, program.param_vars[param_names[i]],
+                                 grads[j])
+                                for j, i in enumerate(trainable_idx)]
                 if opt._grad_clip is not None:
                     clipped = opt._grad_clip([(p, g)
                                               for _, p, g in params_grads])
                     params_grads = [(i, p, g) for (i, p, _), (_, g) in
                                     zip(params_grads, clipped)]
+                from ..regularizer import WeightDecayRegularizer
+                regularized = []
+                for i, p, g in params_grads:
+                    reg = p.regularizer or opt._regularization
+                    if isinstance(reg, WeightDecayRegularizer):
+                        g = g + reg.grad_term(new_params[i])
+                    regularized.append((i, p, g))
+                params_grads = regularized
                 lr = lr_vals[oi]
                 for i, p, g in params_grads:
                     slots = {sn: new_slots[k]
@@ -476,7 +503,7 @@ class Executor:
                         if o2 == oi and pid == id(p) and sn in ns_:
                             new_slots[k] = ns_[sn]
             if fetches is None:
-                env = forward(feed_vals, param_vals)
+                env = forward(feed_vals, param_vals, rng_vals)
                 fetches = [env[n] for n in fetch_names]
             return fetches, new_params, new_slots
 

@@ -1,0 +1,213 @@
+"""Regression tests for advisor findings (round 1 ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer, static, amp
+from paddle_tpu.nn import functional as F
+
+
+def _tiny_model():
+    pt.seed(7)
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+
+
+def test_optimizer_state_dict_roundtrip_fresh_adam():
+    """high: restoring a checkpoint into a FRESH optimizer used to crash on
+    scalar beta-pow slots (slot lazily created with the param's shape)."""
+    m = _tiny_model()
+    o = optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    x = pt.to_tensor(np.random.randn(8, 4).astype("f4"))
+    y = pt.to_tensor(np.random.randn(8, 2).astype("f4"))
+    for _ in range(3):
+        loss = F.mse_loss(m(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    state = o.state_dict()
+
+    o2 = optimizer.Adam(learning_rate=1e-3, parameters=m.parameters())
+    o2.set_state_dict(state)  # must not raise
+    for p in m.parameters():
+        if p.stop_gradient:
+            continue
+        slots = o._accumulators[id(p)]
+        slots2 = o2._accumulators[id(p)]
+        for sname in ("moment1", "moment2", "beta1_pow", "beta2_pow"):
+            np.testing.assert_allclose(np.asarray(slots2[sname].data),
+                                       np.asarray(slots[sname].data))
+            assert slots2[sname].data.shape == slots[sname].data.shape
+
+    # and the restored optimizer continues training identically
+    loss = F.mse_loss(m(x), y)
+    loss.backward()
+    o2.step()
+    o2.clear_grad()
+
+
+def test_static_dropout_varies_across_runs():
+    """medium: static-mode dropout used to bake one mask at record time."""
+    pt.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            xv = static.data("x", [32, 64], "float32")
+            out = F.dropout(xv, p=0.5, training=True)
+        exe = static.Executor()
+        x = np.ones((32, 64), "f4")
+        a = exe.run(prog, feed={"x": x}, fetch_list=[out])[0]
+        b = exe.run(prog, feed={"x": x}, fetch_list=[out])[0]
+    finally:
+        pt.disable_static()
+    assert not np.array_equal(a, b), "dropout mask identical across runs"
+    # upscale_in_train keeps the expectation about right
+    assert 0.5 < a.mean() < 1.5
+
+
+def test_bce_with_logits_weight_and_pos_weight():
+    """medium: weight / pos_weight used to be silently ignored."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(6, 3).astype("f4")
+    y = (rs.rand(6, 3) > 0.5).astype("f4")
+    w = rs.rand(6, 3).astype("f4") + 0.5
+    pw = rs.rand(3).astype("f4") + 0.5
+
+    def ref(x, y, w=None, pw=None):
+        log_sig = -np.log1p(np.exp(-np.abs(x))) + np.minimum(x, 0)
+        log_1m = log_sig - x
+        pwv = pw if pw is not None else 1.0
+        loss = -(pwv * y * log_sig + (1 - y) * log_1m)
+        if w is not None:
+            loss = loss * w
+        return loss.mean()
+
+    got = F.binary_cross_entropy_with_logits(
+        pt.to_tensor(x), pt.to_tensor(y))
+    np.testing.assert_allclose(float(got.numpy()), ref(x, y), rtol=1e-5)
+
+    got = F.binary_cross_entropy_with_logits(
+        pt.to_tensor(x), pt.to_tensor(y), weight=pt.to_tensor(w))
+    np.testing.assert_allclose(float(got.numpy()), ref(x, y, w=w), rtol=1e-5)
+
+    got = F.binary_cross_entropy_with_logits(
+        pt.to_tensor(x), pt.to_tensor(y), pos_weight=pt.to_tensor(pw))
+    np.testing.assert_allclose(float(got.numpy()), ref(x, y, pw=pw),
+                               rtol=1e-5)
+
+    got = F.binary_cross_entropy_with_logits(
+        pt.to_tensor(x), pt.to_tensor(y), weight=pt.to_tensor(w),
+        pos_weight=pt.to_tensor(pw))
+    np.testing.assert_allclose(float(got.numpy()), ref(x, y, w=w, pw=pw),
+                               rtol=1e-5)
+
+    # matches torch's reference implementation
+    torch = pytest.importorskip("torch")
+    tref = torch.nn.functional.binary_cross_entropy_with_logits(
+        torch.tensor(x), torch.tensor(y), weight=torch.tensor(w),
+        pos_weight=torch.tensor(pw)).item()
+    got = F.binary_cross_entropy_with_logits(
+        pt.to_tensor(x), pt.to_tensor(y), weight=pt.to_tensor(w),
+        pos_weight=pt.to_tensor(pw))
+    np.testing.assert_allclose(float(got.numpy()), tref, rtol=1e-5)
+
+
+def test_clip_before_regularization():
+    """low: reference clips RAW grads first, then appends regularization."""
+    from paddle_tpu.clip import ClipGradByGlobalNorm
+    from paddle_tpu.regularizer import L2Decay
+
+    p = pt.Parameter(np.ones(4, "f4") * 2.0)
+    p._grad = pt.to_tensor(np.ones(4, "f4") * 10.0).data
+    o = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                      grad_clip=ClipGradByGlobalNorm(1.0),
+                      weight_decay=L2Decay(0.1))
+    o.step()
+    # clip first: g=10*4 -> norm=20, clipped to g=0.5 each; then +0.1*2.0
+    expect = 2.0 - 1.0 * (0.5 + 0.2)
+    np.testing.assert_allclose(np.asarray(p.data), expect, rtol=1e-5)
+
+
+def test_grad_scaler_on_device_and_skips_inf_step():
+    m = _tiny_model()
+    o = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=4.0,
+                            decr_every_n_nan_or_inf=1, incr_every_n_steps=2)
+    x = pt.to_tensor(np.random.randn(8, 4).astype("f4"))
+    y = pt.to_tensor(np.random.randn(8, 2).astype("f4"))
+
+    before = [np.asarray(p.data).copy() for p in m.parameters()]
+    loss = scaler.scale(F.mse_loss(m(x), y))
+    loss.backward()
+    scaler.step(o)
+    o.clear_grad()
+    after = [np.asarray(p.data) for p in m.parameters()]
+    assert any(not np.allclose(b, a) for b, a in zip(before, after))
+    s0 = scaler.state_dict()
+    assert s0["scale"] == 4.0 and s0["good"] == 1
+
+    # poison one grad -> step must be skipped, scale halved
+    before = [np.asarray(p.data).copy() for p in m.parameters()]
+    loss = scaler.scale(F.mse_loss(m(x), y))
+    loss.backward()
+    params = list(m.parameters())
+    params[0]._grad = params[0]._grad * np.float32("inf")
+    scaler.step(o)
+    o.clear_grad()
+    after = [np.asarray(p.data) for p in m.parameters()]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)
+    s1 = scaler.state_dict()
+    assert s1["scale"] == 2.0 and s1["good"] == 0
+
+
+def test_grad_scaler_first_step_inf_keeps_adam_slots_clean():
+    """Rollback of the VERY FIRST step must not leave lazily-created Adam
+    slots holding the inf update (slots are ensured before snapshot)."""
+    m = _tiny_model()
+    o = optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=2.0 ** 15)
+    x = pt.to_tensor(np.random.randn(8, 4).astype("f4"))
+    y = pt.to_tensor(np.random.randn(8, 2).astype("f4"))
+    loss = scaler.scale(F.mse_loss(m(x), y))
+    loss.backward()
+    params = list(m.parameters())
+    params[0]._grad = params[0]._grad * np.float32("inf")
+    scaler.step(o)
+    o.clear_grad()
+    for p in params:
+        if p.stop_gradient:
+            continue
+        slots = o._accumulators[id(p)]
+        assert np.isfinite(np.asarray(slots["moment1"].data)).all()
+        assert float(slots["beta1_pow"].data) == 1.0
+    # next good step trains normally
+    loss = scaler.scale(F.mse_loss(m(x), y))
+    loss.backward()
+    scaler.step(o)
+    for p in params:
+        assert np.isfinite(np.asarray(p.data)).all()
+
+
+def test_grad_scaler_composes_with_to_static():
+    from paddle_tpu import jit
+    m = _tiny_model()
+    o = optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=8.0, incr_every_n_steps=3)
+    x = pt.to_tensor(np.random.randn(16, 4).astype("f4"))
+    y = pt.to_tensor(np.random.randn(16, 2).astype("f4"))
+
+    def step(x, y):
+        loss = F.mse_loss(m(x), y)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.unscale_(o)
+        scaler.step(o)
+        o.clear_grad()
+        return loss
+
+    cstep = jit.to_static(step, models=[m], optimizers=[o],
+                          scalers=[scaler])
+    vals = [float(cstep(x, y).numpy()) for _ in range(6)]
+    assert vals[-1] < vals[0]
+    # dynamic scale growth happened inside the compiled step
+    assert scaler.state_dict()["scale"] == 32.0
