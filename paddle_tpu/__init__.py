@@ -46,6 +46,9 @@ from . import models
 from . import utils
 from . import inference
 from . import fluid
+from . import reader
+from .reader import batch
+from . import dataset
 
 # dygraph/static mode management (reference: fluid.enable_dygraph /
 # paddle.enable_static). Dygraph is the default here (modern surface).

@@ -1,0 +1,19 @@
+"""paddle_tpu.dataset — the dataset zoo (reference:
+python/paddle/dataset/__init__.py). Real files are used when cached under
+``common.DATA_HOME``; otherwise deterministic synthetic corpora with the
+reference's exact sample formats keep everything runnable offline (see
+common.py)."""
+from . import common
+from . import mnist
+from . import cifar
+from . import imdb
+from . import imikolov
+from . import uci_housing
+from . import movielens
+from . import wmt16
+from . import conll05
+from . import sentiment
+from . import flowers
+
+__all__ = ["common", "mnist", "cifar", "imdb", "imikolov", "uci_housing",
+           "movielens", "wmt16", "conll05", "sentiment", "flowers"]

@@ -1,0 +1,59 @@
+"""paddle_tpu.dataset.common — dataset cache + offline fallback.
+
+TPU-native rebuild of reference python/paddle/dataset/common.py (DATA_HOME,
+download-with-md5 cache, reader conversion helpers).
+
+Offline policy: the reference downloads from public mirrors at import
+time. This environment may have zero egress, so every dataset module
+first looks for real files under ``DATA_HOME`` (drop the reference's
+files there and they are used as-is) and otherwise *generates a
+deterministic synthetic corpus with the exact sample format* of the real
+dataset (shapes, dtypes, vocab semantics, label ranges). That keeps every
+pipeline, model config, and test runnable end-to-end offline; swapping in
+the real files changes the numbers, not the code."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as np
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset"))
+
+
+def data_path(*parts):
+    return os.path.join(DATA_HOME, *parts)
+
+
+def has_real(*parts):
+    return os.path.exists(data_path(*parts))
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Reference-compatible signature. Returns the cached path if present;
+    raises with a clear offline message otherwise (no egress here)."""
+    fname = data_path(module_name,
+                      save_name or url.split("/")[-1])
+    if os.path.exists(fname) and (md5sum is None or
+                                  md5file(fname) == md5sum):
+        return fname
+    raise RuntimeError(
+        f"dataset file {fname} not cached and this environment has no "
+        f"network egress; place the file there manually (source: {url}) "
+        f"or use the synthetic fallback readers")
+
+
+def rng_for(name):
+    """Deterministic per-dataset generator for synthetic fallbacks."""
+    seed = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4],
+                          "little")
+    return np.random.RandomState(seed)

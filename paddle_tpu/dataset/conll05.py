@@ -1,0 +1,64 @@
+"""CoNLL-2005 SRL-style sequence labeling (reference:
+python/paddle/dataset/conll05.py — word/predicate/label dicts + test
+reader yielding word ids, context features, predicate, and BIO label
+sequence). Synthetic fallback: label sequences generated from a hidden
+Markov chain conditioned on word ids — learnable by the CRF/sequence
+stack."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+WORD_VOCAB = 4000
+PRED_VOCAB = 300
+NUM_LABELS = 19  # BIO over 9 roles + O
+TEST_N = 500
+
+
+def get_dict():
+    word_dict = {f"w{i}": i for i in range(WORD_VOCAB)}
+    verb_dict = {f"v{i}": i for i in range(PRED_VOCAB)}
+    label_dict = {f"L{i}": i for i in range(NUM_LABELS)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    rs = common.rng_for("conll05-emb")
+    return rs.randn(WORD_VOCAB, 32).astype("f4")
+
+
+def _samples(n, seed_name):
+    rs = common.rng_for(seed_name)
+    # hidden transition structure for labels + word->label affinity
+    trans = rs.dirichlet(np.ones(NUM_LABELS) * 0.3, size=NUM_LABELS)
+    emit_affinity = rs.randint(0, NUM_LABELS, (WORD_VOCAB,))
+    out = []
+    for _ in range(n):
+        length = int(rs.randint(5, 30))
+        words = rs.randint(0, WORD_VOCAB, (length,)).astype("int64")
+        pred = int(rs.randint(0, PRED_VOCAB))
+        labels = np.zeros(length, "int64")
+        state = int(rs.randint(0, NUM_LABELS))
+        for i, w in enumerate(words):
+            if rs.rand() < 0.5:
+                state = int(emit_affinity[w])
+            else:
+                state = int(rs.choice(NUM_LABELS, p=trans[state]))
+            labels[i] = state
+        # reference yields 8 context slices + predicate + mark + labels;
+        # we keep (words, predicate, labels) — the learnable core
+        out.append((list(words), pred, list(labels)))
+    return out
+
+
+def test():
+    data = _samples(TEST_N, "conll05-test")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def fetch():
+    pass

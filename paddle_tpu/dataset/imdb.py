@@ -1,0 +1,59 @@
+"""IMDB sentiment (reference: python/paddle/dataset/imdb.py — word_dict,
+train/test readers yielding ([word ids], 0/1 label)).
+
+Synthetic fallback: a two-regime unigram language — positive and negative
+reviews draw from shifted word distributions over a shared vocab — so
+bag-of-words / sequence-conv models actually separate the classes."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+VOCAB = 5000
+TRAIN_N = 3000
+TEST_N = 500
+
+
+def word_dict():
+    """word -> id (ids 0..VOCAB-1; the reference appends <unk> last)."""
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+def _samples(n, seed_name):
+    rs = common.rng_for(seed_name)
+    # two smooth unigram distributions whose mass is shifted apart
+    ranks = np.arange(1, VOCAB + 1, dtype="f8")
+    base = 1.0 / ranks
+    pos = base * (1.0 + 0.8 * np.sin(ranks * 0.01))
+    neg = base * (1.0 + 0.8 * np.cos(ranks * 0.01))
+    pos /= pos.sum()
+    neg /= neg.sum()
+    out = []
+    for _ in range(n):
+        label = int(rs.randint(0, 2))
+        length = int(rs.randint(20, 120))
+        dist = pos if label else neg
+        ids = rs.choice(VOCAB, size=length, p=dist).astype("int64")
+        out.append((list(ids), label))
+    return out
+
+
+def train(word_idx=None):
+    data = _samples(TRAIN_N, "imdb-train")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def test(word_idx=None):
+    data = _samples(TEST_N, "imdb-test")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def fetch():
+    pass

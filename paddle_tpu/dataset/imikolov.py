@@ -1,0 +1,71 @@
+"""PTB-style n-gram LM data (reference: python/paddle/dataset/imikolov.py —
+word_dict via build_dict, train/test readers yielding n-gram tuples or
+seq data). Synthetic fallback: a Markov-chain corpus over a Zipf vocab so
+word2vec/NGram models have real bigram structure to learn."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+VOCAB = 2000
+TRAIN_SENTS = 2000
+TEST_SENTS = 200
+
+
+def build_dict(min_word_freq=50):
+    return {f"w{i}": i for i in range(VOCAB)}
+
+
+word_dict = build_dict
+
+
+def _sentences(n, seed_name):
+    rs = common.rng_for(seed_name)
+    # sparse random Markov transitions give learnable bigram stats;
+    # the chain is split-independent so train and test share statistics
+    next_words = common.rng_for("imikolov-chain").randint(
+        0, VOCAB, (VOCAB, 5)).astype("int64")
+    out = []
+    for _ in range(n):
+        length = int(rs.randint(5, 25))
+        w = int(rs.randint(0, VOCAB))
+        sent = [w]
+        for _ in range(length - 1):
+            w = int(next_words[w, rs.randint(0, 5)])
+            sent.append(w)
+        out.append(sent)
+    return out
+
+
+def _reader(sents, word_idx, n, data_type):
+    def creator():
+        for sent in sents:
+            if data_type == DataType.NGRAM:
+                if len(sent) < n:
+                    continue
+                for i in range(n - 1, len(sent)):
+                    yield tuple(sent[i - n + 1:i + 1])
+            else:
+                yield sent[:-1], sent[1:]
+    return creator
+
+
+def train(word_idx=None, n=5, data_type=DataType.NGRAM):
+    return _reader(_sentences(TRAIN_SENTS, "imikolov-train"), word_idx, n,
+                   data_type)
+
+
+def test(word_idx=None, n=5, data_type=DataType.NGRAM):
+    return _reader(_sentences(TEST_SENTS, "imikolov-test"), word_idx, n,
+                   data_type)
+
+
+def fetch():
+    pass

@@ -1,0 +1,116 @@
+"""MovieLens-1M style CTR/recommendation data (reference:
+python/paddle/dataset/movielens.py — MovieInfo/UserInfo, train/test
+readers yielding (user_id, gender, age, job, movie_id, categories,
+title_ids, rating)). Synthetic fallback: preference structure =
+low-rank user×movie affinity so Wide&Deep/DeepFM models learn signal."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+NUM_USERS = 800
+NUM_MOVIES = 600
+NUM_CATEGORIES = 18
+TITLE_VOCAB = 1000
+MAX_JOB = 21
+AGES = [1, 18, 25, 35, 45, 50, 56]
+TRAIN_N = 6000
+TEST_N = 800
+
+
+class MovieInfo:
+    def __init__(self, index, categories, title):
+        self.index = index
+        self.categories = categories
+        self.title = title
+
+
+class UserInfo:
+    def __init__(self, index, gender, age, job):
+        self.index = index
+        self.is_male = gender == "M"
+        self.age = age
+        self.job_id = job
+
+
+def _tables():
+    rs = common.rng_for("movielens-tables")
+    movies = {}
+    for i in range(NUM_MOVIES):
+        cats = list(rs.choice(NUM_CATEGORIES,
+                              size=int(rs.randint(1, 4)), replace=False))
+        title = list(rs.randint(0, TITLE_VOCAB, (int(rs.randint(2, 6)),)))
+        movies[i] = MovieInfo(i, cats, title)
+    users = {}
+    for i in range(NUM_USERS):
+        users[i] = UserInfo(i, "M" if rs.rand() < 0.5 else "F",
+                            int(rs.choice(AGES)),
+                            int(rs.randint(0, MAX_JOB)))
+    u = rs.randn(NUM_USERS, 8).astype("f4")
+    m = rs.randn(NUM_MOVIES, 8).astype("f4")
+    return movies, users, u, m
+
+
+def movie_info():
+    return _tables()[0]
+
+
+def user_info():
+    return _tables()[1]
+
+
+def max_user_id():
+    return NUM_USERS
+
+
+def max_movie_id():
+    return NUM_MOVIES
+
+
+def max_job_id():
+    return MAX_JOB - 1
+
+
+def age_table():
+    return list(AGES)
+
+
+def categories():
+    return [f"cat{i}" for i in range(NUM_CATEGORIES)]
+
+
+def _samples(n, seed_name):
+    movies, users, u, m = _tables()
+    rs = common.rng_for(seed_name)
+    out = []
+    for _ in range(n):
+        ui = int(rs.randint(0, NUM_USERS))
+        mi = int(rs.randint(0, NUM_MOVIES))
+        aff = float(u[ui] @ m[mi]) / 8.0
+        rating = int(np.clip(round(3 + aff + rs.randn() * 0.3), 1, 5))
+        usr, mov = users[ui], movies[mi]
+        age_idx = AGES.index(usr.age)
+        out.append((ui, int(usr.is_male), age_idx, usr.job_id, mi,
+                    mov.categories, mov.title, float(rating)))
+    return out
+
+
+def train():
+    data = _samples(TRAIN_N, "movielens-train")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def test():
+    data = _samples(TEST_N, "movielens-test")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def fetch():
+    pass

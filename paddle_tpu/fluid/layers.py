@@ -26,6 +26,19 @@ from ..ops.math import *  # noqa: F401,F403
 from ..ops.manip import *  # noqa: F401,F403
 from ..ops.creation import *  # noqa: F401,F403
 from ..ops.nn_ops import *  # noqa: F401,F403
+from ..ops.sequence import (sequence_pool, sequence_softmax,  # noqa: F401
+                            sequence_reverse, sequence_expand,
+                            sequence_pad, sequence_unpad, sequence_concat,
+                            sequence_conv, sequence_slice,
+                            sequence_expand_as, sequence_reshape,
+                            sequence_scatter, sequence_enumerate,
+                            sequence_first_step, sequence_last_step)
+from ..ops.crf import linear_chain_crf, crf_decoding  # noqa: F401
+from ..ops.ctc import warpctc, ctc_greedy_decoder  # noqa: F401
+from ..nn.decode import (BeamSearchDecoder, dynamic_decode,  # noqa: F401
+                         gather_tree, TrainingHelper,
+                         GreedyEmbeddingHelper, SamplingEmbeddingHelper,
+                         BasicDecoder)
 from ..ops.loss import (softmax_with_cross_entropy,  # noqa: F401
                         sigmoid_cross_entropy_with_logits,
                         square_error_cost, huber_loss, kl_div, log_loss,

@@ -41,11 +41,16 @@ def _discover_state_objects(fn, models, optimizers, scalers=None):
     seen_o = {id(o) for o in optimizers}
     seen_s = {id(s) for s in scalers}
 
+    def _is_optimizer(obj):
+        # a fleet.DistributedOptimizer duck-types Optimizer around `inner`
+        return isinstance(obj, Optimizer) or isinstance(
+            getattr(obj, "inner", None), Optimizer)
+
     def visit(obj):
         if isinstance(obj, Layer) and id(obj) not in seen_m:
             seen_m.add(id(obj))
             models.append(obj)
-        elif isinstance(obj, Optimizer) and id(obj) not in seen_o:
+        elif _is_optimizer(obj) and id(obj) not in seen_o:
             seen_o.add(id(obj))
             optimizers.append(obj)
         elif isinstance(obj, GradScaler) and id(obj) not in seen_s:

@@ -144,6 +144,56 @@ class Transformer(nn.Layer):
         memory = self.encode(src_ids, cross_mask)
         return self.decode(tgt_ids, memory, cross_mask)
 
+    def generate(self, src_ids, beam_size=4, max_len=32, bos_id=1,
+                 eos_id=2):
+        """Beam-search translation (reference: the WMT book config decodes
+        with fluid BeamSearchDecoder/dynamic_decode, layers/rnn.py:687).
+
+        TPU formulation: the 'cell state' is the fixed-width token prefix
+        buffer + a step counter; every step re-decodes the causal prefix
+        (static [B*K, T_max] shapes; a KV-cache incremental decoder is a
+        later optimization) and beam bookkeeping runs in
+        nn.decode.dynamic_decode's lax.while_loop.
+
+        Returns (ids [B, T, K], scores [B, K])."""
+        import jax
+        import jax.numpy as jnp
+        from ..nn.decode import BeamSearchDecoder, dynamic_decode
+        from ..tensor import Tensor
+
+        was_training = self.training
+        self.eval()
+        try:
+            memory = self.encode(src_ids)
+            mem = BeamSearchDecoder.tile_beam_merge_with_batch(memory,
+                                                               beam_size)
+            b = src_ids.shape[0]
+            t_max = int(max_len)
+            model = self
+
+            class _PrefixCell:
+                def __call__(self, tokens, states):
+                    buf, t = states
+                    tcur = t.data.reshape(-1)[0]
+                    buf_arr = buf.data.at[:, tcur].set(
+                        tokens.data.reshape(-1).astype(jnp.int32))
+                    logits = model.decode(Tensor(buf_arr), mem)
+                    out = jax.lax.dynamic_index_in_dim(
+                        logits.data, tcur, axis=1, keepdims=False)
+                    return Tensor(out), (Tensor(buf_arr),
+                                         Tensor(t.data + 1))
+
+            decoder = BeamSearchDecoder(_PrefixCell(), bos_id, eos_id,
+                                        beam_size)
+            init = (Tensor(jnp.full((b, t_max), eos_id, jnp.int32)),
+                    Tensor(jnp.zeros((b, 1), jnp.int32)))
+            ids, scores = dynamic_decode(decoder, init,
+                                         max_step_num=t_max)
+            return ids, scores
+        finally:
+            if was_training:
+                self.train()
+
     def loss(self, logits, labels, pad_id=0):
         """Label-smoothed CE averaged over non-pad tokens (reference:
         label_smooth + softmax_with_cross_entropy(soft_label=True))."""

@@ -16,6 +16,10 @@ from .layers import (
 )
 from .loss import (CrossEntropyLoss, MSELoss, L1Loss, SmoothL1Loss, BCELoss,
                    BCEWithLogitsLoss, KLDivLoss, NLLLoss, MarginRankingLoss)
+from .decode import (Decoder, BeamSearchDecoder, dynamic_decode,
+                     gather_tree, DecodeHelper, TrainingHelper,
+                     GreedyEmbeddingHelper, SamplingEmbeddingHelper,
+                     BasicDecoder, basic_decode)
 from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, LSTM,
                   GRU, SimpleRNN, StaticRNN)
 from . import functional

@@ -1,0 +1,365 @@
+"""paddle_tpu.nn.decode — RNN decoding: dynamic_decode, beam search,
+decode helpers.
+
+TPU-native rebuild of the reference decoding stack
+(reference: python/paddle/fluid/layers/rnn.py — Decoder:576,
+BeamSearchDecoder:687, dynamic_decode:1147, DecodeHelper:1382,
+TrainingHelper:1444, GreedyEmbeddingHelper:1597, BasicDecoder:1829; and
+the C++ gather_tree_op).
+
+Redesign: the reference builds a while-op sub-block with LoDTensorArrays
+and grows outputs dynamically; XLA needs static shapes, so here
+``dynamic_decode`` drives a ``lax.while_loop`` whose carry holds
+fixed-size ``[max_step, ...]`` output buffers written by index — early
+termination still happens (the loop predicate stops when every beam is
+finished) but buffers never change shape. Beam state rides as
+``[batch, beam, ...]`` arrays, beam reordering is one gather per step,
+and the final backtrace (``gather_tree``) is a reverse ``lax.scan``.
+Inference-only: runs under ``no_grad`` (tracer-safe through the Layer
+dispatch), so the whole decode jits into one XLA while loop.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .. import autograd
+
+NEG_INF = -1e9
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x.data if isinstance(x, Tensor) else jnp.asarray(x), tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(lambda a: Tensor(a), tree)
+
+
+class Decoder:
+    """Decoder protocol (reference: layers/rnn.py:576). Subclasses
+    implement initialize/step/finalize over raw jnp arrays."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """reference: layers/rnn.py:687. Wraps a cell; each step scores
+    ``beam_size`` continuations per batch row and keeps the top-k.
+
+    cell: RNNCell-like Layer ((input, states) -> (output, new_states));
+    embedding_fn maps ``[B, K]`` ids to inputs; output_fn maps cell output
+    to vocab logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[B, ...] -> [B*beam, ...] (repeat each row beam_size times) —
+        for tensors used inside cell.call (e.g. attention memory)."""
+        a = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        tiled = jnp.repeat(a, beam_size, axis=0)
+        return Tensor(tiled) if isinstance(x, Tensor) else tiled
+
+    def _merge(self, x):
+        # [B, K, ...] -> [B*K, ...]
+        return x.reshape((-1,) + x.shape[2:])
+
+    def _split(self, x, b):
+        return x.reshape((b, self.beam_size) + x.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        states = _unwrap(initial_cell_states)
+        b = jax.tree_util.tree_leaves(states)[0].shape[0]
+        k = self.beam_size
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(s, k, axis=0), states)  # [B*K, ...]
+        tokens = jnp.full((b, k), self.start_token, jnp.int32)
+        # only beam 0 is live initially so the k start beams don't
+        # duplicate the same hypothesis
+        cum_lp = jnp.tile(jnp.array([0.0] + [NEG_INF] * (k - 1),
+                                    jnp.float32)[None, :], (b, 1))
+        finished = jnp.zeros((b, k), bool)
+        return (tokens, cum_lp, finished), states
+
+    def step(self, time, beam_state, cell_states):
+        tokens, cum_lp, finished = beam_state
+        b, k = tokens.shape
+
+        with autograd.no_grad():
+            emb = self.embedding_fn(Tensor(tokens)) if self.embedding_fn \
+                else Tensor(tokens)
+            emb = _unwrap(emb)
+            emb = self._merge(emb)
+            out, new_states = self.cell(Tensor(emb), _wrap(cell_states))
+            out = _unwrap(out)
+            if self.output_fn is not None:
+                out = _unwrap(self.output_fn(Tensor(out)))
+        new_states = _unwrap(new_states)
+
+        v = out.shape[-1]
+        lp = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+        lp = self._split(lp, b)                                 # [B, K, V]
+        # finished beams may only extend with end_token, at no cost
+        end_only = jnp.full((v,), NEG_INF, jnp.float32).at[
+            self.end_token].set(0.0)
+        lp = jnp.where(finished[:, :, None], end_only[None, None, :], lp)
+
+        total = cum_lp[:, :, None] + lp                         # [B, K, V]
+        flat = total.reshape(b, k * v)
+        new_lp, idx = jax.lax.top_k(flat, k)                    # [B, K]
+        parent = (idx // v).astype(jnp.int32)
+        token = (idx % v).astype(jnp.int32)
+
+        prev_finished = jnp.take_along_axis(finished, parent, axis=1)
+        new_finished = prev_finished | (token == self.end_token)
+
+        # reorder cell states by parent beam
+        gidx = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        new_states = jax.tree_util.tree_map(lambda s: s[gidx], new_states)
+
+        return ((token, new_lp, new_finished), new_states,
+                dict(token=token, parent=parent,
+                     prev_finished=prev_finished))
+
+    def finalize(self, step_tokens, step_parents, lengths, final_lp):
+        """Backtrace the beam ancestry (the reference's gather_tree op)."""
+        ids = gather_tree(step_tokens, step_parents, self.end_token)
+        return ids, final_lp
+
+
+def gather_tree(ids, parents, end_token=0):
+    """reference: C++ gather_tree_op (exposed as fluid.layers.gather_tree).
+    ids/parents: [T, B, K] -> full sequences [T, B, K] following each
+    final beam's ancestry back through time (reverse lax.scan)."""
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents)
+    t, b, k = ids.shape
+    beam = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (b, k))
+
+    def back(cursor, inp):
+        ids_t, parents_t = inp
+        tok = jnp.take_along_axis(ids_t, cursor, axis=1)
+        prev = jnp.take_along_axis(parents_t, cursor, axis=1)
+        return prev, tok
+
+    _, toks = jax.lax.scan(back, beam, (ids, parents), reverse=True)
+    return toks  # [T, B, K]
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=64,
+                   output_time_major=False, return_length=False, **kwargs):
+    """reference: layers/rnn.py:1147 dynamic_decode. Runs the decoder until
+    every beam emits end_token or ``max_step_num`` is hit.
+
+    Returns (ids, final_scores) — ids ``[B, T, K]`` (or time-major), plus
+    lengths when ``return_length``."""
+    (tokens0, cum0, fin0), states0 = decoder.initialize(inits)
+    b, k = tokens0.shape
+    t_max = int(max_step_num)
+
+    tok_buf = jnp.zeros((t_max, b, k), jnp.int32)
+    par_buf = jnp.zeros((t_max, b, k), jnp.int32)
+
+    def cond(carry):
+        t, beam_state, states, tok_buf, par_buf, lengths = carry
+        _, _, finished = beam_state
+        return jnp.logical_and(t < t_max, ~jnp.all(finished))
+
+    def body(carry):
+        t, beam_state, states, tok_buf, par_buf, lengths = carry
+        new_beam, new_states, rec = decoder.step(t, beam_state, states)
+        tok_buf = tok_buf.at[t].set(rec["token"])
+        par_buf = par_buf.at[t].set(rec["parent"])
+        lengths = lengths + (~rec["prev_finished"]).astype(jnp.int32)
+        return (t + 1, new_beam, new_states, tok_buf, par_buf, lengths)
+
+    carry0 = (jnp.asarray(0), (tokens0, cum0, fin0), states0, tok_buf,
+              par_buf, jnp.zeros((b, k), jnp.int32))
+    t, (tokens, cum_lp, finished), states, tok_buf, par_buf, lengths = \
+        jax.lax.while_loop(cond, body, carry0)
+
+    # pad the un-run tail so gather_tree passes finished beams through
+    steps = jnp.arange(t_max)[:, None, None]
+    tok_buf = jnp.where(steps < t, tok_buf, decoder.end_token
+                        if hasattr(decoder, "end_token") else 0)
+    par_buf = jnp.where(steps < t,
+                        par_buf,
+                        jnp.broadcast_to(
+                            jnp.arange(k, dtype=jnp.int32)[None, None, :],
+                            (t_max, b, k)))
+
+    if hasattr(decoder, "finalize") and isinstance(decoder,
+                                                   BeamSearchDecoder):
+        ids, scores = decoder.finalize(tok_buf, par_buf, lengths, cum_lp)
+    else:
+        ids, scores = decoder.finalize(tok_buf, par_buf, lengths)
+
+    if not output_time_major:
+        ids = jnp.moveaxis(ids, 0, 1)  # [B, T, K]
+    out = (Tensor(ids), Tensor(scores))
+    if return_length:
+        out = out + (Tensor(lengths),)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# helper-based decoding (reference: DecodeHelper:1382 family)
+
+class DecodeHelper:
+    """Protocol: initialize() -> (inputs, finished); sample(); next_inputs()
+    (reference: layers/rnn.py:1382)."""
+
+
+class TrainingHelper(DecodeHelper):
+    """Teacher forcing: feed the gold inputs step by step
+    (reference: layers/rnn.py:1444)."""
+
+    def __init__(self, inputs, sequence_length, time_major=False):
+        x = inputs.data if isinstance(inputs, Tensor) else jnp.asarray(
+            inputs)
+        self.inputs = x if time_major else jnp.moveaxis(x, 0, 1)  # [T, B,.]
+        self.sequence_length = jnp.asarray(
+            sequence_length.data if isinstance(sequence_length, Tensor)
+            else sequence_length, jnp.int32)
+
+    def initialize(self):
+        finished = self.sequence_length <= 0
+        return self.inputs[0], finished
+
+    def sample(self, time, outputs):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int32)
+
+    def next_inputs(self, time, outputs, sample_ids):
+        t = time + 1
+        finished = t >= self.sequence_length
+        nxt = self.inputs[jnp.minimum(t, self.inputs.shape[0] - 1)]
+        return finished, nxt
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Feed back argmax ids through an embedding
+    (reference: layers/rnn.py:1597)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self.embedding_fn = embedding_fn
+        self.start_tokens = jnp.asarray(
+            start_tokens.data if isinstance(start_tokens, Tensor)
+            else start_tokens, jnp.int32)
+        self.end_token = int(end_token)
+
+    def initialize(self):
+        finished = jnp.zeros_like(self.start_tokens, bool)
+        with autograd.no_grad():
+            emb = _unwrap(self.embedding_fn(Tensor(self.start_tokens)))
+        return emb, finished
+
+    def sample(self, time, outputs):
+        return jnp.argmax(outputs, axis=-1).astype(jnp.int32)
+
+    def next_inputs(self, time, outputs, sample_ids):
+        finished = sample_ids == self.end_token
+        with autograd.no_grad():
+            emb = _unwrap(self.embedding_fn(Tensor(sample_ids)))
+        return finished, emb
+
+
+class SamplingEmbeddingHelper(GreedyEmbeddingHelper):
+    """Sample ids from the output distribution
+    (reference: layers/rnn.py sampling helper)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self._seed = seed
+
+    def sample(self, time, outputs):
+        from .. import random as prandom
+        key = jax.random.PRNGKey(self._seed + 0) if self._seed is not None \
+            else prandom.next_key()
+        key = jax.random.fold_in(key, time)
+        return jax.random.categorical(key, outputs).astype(jnp.int32)
+
+
+class BasicDecoder(Decoder):
+    """Cell + helper decoding (reference: layers/rnn.py:1829). Emits
+    (cell_output, sample_id) per step; driven by basic_decode below."""
+
+    def __init__(self, cell, helper, output_fn=None):
+        self.cell = cell
+        self.helper = helper
+        self.output_fn = output_fn
+
+    def initialize(self, inits):
+        inputs, finished = self.helper.initialize()
+        return inputs, _unwrap(inits), finished
+
+    def step(self, time, inputs, states):
+        with autograd.no_grad():
+            out, new_states = self.cell(Tensor(inputs), _wrap(states))
+            out = _unwrap(out)
+            if self.output_fn is not None:
+                out = _unwrap(self.output_fn(Tensor(out)))
+        sample_ids = self.helper.sample(time, out)
+        finished, next_inputs = self.helper.next_inputs(time, out,
+                                                        sample_ids)
+        return (out, sample_ids), next_inputs, _unwrap(new_states), finished
+
+
+def basic_decode(decoder, inits, max_step_num=64, output_time_major=False):
+    """Drive a BasicDecoder (helper-based). Returns (outputs, sample_ids)
+    as [B, T, ...] / [B, T] plus lengths."""
+    inputs0, states0, fin0 = decoder.initialize(inits)
+    t_max = int(max_step_num)
+
+    # probe one step for output shapes; the probe result is discarded, so
+    # restore the global PRNG key afterwards (a sampling helper would
+    # otherwise consume a key and shift the random stream)
+    from .. import random as prandom
+    _key_holder = prandom.global_key_tensor()
+    _saved_key = _key_holder.data
+    (out0, sid0), _, _, _ = decoder.step(jnp.asarray(0), inputs0, states0)
+    _key_holder.data = _saved_key
+    b = sid0.shape[0]
+    out_buf = jnp.zeros((t_max,) + out0.shape, out0.dtype)
+    sid_buf = jnp.zeros((t_max,) + sid0.shape, jnp.int32)
+
+    def cond(carry):
+        t, inputs, states, finished, out_buf, sid_buf, lengths = carry
+        return jnp.logical_and(t < t_max, ~jnp.all(finished))
+
+    def body(carry):
+        t, inputs, states, finished, out_buf, sid_buf, lengths = carry
+        (out, sids), nxt, new_states, new_fin = decoder.step(t, inputs,
+                                                             states)
+        out_buf = out_buf.at[t].set(out)
+        sid_buf = sid_buf.at[t].set(sids)
+        lengths = lengths + (~finished).astype(jnp.int32)
+        return (t + 1, nxt, new_states, finished | new_fin, out_buf,
+                sid_buf, lengths)
+
+    carry0 = (jnp.asarray(0), inputs0, states0, fin0, out_buf, sid_buf,
+              jnp.zeros((b,), jnp.int32))
+    t, _, _, _, out_buf, sid_buf, lengths = jax.lax.while_loop(cond, body,
+                                                               carry0)
+    if not output_time_major:
+        out_buf = jnp.moveaxis(out_buf, 0, 1)
+        sid_buf = jnp.moveaxis(sid_buf, 0, 1)
+    return Tensor(out_buf), Tensor(sid_buf), Tensor(lengths)

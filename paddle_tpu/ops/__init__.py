@@ -139,4 +139,12 @@ del _patch
 from . import sequence  # noqa: F401
 from .sequence import (sequence_pool, sequence_softmax,  # noqa: F401
                        sequence_reverse, sequence_expand, sequence_pad,
-                       sequence_unpad, sequence_concat)
+                       sequence_unpad, sequence_concat, sequence_conv,
+                       sequence_slice, sequence_expand_as,
+                       sequence_reshape, sequence_scatter,
+                       sequence_enumerate, sequence_first_step,
+                       sequence_last_step)
+from . import crf  # noqa: F401
+from .crf import linear_chain_crf, crf_decoding  # noqa: F401
+from . import ctc  # noqa: F401
+from .ctc import ctc_loss, warpctc, ctc_greedy_decoder  # noqa: F401

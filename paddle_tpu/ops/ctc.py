@@ -1,0 +1,168 @@
+"""paddle_tpu.ops.ctc — CTC loss and decoding.
+
+TPU-native rebuild of the reference's CTC stack
+(reference: paddle/fluid/operators/warpctc_op.cc — which wraps the warpctc
+CUDA library — and fluid/layers/nn.py:ctc_greedy_decoder, layers/loss.py:
+warpctc).
+
+Redesign: the warpctc library is a GPU-side ragged kernel; on TPU the CTC
+forward-backward is expressed directly as a ``lax.scan`` over time on the
+log-alpha lattice of the padded extended label sequence ([B, 2L+1]),
+batched over sequences — XLA fuses the whole recurrence, and the gradient
+is jax autodiff of the forward pass (which equals the classic
+forward-backward gradient). No ragged tensors: inputs are padded
+``[B, T, C]`` logits + per-sequence input/label lengths, the layout TPU
+wants anyway.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import as_tensor
+from ..dispatch import apply
+
+NEG_INF = -1e30
+
+
+def _ctc_nll(log_probs, labels, input_len, label_len, blank):
+    """log_probs: [B, T, C] (log-softmaxed), labels: [B, L] int,
+    returns nll [B] (fp32)."""
+    b, t, c = log_probs.shape
+    l = labels.shape[1]
+    s = 2 * l + 1
+
+    labels = labels.astype(jnp.int32)
+    input_len = input_len.astype(jnp.int32)
+    label_len = label_len.astype(jnp.int32)
+
+    # extended sequence: blank, y0, blank, y1, ..., blank
+    ext = jnp.full((b, s), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    pos = jnp.arange(s)[None, :]
+    in_ext = pos < (2 * label_len + 1)[:, None]
+
+    # "can skip" from s-2: ext[s] is a label and differs from ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=blank)[:, :s]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    def emit(lp_t, idx):
+        # lp_t: [B, C] -> [B, S] log-prob of each extended symbol
+        return jnp.take_along_axis(lp_t, idx, axis=1)
+
+    lp0 = emit(log_probs[:, 0], ext)
+    alpha0 = jnp.full((b, s), NEG_INF, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(lp0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.where(label_len > 0, lp0[:, 1],
+                                           NEG_INF))
+
+    def step(alpha, inp):
+        lp_t, tstep = inp
+        a_prev = alpha
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)),
+                       constant_values=NEG_INF)[:, :s]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)),
+                       constant_values=NEG_INF)[:, :s]
+        a_m2 = jnp.where(can_skip, a_m2, NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(a_prev, a_m1), a_m2)
+        new = merged + emit(lp_t, ext)
+        new = jnp.where(in_ext, new, NEG_INF)
+        keep = (tstep < input_len)[:, None]
+        return jnp.where(keep, new, alpha), None
+
+    alpha, _ = jax.lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(log_probs[:, 1:], 1, 0), jnp.arange(1, t)))
+
+    # total = logaddexp(alpha[2*label_len], alpha[2*label_len - 1])
+    idx_last = (2 * label_len)[:, None]
+    idx_prev = jnp.maximum(2 * label_len - 1, 0)[:, None]
+    a_last = jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0]
+    a_prev = jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0]
+    a_prev = jnp.where(label_len > 0, a_prev, NEG_INF)
+    return -jnp.logaddexp(a_last, a_prev)
+
+
+def ctc_loss(logits, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC loss over padded batches (paddle.nn.functional.ctc_loss /
+    reference warpctc semantics, TPU formulation).
+
+    logits: [B, T, C] UNnormalized; labels: [B, L] int (padded);
+    input_lengths/label_lengths: [B]."""
+    def impl(logits, labels, ilen, llen, blank, reduction, norm_by_times):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = _ctc_nll(lp, labels, ilen, llen, blank)
+        if norm_by_times:
+            nll = nll / jnp.maximum(ilen.astype(jnp.float32), 1.0)
+        if reduction == "mean":
+            # torch/paddle 'mean': per-sample loss / label_len, then mean
+            return jnp.mean(nll / jnp.maximum(
+                llen.astype(jnp.float32), 1.0))
+        if reduction == "sum":
+            return jnp.sum(nll)
+        return nll
+
+    return apply(impl,
+                 (logits, as_tensor(labels), as_tensor(input_lengths),
+                  as_tensor(label_lengths)),
+                 dict(blank=blank, reduction=reduction,
+                      norm_by_times=norm_by_times),
+                 name="ctc_loss")
+
+
+def warpctc(input, label, input_length=None, label_length=None, blank=0,
+            norm_by_times=False, name=None):
+    """reference: fluid/layers/loss.py:499 warpctc — returns the
+    per-sequence loss [B, 1] (no reduction)."""
+    x = as_tensor(input)
+    t = x.shape[1] if x.ndim == 3 else None
+    if input_length is None:
+        b = x.shape[0]
+        input_length = np.full((b,), t, np.int32)
+    if label_length is None:
+        # valid labels can never equal blank in CTC, so both the usual
+        # 0-padded batches (blank=0) and -1-padded batches count correctly
+        lab = np.asarray(jax.device_get(as_tensor(label).data))
+        label_length = ((lab >= 0) & (lab != blank)).sum(-1).astype(
+            np.int32)
+    out = ctc_loss(x, label, input_length, label_length, blank=blank,
+                   reduction="none", norm_by_times=norm_by_times)
+    from .manip import unsqueeze
+    return unsqueeze(out, -1)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=-1,
+                       name=None):
+    """reference: fluid/layers/nn.py:5115 ctc_greedy_decoder — argmax per
+    step, merge repeats, drop blanks. Padded formulation: returns
+    (decoded [B, T] padded with `padding_value`, out_lengths [B])."""
+    def impl(x, *maybe_len, blank, padding_value):
+        b, t, c = x.shape
+        ln = maybe_len[0].astype(jnp.int32) if maybe_len else jnp.full(
+            (b,), t, jnp.int32)
+        best = jnp.argmax(x, axis=-1).astype(jnp.int32)    # [B, T]
+        prev = jnp.pad(best, ((0, 0), (1, 0)), constant_values=-1)[:, :t]
+        valid = (jnp.arange(t)[None, :] < ln[:, None])
+        keep = (best != blank) & (best != prev) & valid
+
+        # stable compaction: target position = cumsum(keep) - 1
+        tgt = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+        out_len = jnp.max(jnp.where(keep, tgt + 1, 0), axis=1)
+
+        # scatter kept symbols to compacted slots; slot t is the discard
+        # bin for dropped steps (trimmed off)
+        def compact(row_best, row_keep, row_tgt):
+            buf = jnp.full((t + 1,), padding_value, jnp.int32)
+            idx = jnp.where(row_keep, row_tgt, t)
+            return buf.at[idx].set(jnp.where(row_keep, row_best,
+                                             padding_value))[:t]
+
+        decoded = jax.vmap(compact)(best, keep, tgt)
+        return decoded, out_len
+
+    args = (input,) if input_length is None else (input,
+                                                  as_tensor(input_length))
+    return apply(impl, args, dict(blank=blank, padding_value=padding_value),
+                 nondiff=True, n_out=2, name="ctc_greedy_decoder")

@@ -54,8 +54,14 @@ def _bwd_kernel(x_ref, w_ref, mu_ref, rstd_ref, g_ref, dx_ref, dw_ref,
     m1 = jnp.mean(dxhat, axis=1, keepdims=True)
     m2 = jnp.mean(dxhat * xhat, axis=1, keepdims=True)
     dx_ref[:] = (rstd * (dxhat - m1 - xhat * m2)).astype(dx_ref.dtype)
-    dw_ref[:] = jnp.sum(g * xhat, axis=0, keepdims=True)
-    db_ref[:] = jnp.sum(g, axis=0, keepdims=True)
+    # dw/db accumulate into ONE (1, d) output block revisited by every
+    # grid step — TPU grids run sequentially, so += is a sound reduction
+    @pl.when(i == 0)
+    def _init():
+        dw_ref[:] = jnp.zeros_like(dw_ref)
+        db_ref[:] = jnp.zeros_like(db_ref)
+    dw_ref[:] += jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_ref[:] += jnp.sum(g, axis=0, keepdims=True)
 
 
 def _run_fwd(x2, w, b, eps):
@@ -119,18 +125,18 @@ def _ln_bwd(eps, res, g):
         ],
         out_specs=[
             pl.BlockSpec((br, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, d), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, d), lambda i: (0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, d), x2.dtype),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
-            jax.ShapeDtypeStruct((nblocks, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
         ],
         interpret=interpret_mode(),
     )(x2, w.reshape(1, d), mu, rstd, g)
-    dw = jnp.sum(dw_part, axis=0).astype(w.dtype)
-    db = jnp.sum(db_part, axis=0).astype(w.dtype)
+    dw = dw_part[0].astype(w.dtype)
+    db = db_part[0].astype(w.dtype)
     return dx, dw, db
 
 

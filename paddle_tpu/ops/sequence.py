@@ -129,3 +129,141 @@ def sequence_unpad(x, length, name=None):
 def sequence_concat(xs, name=None):
     from .manip import concat
     return concat(xs, axis=1)
+
+
+def sequence_first_step(x, length=None, name=None):
+    """reference: sequence_lod.py:sequence_first_step (pool 'first')."""
+    return sequence_pool(x, "first", length=length)
+
+
+def sequence_last_step(x, length=None, name=None):
+    """reference: sequence_lod.py:sequence_last_step (pool 'last')."""
+    return sequence_pool(x, "last", length=length)
+
+
+def sequence_conv(x, weight, bias=None, filter_size=3, padding_start=None,
+                  length=None, name=None):
+    """reference: sequence_conv_op (sequence_lod.py:44). Context-window
+    convolution over time: each step t sees steps
+    [t + padding_start, t + padding_start + filter_size) with zero padding
+    outside the valid prefix; the stacked context is projected by `weight`
+    ([filter_size * D, num_filters]).
+
+    TPU-first: the context stack is built with static rolls (filter_size is
+    a compile-time constant) and the projection is ONE MXU matmul; positions
+    beyond `length` are masked to zero, matching LoD boundaries."""
+    if padding_start is None:
+        # reference default (sequence_lod.py:155): -int(filter_size // 2)
+        padding_start = -int(filter_size // 2)
+    has_bias = bias is not None
+    has_len = length is not None
+
+    def impl(x, w, *rest, filter_size, padding_start, has_bias, has_len):
+        bvals = rest[0] if has_bias else None
+        ln = rest[1 if has_bias else 0] if has_len else None
+        b, t, d = x.shape
+        if ln is None:
+            ln = jnp.full((b,), t, jnp.int32)
+        m = _mask(ln, t, 1)
+        xz = jnp.where(m, x, 0.0)
+        cols = []
+        pos = jnp.arange(t)
+        for j in range(filter_size):
+            off = padding_start + j
+            shifted = jnp.roll(xz, -off, axis=1)
+            src = pos + off
+            ok = (src >= 0) & (src < ln[:, None])
+            cols.append(jnp.where(ok[..., None], shifted, 0.0))
+        ctx = jnp.concatenate(cols, axis=-1)  # [B, T, fs*D]
+        out = jnp.einsum("btk,kf->btf", ctx, w)
+        if bvals is not None:
+            out = out + bvals
+        return jnp.where(m, out, 0.0)
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(bias)
+    if length is not None:
+        args.append(as_tensor(length))
+    return apply(impl, tuple(args),
+                 dict(filter_size=filter_size, padding_start=padding_start,
+                      has_bias=has_bias, has_len=has_len),
+                 name="sequence_conv")
+
+
+def sequence_slice(x, offset, length_per_seq, name=None):
+    """reference: sequence_slice_op — per-row slice [offset, offset+len)
+    re-packed at the start of each row (padded layout). Output keeps the
+    static [B, T, ...] shape; valid width per row is `length_per_seq`."""
+    def impl(x, off, sl):
+        b, t = x.shape[:2]
+        idx = jnp.arange(t)[None, :] + off[:, None]
+        idx = jnp.clip(idx, 0, t - 1)
+        gathered = jnp.take_along_axis(
+            x, idx.reshape(b, t, *([1] * (x.ndim - 2))).astype(jnp.int32),
+            axis=1)
+        m = _mask(sl.astype(jnp.int32), t, x.ndim - 2)
+        return jnp.where(m, gathered, 0)
+
+    return apply(impl, (x, as_tensor(offset), as_tensor(length_per_seq)),
+                 name="sequence_slice")
+
+
+def sequence_expand_as(x, y_length, maxlen=None, name=None):
+    """reference: sequence_expand_as_op — row i of x is repeated to the
+    width of sequence i: output [B, T, ...] where out[i, t] = x[i] for
+    t < y_length[i], else 0. (Padded-batch formulation of the LoD
+    broadcast; `maxlen` = static T, defaults to max(y_length) which then
+    must be concrete.)"""
+    ln = as_tensor(y_length)
+    if maxlen is None:
+        maxlen = int(np.asarray(jax.device_get(ln.data)).max())
+
+    def impl(x, ln, t):
+        out = jnp.broadcast_to(x[:, None], (x.shape[0], t) + x.shape[1:])
+        m = _mask(ln, t, x.ndim - 1)
+        return jnp.where(m, out, 0)
+
+    return apply(impl, (x, ln), dict(t=int(maxlen)),
+                 name="sequence_expand_as")
+
+
+def sequence_reshape(x, new_dim, name=None):
+    """reference: sequence_reshape_op — refold the feature dim: [B, T, D]
+    -> [B, T*D/new_dim, new_dim]."""
+    def impl(x, new_dim):
+        b = x.shape[0]
+        return x.reshape(b, -1, new_dim)
+    return apply(impl, (x,), dict(new_dim=new_dim), name="sequence_reshape")
+
+
+def sequence_scatter(x, index, updates, name=None):
+    """reference: sequence_scatter_op — per-row scatter-add: for row b,
+    x[b, index[b, j]] += updates[b, j]."""
+    def impl(x, idx, upd):
+        def row(xr, ir, ur):
+            return xr.at[ir].add(ur)
+        return jax.vmap(row)(x, idx.astype(jnp.int32), upd)
+    return apply(impl, (x, as_tensor(index), updates),
+                 name="sequence_scatter")
+
+
+def sequence_enumerate(x, win_size, pad_value=0, length=None, name=None):
+    """reference: sequence_enumerate_op — sliding windows of ids:
+    [B, T] -> [B, T, win_size]; positions past the valid prefix (or past
+    the end of a window) are pad_value."""
+    def impl(x, *maybe_len, win_size, pad_value):
+        b, t = x.shape[:2]
+        ln = maybe_len[0] if maybe_len else jnp.full((b,), t, jnp.int32)
+        pos = jnp.arange(t)[None, :, None] + jnp.arange(win_size)[None, None]
+        ok = pos < ln[:, None, None]
+        idx = jnp.clip(pos, 0, t - 1)
+        # gather x[b, idx[b, t, w]] along the time axis
+        win = jnp.take_along_axis(
+            jnp.broadcast_to(x[:, :, None], (b, t, win_size)), idx, axis=1)
+        return jnp.where(ok, win, pad_value)
+
+    args = (as_tensor(x),) if length is None else (as_tensor(x),
+                                                   as_tensor(length))
+    return apply(impl, args, dict(win_size=win_size, pad_value=pad_value),
+                 nondiff=True, name="sequence_enumerate")

@@ -375,15 +375,26 @@ def _loss_fn(params_local, tokens, cfg):
     y = _ln(y, params_local["lnf_w"], params_local["lnf_b"])
     logits = jnp.einsum("...h,vh->...v", y, params_local["embed"])
 
-    # next-token loss within local seq block
+    # next-token loss. The label of a local block's LAST position is the
+    # FIRST token of the next sp shard — fetched with one ppermute over the
+    # sp ring (a roll within the local block would pair sequence-boundary
+    # tokens with wrong labels). Only the globally-last position has no
+    # label.
     logp = jax.nn.log_softmax(logits, axis=-1)
-    labels = jnp.roll(tokens, -1, axis=-1)
+    first_tok = tokens[..., :1]
+    sp_perm = [(i, (i - 1) % sp) for i in range(sp)]  # rank r+1 -> r
+    next_first = lax.ppermute(first_tok, "sp", sp_perm)
+    labels = jnp.concatenate([tokens[..., 1:], next_first], axis=-1)
     picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    mask = jnp.ones_like(picked).at[..., -1].set(0.0)
-    loss = -jnp.sum(picked * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    # average over dp and sp (tokens split over both)
+    is_last_sp = (sp_r == sp - 1)
+    mask = jnp.ones_like(picked).at[..., -1].set(
+        jnp.where(is_last_sp, 0.0, 1.0))
+    # global token-weighted mean: psum numerator/denominator over the axes
+    # that split tokens (sp), then average over dp
+    num = lax.psum(-jnp.sum(picked * mask), "sp")
+    den = lax.psum(jnp.sum(mask), "sp")
+    loss = num / jnp.maximum(den, 1.0)
     loss = lax.pmean(loss, "dp")
-    loss = lax.pmean(loss, "sp")
     return loss
 
 

@@ -1,0 +1,266 @@
+"""paddle_tpu.reader — the composable reader algebra.
+
+TPU-native rebuild of the reference's reader decorators
+(reference: python/paddle/reader/decorator.py — cache:36, map_readers:60,
+shuffle:102, chain:151, compose:216, buffered:276, firstn:319,
+xmap_readers:364, multiprocess_reader:457; and fluid.io.batch).
+
+A *reader creator* is a zero-arg callable returning a generator of
+samples. Decorators wrap creators into new creators. The implementation is
+plain Python (host-side pipeline feeding the device), with threads for the
+buffered/xmap stages — the TPU analogue of the reference's
+multiprocess+pipe readers, which exist to keep the accelerator fed; the
+heavy lifting on this side lives in the C++ batcher (io.native)."""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _pyrandom
+import threading
+
+import numpy as np
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "batch"]
+
+
+def cache(reader):
+    """Cache the first COMPLETE pass in memory; later passes replay it.
+    A partially-consumed pass (early break) is discarded, not cached."""
+    cached = []
+    done = [False]
+
+    def creator():
+        if done[0]:
+            yield from cached
+            return
+        this_pass = []
+        for item in reader():
+            this_pass.append(item)
+            yield item
+        cached[:] = this_pass  # only a finished pass becomes the cache
+        done[0] = True
+
+    return creator
+
+
+def map_readers(func, *readers):
+    """Zip several readers and map func over the tuples."""
+    def creator():
+        its = [r() for r in readers]
+        for items in zip(*its):
+            yield func(*items)
+
+    return creator
+
+
+def shuffle(reader, buf_size):
+    """Pool-based shuffle with a bounded buffer."""
+    def creator():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) >= buf_size:
+                _pyrandom.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _pyrandom.shuffle(buf)
+            yield from buf
+
+    return creator
+
+
+def chain(*readers):
+    """Concatenate readers end to end."""
+    def creator():
+        for r in readers:
+            yield from r()
+
+    return creator
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: (a, b), (c) -> (a, b, c).
+    check_alignment=True raises if lengths differ."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def _flatten(item):
+        if isinstance(item, tuple):
+            return item
+        return (item,)
+
+    def creator():
+        its = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*its):
+                if any(i is None for i in items):
+                    raise ValueError(
+                        "compose: readers have different lengths")
+                yield sum((_flatten(i) for i in items), ())
+        else:
+            for items in zip(*its):
+                yield sum((_flatten(i) for i in items), ())
+
+    return creator
+
+
+def buffered(reader, size):
+    """Producer thread fills a bounded queue; consumer drains it —
+    overlaps host preprocessing with device steps."""
+    _end = object()
+
+    def creator():
+        q = queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for item in reader():
+                    q.put(item)
+            finally:
+                q.put(_end)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _end:
+                break
+            yield item
+
+    return creator
+
+
+def firstn(reader, n):
+    """Limit to the first n samples."""
+    def creator():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+
+    return creator
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map with a thread pool (the reference forks processes for
+    the GIL; numpy preprocessing releases it, so threads suffice and avoid
+    fork+TPU-client hazards)."""
+    _end = object()
+
+    class _Raised:
+        def __init__(self, exc):
+            self.exc = exc
+
+    def creator():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            # sentinel delivery is unconditional so a raising reader can't
+            # deadlock the consumer; the error is forwarded and re-raised
+            try:
+                for i, item in enumerate(reader()):
+                    in_q.put((i, item))
+            except Exception as e:  # noqa: BLE001
+                out_q.put(_Raised(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_end)
+
+        def work():
+            try:
+                while True:
+                    got = in_q.get()
+                    if got is _end:
+                        break
+                    i, item = got
+                    out_q.put((i, mapper(item)))
+            except Exception as e:  # noqa: BLE001
+                out_q.put(_Raised(e))
+            finally:
+                out_q.put(_end)
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                got = out_q.get()
+                if got is _end:
+                    finished += 1
+                    continue
+                if isinstance(got, _Raised):
+                    raise got.exc
+                yield got[1]
+        else:
+            pending = {}
+            nxt = 0
+            while finished < process_num or pending:
+                if nxt in pending:
+                    yield pending.pop(nxt)
+                    nxt += 1
+                    continue
+                if finished == process_num:
+                    break  # workers gone but a hole remains (item dropped)
+                got = out_q.get()
+                if got is _end:
+                    finished += 1
+                    continue
+                if isinstance(got, _Raised):
+                    raise got.exc
+                i, item = got
+                if i == nxt:
+                    yield item
+                    nxt += 1
+                else:
+                    pending[i] = item
+
+    return creator
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Run several readers concurrently, interleaving their output
+    (thread-backed; see xmap_readers note)."""
+    _end = object()
+
+    def creator():
+        q = queue.Queue(queue_size)
+
+        def run(r):
+            try:
+                for item in r():
+                    q.put(item)
+            finally:
+                q.put(_end)
+
+        for r in readers:
+            threading.Thread(target=run, args=(r,), daemon=True).start()
+        finished = 0
+        while finished < len(readers):
+            item = q.get()
+            if item is _end:
+                finished += 1
+                continue
+            yield item
+
+    return creator
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size (fluid.io.batch /
+    paddle.batch parity)."""
+    def creator():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return creator

@@ -1,0 +1,127 @@
+"""Tests for paddle_tpu.reader decorators and paddle_tpu.dataset loaders
+(reference: python/paddle/reader/tests/decorator_test.py and
+dataset/tests/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import reader as R
+from paddle_tpu import dataset
+
+
+def _counter(n):
+    def creator():
+        yield from range(n)
+    return creator
+
+
+def test_reader_decorators_compose():
+    r = R.firstn(_counter(100), 10)
+    assert list(r()) == list(range(10))
+
+    r = R.map_readers(lambda a, b: a + b, _counter(5), _counter(5))
+    assert list(r()) == [0, 2, 4, 6, 8]
+
+    r = R.chain(_counter(3), _counter(2))
+    assert list(r()) == [0, 1, 2, 0, 1]
+
+    r = R.compose(_counter(3), R.map_readers(lambda x: (x, x * 2),
+                                             _counter(3)))
+    assert list(r()) == [(0, 0, 0), (1, 1, 2), (2, 2, 4)]
+
+    with pytest.raises(ValueError):
+        list(R.compose(_counter(3), _counter(4))())
+
+    r = R.shuffle(_counter(20), buf_size=8)
+    got = list(r())
+    assert sorted(got) == list(range(20))
+
+    r = R.buffered(_counter(50), size=8)
+    assert list(r()) == list(range(50))
+
+    r = R.cache(_counter(5))
+    assert list(r()) == list(r()) == [0, 1, 2, 3, 4]
+
+    r = R.batch(_counter(7), batch_size=3)
+    bs = list(r())
+    assert bs == [[0, 1, 2], [3, 4, 5], [6]]
+    r = R.batch(_counter(7), batch_size=3, drop_last=True)
+    assert list(r()) == [[0, 1, 2], [3, 4, 5]]
+
+
+def test_xmap_and_multiprocess_readers():
+    r = R.xmap_readers(lambda x: x * 10, _counter(30), 4, 8, order=True)
+    assert list(r()) == [i * 10 for i in range(30)]
+    r = R.xmap_readers(lambda x: x * 10, _counter(30), 4, 8, order=False)
+    assert sorted(list(r())) == [i * 10 for i in range(30)]
+    r = R.multiprocess_reader([_counter(10), _counter(10)])
+    assert sorted(list(r())) == sorted(list(range(10)) * 2)
+
+
+def test_mnist_format_and_determinism():
+    imgs, labels = dataset.mnist.train_arrays()
+    assert imgs.shape[1] == 784 and imgs.dtype == np.float32
+    assert imgs.min() >= -1.0 and imgs.max() <= 1.0
+    assert set(np.unique(labels)).issubset(set(range(10)))
+    imgs2, labels2 = dataset.mnist.train_arrays()
+    np.testing.assert_array_equal(imgs, imgs2)  # deterministic
+
+    sample = next(dataset.mnist.train()())
+    assert sample[0].shape == (784,) and isinstance(sample[1], int)
+
+
+def test_cifar_imdb_imikolov_formats():
+    img, lab = next(dataset.cifar.train10()())
+    assert img.shape == (3072,) and 0 <= lab < 10
+    img, lab = next(dataset.cifar.train100()())
+    assert 0 <= lab < 100
+
+    ids, lab = next(dataset.imdb.train()())
+    assert lab in (0, 1) and all(0 <= i < dataset.imdb.VOCAB for i in ids)
+
+    gram = next(dataset.imikolov.train(n=5)())
+    assert len(gram) == 5
+
+    src, trg_in, trg_next = next(dataset.wmt16.train()())
+    assert trg_in[0] == dataset.wmt16.BOS
+    assert trg_next[-1] == dataset.wmt16.EOS
+    assert len(trg_in) == len(trg_next)
+
+    x, y = next(dataset.uci_housing.train()())
+    assert x.shape == (13,)
+
+    u, g, age, job, m, cats, title, rating = next(
+        dataset.movielens.train()())
+    assert 1.0 <= rating <= 5.0
+
+    words, pred, labels = next(dataset.conll05.test()())
+    assert len(words) == len(labels)
+
+
+def test_mnist_pipeline_trains_lenet():
+    """End-to-end: dataset -> reader decorators -> batch -> train. The
+    synthetic MNIST must be learnable (accuracy well above chance)."""
+    from paddle_tpu import nn, optimizer
+    import paddle_tpu.nn.functional as F
+
+    pt.seed(0)
+    train_reader = R.batch(
+        R.shuffle(R.firstn(dataset.mnist.train(), 2000), buf_size=512),
+        batch_size=128)
+
+    model = nn.Sequential(nn.Linear(784, 64), nn.ReLU(),
+                          nn.Linear(64, 10))
+    o = optimizer.Adam(learning_rate=3e-3, parameters=model.parameters())
+    for epoch in range(4):
+        for batch in train_reader():
+            x = np.stack([s[0] for s in batch])
+            y = np.array([s[1] for s in batch], "i4")
+            loss = F.cross_entropy(model(pt.to_tensor(x)), pt.to_tensor(y))
+            loss.backward()
+            o.step()
+            o.clear_grad()
+
+    imgs, labels = dataset.mnist.test_arrays()
+    logits = model(pt.to_tensor(imgs[:500])).numpy()
+    acc = (logits.argmax(-1) == labels[:500]).mean()
+    assert acc > 0.7, acc

@@ -1,0 +1,115 @@
+"""Fleet API -> sharded user-model training (VERDICT r1 item 4).
+
+Trains the zoo BERT through fleet.init + distributed_model +
+distributed_optimizer on the 8-device CPU mesh (dp=2 × tp=4) and checks
+the losses match a single-device run of the same model step for step —
+i.e. GSPMD partitioning with Megatron param placement is semantically
+invisible. (reference: fluid/incubate/fleet/collective/__init__.py)"""
+import numpy as np
+import pytest
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer, jit
+from paddle_tpu.models.bert import Bert, BertConfig, BertForPretraining
+import paddle_tpu.parallel.fleet as fleet_mod
+from paddle_tpu.parallel.fleet import (Fleet, DistributedStrategy,
+                                       megatron_param_spec)
+
+
+def _bert_and_data(batch=8, seq=32):
+    cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+    pt.seed(123)
+    model = BertForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("i4")
+    mlm = np.where(rng.rand(batch, seq) < 0.2,
+                   rng.randint(0, cfg.vocab_size, (batch, seq)),
+                   -1).astype("i4")
+    nsp = rng.randint(0, 2, (batch,)).astype("i4")
+    return cfg, model, ids, mlm, nsp
+
+
+def _make_step(model, o):
+    def step(ids, mlm, nsp):
+        logits, nsp_logits = model(ids)
+        loss = model.loss(logits, nsp_logits, mlm, nsp)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+    return jit.to_static(step, models=[model], optimizers=[o])
+
+
+def test_megatron_param_spec_patterns():
+    assert megatron_param_spec("encoder.0.attention.qkv.weight",
+                               (64, 192)) == P(None, "tp")
+    assert megatron_param_spec("encoder.0.attention.qkv.bias",
+                               (192,)) == P("tp")
+    assert megatron_param_spec("encoder.0.attention.out.weight",
+                               (64, 64)) == P("tp", None)
+    assert megatron_param_spec("encoder.0.ffn1.weight",
+                               (64, 256)) == P(None, "tp")
+    assert megatron_param_spec("encoder.0.ffn2.weight",
+                               (256, 64)) == P("tp", None)
+    assert megatron_param_spec("embeddings.word_embeddings.weight",
+                               (1024, 64)) == P()
+    assert megatron_param_spec("encoder.0.attn_norm.weight", (64,)) == P()
+
+
+def test_fleet_bert_dp_tp_matches_single_device():
+    # ---- single-device reference run -------------------------------
+    cfg, model_ref, ids, mlm, nsp = _bert_and_data()
+    o_ref = optimizer.SGD(learning_rate=0.1,
+                          parameters=model_ref.parameters())
+    step_ref = _make_step(model_ref, o_ref)
+    ref_losses = [float(step_ref(pt.to_tensor(ids), pt.to_tensor(mlm),
+                                 pt.to_tensor(nsp)).numpy())
+                  for _ in range(3)]
+
+    # ---- fleet dp×tp run --------------------------------------------
+    cfg, model, ids, mlm, nsp = _bert_and_data()  # same seed -> same init
+    fleet = Fleet()
+    strategy = DistributedStrategy()
+    strategy.mesh_shape = {"dp": 2, "tp": 4}
+    fleet.init(strategy=strategy)
+    model = fleet.distributed_model(model)
+
+    # tp-sharded placement actually happened
+    qkv = dict(model.named_parameters())[
+        "bert.encoder.0.attention.qkv.weight"]
+    assert qkv.data.sharding.spec == P(None, "tp")
+
+    o = fleet.distributed_optimizer(
+        optimizer.SGD(learning_rate=0.1, parameters=model.parameters()))
+    step = _make_step(model, o)
+    tids, tmlm, tnsp = fleet.shard_batch(ids, mlm, nsp)
+    losses = [float(step(tids, tmlm, tnsp).numpy()) for _ in range(3)]
+
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-3, atol=2e-3)
+
+    # params remain tp-sharded after compiled steps (no silent gather)
+    assert qkv.data.sharding.spec == P(None, "tp")
+
+
+def test_fleet_dp_only_matches_single_device():
+    cfg, model_ref, ids, mlm, nsp = _bert_and_data(batch=8)
+    o_ref = optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                               parameters=model_ref.parameters())
+    step_ref = _make_step(model_ref, o_ref)
+    ref = [float(step_ref(pt.to_tensor(ids), pt.to_tensor(mlm),
+                          pt.to_tensor(nsp)).numpy()) for _ in range(2)]
+
+    cfg, model, ids, mlm, nsp = _bert_and_data(batch=8)
+    fleet = Fleet()
+    fleet.init(mesh_shape={"dp": 8})
+    model = fleet.distributed_model(model)
+    o = fleet.distributed_optimizer(
+        optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                           parameters=model.parameters()))
+    step = _make_step(model, o)
+    tids, tmlm, tnsp = fleet.shard_batch(ids, mlm, nsp)
+    got = [float(step(tids, tmlm, tnsp).numpy()) for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=2e-3, atol=2e-3)

@@ -1,0 +1,93 @@
+"""Edge-case regressions from round-2 code review (readers, ctc lengths,
+to_static discovery of fleet optimizers)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import reader as R
+
+
+def test_cache_partial_pass_not_corrupted():
+    c = R.cache(lambda: iter(range(10)))
+    got = []
+    for i, x in enumerate(c()):
+        if i == 3:
+            break
+        got.append(x)
+    # a broken-off pass must not poison the cache
+    assert list(c()) == list(range(10))
+    assert list(c()) == list(range(10))
+
+
+def test_xmap_readers_propagates_mapper_error():
+    def bad_mapper(x):
+        if x == 5:
+            raise ValueError("boom")
+        return x
+
+    r = R.xmap_readers(bad_mapper, lambda: iter(range(10)), 2, 4)
+    with pytest.raises(ValueError, match="boom"):
+        list(r())
+
+    def bad_reader():
+        yield 1
+        raise RuntimeError("reader broke")
+
+    r = R.xmap_readers(lambda x: x, bad_reader, 2, 4)
+    with pytest.raises(RuntimeError, match="reader broke"):
+        list(r())
+
+
+def test_warpctc_zero_padded_labels():
+    from paddle_tpu import ops
+    rs = np.random.RandomState(0)
+    logits = rs.randn(2, 10, 6).astype("f4")
+    # labels padded with 0 == blank (the common paddle batch layout)
+    labels_padded = np.array([[1, 2, 3, 0, 0], [4, 5, 0, 0, 0]], np.int32)
+    out_pad0 = ops.warpctc(pt.to_tensor(logits), labels_padded).numpy()
+    # explicit lengths must give the identical result
+    out_explicit = ops.ctc_loss(
+        pt.to_tensor(logits), labels_padded,
+        np.array([10, 10], np.int32), np.array([3, 2], np.int32),
+        blank=0, reduction="none").numpy()
+    np.testing.assert_allclose(out_pad0[:, 0], out_explicit, rtol=1e-5)
+
+
+def test_to_static_discovers_fleet_distributed_optimizer():
+    from paddle_tpu import nn, optimizer, jit
+    from paddle_tpu.parallel.fleet import Fleet
+
+    pt.seed(0)
+    fleet = Fleet()
+    fleet.init(mesh_shape={"dp": 2})
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    fleet.distributed_model(m)
+    o = fleet.distributed_optimizer(
+        optimizer.Adam(learning_rate=1e-2, parameters=m.parameters()))
+
+    x = pt.to_tensor(np.random.RandomState(0).randn(8, 4).astype("f4"))
+    y = pt.to_tensor(np.random.RandomState(1).randn(8, 2).astype("f4"))
+
+    def step(x, y):
+        loss = pt.nn.functional.mse_loss(m(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    # NO explicit optimizers=: closure discovery must find the wrapper
+    cstep = jit.to_static(step)
+    vals = [float(cstep(x, y).numpy()) for _ in range(5)]
+    assert vals[-1] < vals[0]
+
+
+def test_sequence_conv_even_filter_default():
+    from paddle_tpu import ops
+    x = np.arange(8, dtype="f4").reshape(1, 4, 2)
+    w = np.eye(8, 3).astype("f4")
+    # fs=4 -> reference default padding_start = -2
+    out = ops.sequence_conv(pt.to_tensor(x), pt.to_tensor(w),
+                            filter_size=4).numpy()
+    ref = ops.sequence_conv(pt.to_tensor(x), pt.to_tensor(w),
+                            filter_size=4, padding_start=-2).numpy()
+    np.testing.assert_allclose(out, ref)
