@@ -48,6 +48,7 @@ from . import inference
 from . import fluid
 from . import reader
 from .reader import batch
+from . import distribution
 from . import dataset
 
 # dygraph/static mode management (reference: fluid.enable_dygraph /

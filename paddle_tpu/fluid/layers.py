@@ -19,6 +19,9 @@ from .. import initializer as I
 from ..param_attr import ParamAttr
 from ..static import data  # noqa: F401 (fluid.layers.data parity)
 from ..ops.control_flow import cond, while_loop, case, switch_case  # noqa
+from ..ops.imperative_flow import (IfElse, Switch, DynamicRNN,  # noqa: F401
+                                   TensorArray, create_array, array_write,
+                                   array_read, array_length)
 from .. import metric as _metric
 
 # re-export the whole functional op surface
@@ -35,6 +38,17 @@ from ..ops.sequence import (sequence_pool, sequence_softmax,  # noqa: F401
                             sequence_first_step, sequence_last_step)
 from ..ops.crf import linear_chain_crf, crf_decoding  # noqa: F401
 from ..ops.ctc import warpctc, ctc_greedy_decoder  # noqa: F401
+from ..distribution import (Uniform, Normal, Categorical,  # noqa: F401
+                            MultivariateNormalDiag)
+from ..ops.detection import (iou_similarity, box_coder,  # noqa: F401
+                             box_clip, prior_box, density_prior_box,
+                             anchor_generator, yolo_box, yolov3_loss,
+                             sigmoid_focal_loss, bipartite_match,
+                             target_assign, ssd_loss, multiclass_nms,
+                             detection_output, polygon_box_transform,
+                             roi_align, roi_pool, generate_proposals,
+                             distribute_fpn_proposals,
+                             collect_fpn_proposals, multi_box_head)
 from ..nn.decode import (BeamSearchDecoder, dynamic_decode,  # noqa: F401
                          gather_tree, TrainingHelper,
                          GreedyEmbeddingHelper, SamplingEmbeddingHelper,

@@ -23,7 +23,7 @@ class BertConfig:
                  intermediate_size=3072, hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
-                 layer_norm_eps=1e-12, use_flash_attention=False):
+                 layer_norm_eps=1e-12, use_flash_attention=True):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers

@@ -260,12 +260,15 @@ class LayerNorm(Layer):
     """reference: dygraph/nn.py:LayerNorm (fused kernel → XLA/Pallas)."""
 
     def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
-                 bias_attr=None, use_pallas=False):
+                 bias_attr=None, use_pallas=None):
         super().__init__()
         if isinstance(normalized_shape, int):
             normalized_shape = (normalized_shape,)
         self._normalized_shape = tuple(normalized_shape)
         self._epsilon = epsilon
+        if use_pallas is None:  # auto: fused kernel on TPU, XLA elsewhere
+            from ..ops.pallas import on_tpu
+            use_pallas = on_tpu()
         self._use_pallas = use_pallas and len(self._normalized_shape) == 1
         if weight_attr is False:
             self.weight = None

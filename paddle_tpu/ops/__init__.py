@@ -20,7 +20,11 @@ from .manip import *  # noqa: F401,F403
 from .creation import *  # noqa: F401,F403
 from .nn_ops import *  # noqa: F401,F403
 from .control_flow import cond, while_loop, case, switch_case  # noqa: F401
+from .imperative_flow import (IfElse, Switch, DynamicRNN,  # noqa: F401
+                              TensorArray, create_array, array_write,
+                              array_read, array_length)
 from . import loss  # noqa: F401
+from . import detection  # noqa: F401
 from . import math as math_ops
 from . import manip as manip_ops
 from . import nn_ops

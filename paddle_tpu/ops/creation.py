@@ -76,8 +76,15 @@ def eye(num_rows, num_columns=None, dtype="float32", name=None):
 
 
 def assign(x, output=None):
-    """reference: assign_op.cc"""
+    """reference: assign_op.cc. Inside an active Switch case block the
+    write is deferred and merged first-match-wins at Switch exit
+    (reference: the guarded sub-block assign in control_flow.py:Switch)."""
     x = as_tensor(x)
+    if output is not None:
+        from .imperative_flow import Switch
+        if Switch.in_case_block():
+            Switch.active()._register(x, output)
+            return output
     out = apply(lambda x: x + 0, (x,), name="assign")
     if output is not None:
         output.set_value(out.data)

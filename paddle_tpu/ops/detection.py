@@ -1,0 +1,1087 @@
+"""Detection ops, static-shape TPU formulations.
+
+Rebuild of the reference detection op family
+(reference: python/paddle/fluid/layers/detection.py — prior_box:1657,
+density_prior_box:1813, anchor_generator:2280, iou_similarity:680,
+box_coder:730, yolo_box:1038, yolov3_loss:912, sigmoid_focal_loss:455,
+bipartite_match:1218, target_assign:1307, ssd_loss:1410,
+multiclass_nms:3082, detection_output:541, box_clip:2866,
+polygon_box_transform:878, generate_proposals:2745,
+distribute_fpn_proposals:3363, multi_box_head:1991; C++ kernels under
+paddle/fluid/operators/detection/).
+
+The reference emits variable-length LoD outputs (NMS keeps "however many"
+boxes). XLA requires static shapes, so every op here uses the padded
+formulation: fixed-size outputs ranked by score with a sentinel
+(label = -1 / score = 0) marking invalid slots — the standard TPU
+detection design. All ops are jit-compatible (lax.fori_loop for the
+sequential NMS/matching scans, no data-dependent Python control flow).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..dispatch import apply
+
+__all__ = [
+    "iou_similarity", "box_coder", "box_clip", "prior_box",
+    "density_prior_box", "anchor_generator", "yolo_box", "yolov3_loss",
+    "sigmoid_focal_loss", "bipartite_match", "target_assign", "ssd_loss",
+    "multiclass_nms", "detection_output", "polygon_box_transform",
+    "roi_align", "roi_pool", "generate_proposals",
+    "distribute_fpn_proposals", "collect_fpn_proposals", "multi_box_head",
+]
+
+
+# ---------------------------------------------------------------------------
+# box geometry helpers (pure jax, used inside kernels)
+
+def _box_area(box, normalized):
+    w = box[..., 2] - box[..., 0]
+    h = box[..., 3] - box[..., 1]
+    if not normalized:
+        w = w + 1.0
+        h = h + 1.0
+    return jnp.maximum(w, 0.0) * jnp.maximum(h, 0.0)
+
+
+def _pairwise_iou(a, b, normalized=True):
+    """a (..., N, 4), b (..., M, 4) → IoU (..., N, M); xyxy corners."""
+    off = 0.0 if normalized else 1.0
+    lt = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    rb = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = _box_area(a, normalized)[..., :, None]
+    area_b = _box_area(b, normalized)[..., None, :]
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    """IoU matrix between row boxes (reference detection.py:680). x (N,4)
+    or (B,N,4); y (M,4) or (B,M,4) → (…,N,M)."""
+    return apply(
+        lambda x, y: _pairwise_iou(x, y, box_normalized), (x, y),
+        nondiff=False, name="iou_similarity")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference detection.py:730,
+    operators/detection/box_coder_op.h). prior_box (M,4) xyxy; variance
+    either a (M,4)/(4,) array or a python list of 4 floats."""
+    ct = code_type.lower()
+    if ct not in ("encode_center_size", "decode_center_size"):
+        raise ValueError("unknown code_type %s" % code_type)
+    var_is_list = isinstance(prior_box_var, (list, tuple))
+    var_list = list(prior_box_var) if var_is_list else None
+
+    def impl(prior, target, *maybe_var):
+        off = 0.0 if box_normalized else 1.0
+        pw = prior[:, 2] - prior[:, 0] + off
+        ph = prior[:, 3] - prior[:, 1] + off
+        pcx = prior[:, 0] + pw * 0.5
+        pcy = prior[:, 1] + ph * 0.5
+        if maybe_var:
+            var = maybe_var[0]
+            var = jnp.broadcast_to(var.reshape(-1, 4), (prior.shape[0], 4))
+        elif var_list is not None:
+            var = jnp.broadcast_to(jnp.asarray(var_list, prior.dtype),
+                                   (prior.shape[0], 4))
+        else:
+            var = jnp.ones((prior.shape[0], 4), prior.dtype)
+        if ct == "encode_center_size":
+            # target (N, 4) vs priors (M, 4) → (N, M, 4)
+            tw = target[:, 2] - target[:, 0] + off
+            th = target[:, 3] - target[:, 1] + off
+            tcx = target[:, 0] + tw * 0.5
+            tcy = target[:, 1] + th * 0.5
+            ex = (tcx[:, None] - pcx[None, :]) / pw[None, :] / var[None, :, 0]
+            ey = (tcy[:, None] - pcy[None, :]) / ph[None, :] / var[None, :, 1]
+            ew = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10)) / \
+                var[None, :, 2]
+            eh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10)) / \
+                var[None, :, 3]
+            return jnp.stack([ex, ey, ew, eh], axis=-1)
+        # decode: target (N, M, 4) or (M, 4); priors broadcast on `axis`
+        t = target
+        squeeze = False
+        if t.ndim == 2:
+            t = t[None] if axis == 0 else t[:, None]
+            squeeze = True
+        if axis == 0:
+            pcx_, pcy_, pw_, ph_, v = (pcx[None, :], pcy[None, :],
+                                       pw[None, :], ph[None, :], var[None])
+        else:
+            pcx_, pcy_, pw_, ph_, v = (pcx[:, None], pcy[:, None],
+                                       pw[:, None], ph[:, None],
+                                       var[:, None])
+        dcx = v[..., 0] * t[..., 0] * pw_ + pcx_
+        dcy = v[..., 1] * t[..., 1] * ph_ + pcy_
+        dw = jnp.exp(jnp.minimum(v[..., 2] * t[..., 2], 30.0)) * pw_
+        dh = jnp.exp(jnp.minimum(v[..., 3] * t[..., 3], 30.0)) * ph_
+        out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                         dcx + dw * 0.5 - off, dcy + dh * 0.5 - off],
+                        axis=-1)
+        return out[0] if (squeeze and axis == 0) else (
+            out[:, 0] if squeeze else out)
+
+    args = (prior_box, target_box)
+    if prior_box_var is not None and not var_is_list:
+        args = args + (prior_box_var,)
+    return apply(impl, args, name="box_coder")
+
+
+def box_clip(input, im_info, name=None):
+    """Clip boxes to image bounds (reference detection.py:2866). im_info
+    rows are (H, W, scale)."""
+    def impl(boxes, im_info):
+        im = im_info.reshape(-1, im_info.shape[-1])
+        h = im[:, 0] / im[:, 2] - 1.0
+        w = im[:, 1] / im[:, 2] - 1.0
+        if boxes.ndim == 2:
+            hh, ww = h[0], w[0]
+        else:
+            hh = h.reshape((-1,) + (1,) * (boxes.ndim - 2))
+            ww = w.reshape((-1,) + (1,) * (boxes.ndim - 2))
+        x1 = jnp.clip(boxes[..., 0], 0.0, ww)
+        y1 = jnp.clip(boxes[..., 1], 0.0, hh)
+        x2 = jnp.clip(boxes[..., 2], 0.0, ww)
+        y2 = jnp.clip(boxes[..., 3], 0.0, hh)
+        return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+    return apply(impl, (input, im_info), name="box_clip")
+
+
+def polygon_box_transform(input, name=None):
+    """Quad offsets → absolute vertex coords (reference detection.py:878).
+    input (N, 8, H, W): channel 2k is x-offset, 2k+1 is y-offset."""
+    def impl(x):
+        n, c, h, w = x.shape
+        xs = jax.lax.broadcasted_iota(x.dtype, (h, w), 1)
+        ys = jax.lax.broadcasted_iota(x.dtype, (h, w), 0)
+        grid = jnp.stack([xs, ys] * (c // 2))  # (C, H, W)
+        return grid[None] - x
+
+    return apply(impl, (input,), name="polygon_box_transform")
+
+
+# ---------------------------------------------------------------------------
+# prior / anchor generation (host-side numpy grids are fine: shapes are
+# static and the results are constants folded into the XLA program)
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes over a feature map (reference detection.py:1657).
+    Returns (boxes, variances), each (H, W, num_priors, 4)."""
+    min_sizes = [float(s) for s in np.atleast_1d(min_sizes)]
+    max_sizes = [float(s) for s in np.atleast_1d(max_sizes)] \
+        if max_sizes is not None else []
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    def impl(feat, img):
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        step_w = steps[0] if steps[0] > 0 else iw / fw
+        step_h = steps[1] if steps[1] > 0 else ih / fh
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+        cx = jnp.broadcast_to(cx[None, :], (fh, fw))
+        cy = jnp.broadcast_to(cy[:, None], (fh, fw))
+        whs = []
+        for k, ms in enumerate(min_sizes):
+            if not min_max_aspect_ratios_order:
+                for ar in ars:
+                    whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+                    if abs(ar - 1.0) < 1e-6 and k < len(max_sizes):
+                        bs = math.sqrt(ms * max_sizes[k])
+                        whs.append((bs, bs))
+            else:
+                whs.append((ms, ms))
+                if k < len(max_sizes):
+                    bs = math.sqrt(ms * max_sizes[k])
+                    whs.append((bs, bs))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    whs.append((ms * math.sqrt(ar), ms / math.sqrt(ar)))
+        wh = jnp.asarray(whs, jnp.float32)  # (P, 2)
+        boxes = jnp.stack([
+            (cx[..., None] - wh[None, None, :, 0] / 2) / iw,
+            (cy[..., None] - wh[None, None, :, 1] / 2) / ih,
+            (cx[..., None] + wh[None, None, :, 0] / 2) / iw,
+            (cy[..., None] + wh[None, None, :, 1] / 2) / ih,
+        ], axis=-1)  # (H, W, P, 4)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    return apply(impl, (input, image), n_out=2, nondiff=True,
+                 name="prior_box")
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    """Densified priors (reference detection.py:1813): each fixed_size is
+    laid out on a densities[i]×densities[i] sub-grid in every cell."""
+    densities = [int(d) for d in densities]
+    fixed_sizes = [float(s) for s in fixed_sizes]
+    fixed_ratios = [float(r) for r in fixed_ratios]
+
+    def impl(feat, img):
+        fh, fw = feat.shape[2], feat.shape[3]
+        ih, iw = img.shape[2], img.shape[3]
+        step_w = steps[0] if steps[0] > 0 else iw / fw
+        step_h = steps[1] if steps[1] > 0 else ih / fh
+        cell_x = jnp.arange(fw, dtype=jnp.float32) * step_w
+        cell_y = jnp.arange(fh, dtype=jnp.float32) * step_h
+        cell_x = jnp.broadcast_to(cell_x[None, :], (fh, fw))
+        cell_y = jnp.broadcast_to(cell_y[:, None], (fh, fw))
+        pieces = []  # per-prior (dx, dy, w, h) offsets within a cell
+        for size, dens in zip(fixed_sizes, densities):
+            for ratio in fixed_ratios:
+                w = size * math.sqrt(ratio)
+                h = size / math.sqrt(ratio)
+                shift = int(step_w / dens), int(step_h / dens)
+                for dj in range(dens):
+                    for di in range(dens):
+                        ccx = (di + 0.5) * shift[0]
+                        ccy = (dj + 0.5) * shift[1]
+                        pieces.append((ccx, ccy, w, h))
+        po = jnp.asarray(pieces, jnp.float32)  # (P, 4)
+        cx = cell_x[..., None] + po[None, None, :, 0]
+        cy = cell_y[..., None] + po[None, None, :, 1]
+        w = po[None, None, :, 2]
+        h = po[None, None, :, 3]
+        boxes = jnp.stack([(cx - w / 2) / iw, (cy - h / 2) / ih,
+                           (cx + w / 2) / iw, (cy + h / 2) / ih], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        if flatten_to_2d:
+            boxes = boxes.reshape(-1, 4)
+            var = var.reshape(-1, 4)
+        return boxes, var
+
+    return apply(impl, (input, image), n_out=2, nondiff=True,
+                 name="density_prior_box")
+
+
+def anchor_generator(input, anchor_sizes=(64.0, 128.0, 256.0, 512.0),
+                     aspect_ratios=(0.5, 1.0, 2.0),
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=(16.0, 16.0),
+                     offset=0.5, name=None):
+    """RPN anchors over a feature map (reference detection.py:2280).
+    Returns (anchors, variances), each (H, W, A, 4) in image coords."""
+    sizes = [float(s) for s in np.atleast_1d(anchor_sizes)]
+    ratios = [float(r) for r in np.atleast_1d(aspect_ratios)]
+
+    def impl(feat):
+        fh, fw = feat.shape[2], feat.shape[3]
+        cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * stride[0]
+        cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * stride[1]
+        cx = jnp.broadcast_to(cx[None, :], (fh, fw))
+        cy = jnp.broadcast_to(cy[:, None], (fh, fw))
+        whs = []
+        for r in ratios:
+            for s in sizes:
+                area = stride[0] * stride[1]
+                area_ratios = area / r
+                base_w = round(math.sqrt(area_ratios))
+                base_h = round(base_w * r)
+                scale_w = s / stride[0]
+                scale_h = s / stride[1]
+                whs.append((scale_w * base_w, scale_h * base_h))
+        wh = jnp.asarray(whs, jnp.float32)
+        boxes = jnp.stack([
+            cx[..., None] - 0.5 * (wh[None, None, :, 0] - 1),
+            cy[..., None] - 0.5 * (wh[None, None, :, 1] - 1),
+            cx[..., None] + 0.5 * (wh[None, None, :, 0] - 1),
+            cy[..., None] + 0.5 * (wh[None, None, :, 1] - 1),
+        ], axis=-1)
+        var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                               boxes.shape)
+        return boxes, var
+
+    return apply(impl, (input,), n_out=2, nondiff=True,
+                 name="anchor_generator")
+
+
+# ---------------------------------------------------------------------------
+# YOLO family
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0):
+    """Decode YOLOv3 head output (reference detection.py:1038,
+    operators/detection/yolo_box_op.h). x (N, A*(5+C), H, W);
+    img_size (N, 2) as (h, w). Returns boxes (N, H*W*A, 4) xyxy in image
+    coords and scores (N, H*W*A, C); below-threshold boxes zeroed."""
+    anchors = [int(a) for a in anchors]
+    na = len(anchors) // 2
+
+    def impl(x, img_size):
+        n, c, h, w = x.shape
+        x5 = x.reshape(n, na, 5 + class_num, h, w)
+        grid_x = jax.lax.broadcasted_iota(jnp.float32, (h, w), 1)
+        grid_y = jax.lax.broadcasted_iota(jnp.float32, (h, w), 0)
+        bias = -0.5 * (scale_x_y - 1.0)
+        bx = (grid_x + jax.nn.sigmoid(x5[:, :, 0]) * scale_x_y + bias) / w
+        by = (grid_y + jax.nn.sigmoid(x5[:, :, 1]) * scale_x_y + bias) / h
+        aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, na, 1, 1)
+        ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, na, 1, 1)
+        input_size = downsample_ratio * h
+        bw = jnp.exp(x5[:, :, 2]) * aw / input_size
+        bh = jnp.exp(x5[:, :, 3]) * ah / input_size
+        conf = jax.nn.sigmoid(x5[:, :, 4])
+        probs = jax.nn.sigmoid(x5[:, :, 5:]) * conf[:, :, None]
+        img_h = img_size[:, 0].astype(jnp.float32).reshape(n, 1, 1, 1)
+        img_w = img_size[:, 1].astype(jnp.float32).reshape(n, 1, 1, 1)
+        x1 = (bx - bw / 2) * img_w
+        y1 = (by - bh / 2) * img_h
+        x2 = (bx + bw / 2) * img_w
+        y2 = (by + bh / 2) * img_h
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0.0, img_w - 1)
+            y1 = jnp.clip(y1, 0.0, img_h - 1)
+            x2 = jnp.clip(x2, 0.0, img_w - 1)
+            y2 = jnp.clip(y2, 0.0, img_h - 1)
+        keep = conf > conf_thresh
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        boxes = jnp.where(keep[..., None], boxes, 0.0)
+        probs = jnp.where(keep[..., None], probs.transpose(0, 1, 3, 4, 2),
+                          0.0)
+        # (N, A, H, W, ·) → (N, H*W*A, ·) matching the reference's
+        # anchor-major-within-cell ordering
+        boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, -1, 4)
+        probs = probs.transpose(0, 2, 3, 1, 4).reshape(n, -1, class_num)
+        return boxes, probs
+
+    return apply(impl, (x, img_size), n_out=2, name="yolo_box")
+
+
+def _bce_logits(logit, label):
+    # stable sigmoid cross-entropy, matches the reference's
+    # SigmoidCrossEntropy in yolov3_loss_op.h
+    return jnp.maximum(logit, 0.0) - logit * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference detection.py:912,
+    operators/detection/yolov3_loss_op.h). Per-sample loss (N,):
+
+    * xy: sigmoid CE, wh: L1 — each scaled by (2 - gw*gh)·score
+    * objectness: sigmoid CE; predictions whose best IoU with any gt
+      exceeds ignore_thresh are excluded from the negative term
+    * class: sigmoid CE with optional label smoothing
+
+    gt boxes are (N, B, 4) cx/cy/w/h normalized; padded slots have w==0
+    or h==0 and are masked out (the LoD-free static-shape contract).
+    """
+    anchors = [int(a) for a in anchors]
+    anchor_mask = [int(a) for a in anchor_mask]
+    na = len(anchor_mask)
+    has_score = gt_score is not None
+
+    def impl(x, gt_box, gt_label, *rest):
+        n, c, h, w = x.shape
+        nb = gt_box.shape[1]
+        score = rest[0] if has_score else jnp.ones((n, nb), x.dtype)
+        x5 = x.reshape(n, na, 5 + class_num, h, w)
+        valid = (gt_box[:, :, 2] > 0) & (gt_box[:, :, 3] > 0)  # (N, B)
+
+        # --- decode predictions (normalized cx/cy/w/h) for the ignore mask
+        grid_x = jax.lax.broadcasted_iota(jnp.float32, (h, w), 1)
+        grid_y = jax.lax.broadcasted_iota(jnp.float32, (h, w), 0)
+        bias = -0.5 * (scale_x_y - 1.0)
+        px = (grid_x + jax.nn.sigmoid(x5[:, :, 0]) * scale_x_y + bias) / w
+        py = (grid_y + jax.nn.sigmoid(x5[:, :, 1]) * scale_x_y + bias) / h
+        aw = jnp.asarray([anchors[2 * m] for m in anchor_mask],
+                         jnp.float32).reshape(1, na, 1, 1)
+        ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                         jnp.float32).reshape(1, na, 1, 1)
+        input_size = float(downsample_ratio * h)
+        pw = jnp.exp(jnp.minimum(x5[:, :, 2], 20.0)) * aw / input_size
+        ph = jnp.exp(jnp.minimum(x5[:, :, 3], 20.0)) * ah / input_size
+        pred = jnp.stack([px - pw / 2, py - ph / 2, px + pw / 2,
+                          py + ph / 2], axis=-1)  # (N,A,H,W,4)
+        gtc = jnp.stack([
+            gt_box[:, :, 0] - gt_box[:, :, 2] / 2,
+            gt_box[:, :, 1] - gt_box[:, :, 3] / 2,
+            gt_box[:, :, 0] + gt_box[:, :, 2] / 2,
+            gt_box[:, :, 1] + gt_box[:, :, 3] / 2], axis=-1)  # (N,B,4)
+        iou = _pairwise_iou(pred.reshape(n, -1, 4), gtc)  # (N,AHW,B)
+        iou = jnp.where(valid[:, None, :], iou, 0.0)
+        best_iou = jnp.max(iou, axis=-1).reshape(n, na, h, w)
+        ignore = best_iou > ignore_thresh
+
+        # --- gt → anchor matching (best over ALL anchors by wh IoU)
+        all_aw = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+        all_ah = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+        gw = gt_box[:, :, 2][..., None]
+        gh = gt_box[:, :, 3][..., None]
+        inter = jnp.minimum(gw, all_aw) * jnp.minimum(gh, all_ah)
+        union = gw * gh + all_aw * all_ah - inter
+        wh_iou = inter / jnp.maximum(union, 1e-10)  # (N, B, num_anchors)
+        best_n = jnp.argmax(wh_iou, axis=-1)  # (N, B)
+        mask_arr = jnp.asarray(anchor_mask)
+        an_idx = jnp.argmax(best_n[..., None] == mask_arr, axis=-1)
+        matched = jnp.any(best_n[..., None] == mask_arr, axis=-1) & valid
+        gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+
+        # gather predictions at matched cells: flat index (N, B)
+        flat = ((an_idx * h) + gj) * w + gi  # into (A, H, W)
+        xf = x5.reshape(n, na, 5 + class_num, h * w)
+        xf = xf.transpose(0, 1, 3, 2).reshape(n, na * h * w, 5 + class_num)
+        sel = jnp.take_along_axis(xf, flat[..., None], axis=1)  # (N,B,5+C)
+
+        tx = gt_box[:, :, 0] * w - gi.astype(jnp.float32)
+        ty = gt_box[:, :, 1] * h - gj.astype(jnp.float32)
+        aw_m = jnp.take(all_aw, jnp.clip(best_n, 0, len(anchors) // 2 - 1))
+        ah_m = jnp.take(all_ah, jnp.clip(best_n, 0, len(anchors) // 2 - 1))
+        tw = jnp.log(jnp.maximum(gt_box[:, :, 2] / aw_m, 1e-10))
+        th = jnp.log(jnp.maximum(gt_box[:, :, 3] / ah_m, 1e-10))
+        box_scale = (2.0 - gt_box[:, :, 2] * gt_box[:, :, 3]) * score
+        loc = (_bce_logits(sel[..., 0], tx) + _bce_logits(sel[..., 1], ty) +
+               jnp.abs(sel[..., 2] - tw) + jnp.abs(sel[..., 3] - th))
+        loc_loss = jnp.sum(jnp.where(matched, loc * box_scale, 0.0), axis=1)
+
+        if use_label_smooth:
+            sw = min(1.0 / class_num, 1.0 / 40.0)
+            pos, neg = 1.0 - sw, sw
+        else:
+            pos, neg = 1.0, 0.0
+        onehot = jax.nn.one_hot(gt_label, class_num, dtype=x.dtype)
+        tgt = onehot * pos + (1.0 - onehot) * neg
+        cls = jnp.sum(_bce_logits(sel[..., 5:], tgt), axis=-1)
+        cls_loss = jnp.sum(jnp.where(matched, cls * score, 0.0), axis=1)
+
+        # objectness: positives at matched cells (weight=score), negatives
+        # everywhere else unless ignored
+        obj_logit = x5[:, :, 4]  # (N, A, H, W)
+        pos_map = jnp.zeros((n, na * h * w), x.dtype)
+        wsrc = jnp.where(matched, score, 0.0)
+        pos_map = pos_map.at[jnp.arange(n)[:, None], flat].max(wsrc)
+        pos_map = pos_map.reshape(n, na, h, w)
+        is_pos = pos_map > 0
+        obj_pos = _bce_logits(obj_logit, 1.0) * pos_map
+        obj_neg = jnp.where(is_pos | ignore, 0.0,
+                            _bce_logits(obj_logit, 0.0))
+        obj_loss = jnp.sum((obj_pos + obj_neg).reshape(n, -1), axis=1)
+        return loc_loss + cls_loss + obj_loss
+
+    args = (x, gt_box, gt_label)
+    if has_score:
+        args = args + (gt_score,)
+    return apply(impl, args, name="yolov3_loss")
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    """Focal loss (reference detection.py:455,
+    operators/detection/sigmoid_focal_loss_op.h). x (N, C) logits; label
+    (N, 1) int in [0, C] where 0 is background; fg_num (1,) normalizer."""
+    def impl(x, label, fg_num):
+        n, c = x.shape
+        lbl = label.reshape(-1)
+        fg = jnp.maximum(fg_num.astype(x.dtype).reshape(()), 1.0)
+        cls_ids = jnp.arange(1, c + 1)
+        tgt = (lbl[:, None] == cls_ids).astype(x.dtype)
+        p = jax.nn.sigmoid(x)
+        ce = _bce_logits(x, tgt)
+        p_t = tgt * p + (1 - tgt) * (1 - p)
+        a_t = tgt * alpha + (1 - tgt) * (1 - alpha)
+        return a_t * jnp.power(1 - p_t, gamma) * ce / fg
+
+    return apply(impl, (x, label, fg_num), name="sigmoid_focal_loss")
+
+
+# ---------------------------------------------------------------------------
+# matching / assignment
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """Greedy bipartite matching (reference detection.py:1218,
+    operators/detection/bipartite_match_op.cc). dist (B, N, M) (N gt rows,
+    M priors). Returns (match_indices (B, M) int32 — row matched to each
+    column, -1 if none — and match_dist (B, M))."""
+    per_pred = match_type == "per_prediction"
+    thr = float(dist_threshold or 0.5)
+
+    def one(dist):
+        n, m = dist.shape
+
+        def body(_, carry):
+            mi, md, dm = carry
+            flat = jnp.argmax(dm)
+            i, j = flat // m, flat % m
+            ok = dm[i, j] > 0
+            mi = jnp.where(ok, mi.at[j].set(i.astype(jnp.int32)), mi)
+            md = jnp.where(ok, md.at[j].set(dist[i, j]), md)
+            dm = jnp.where(ok, dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
+            return mi, md, dm
+
+        mi0 = jnp.full((m,), -1, jnp.int32)
+        md0 = jnp.zeros((m,), dist.dtype)
+        mi, md, _ = lax.fori_loop(0, min(n, m), body, (mi0, md0, dist))
+        if per_pred:
+            # second pass: unmatched columns take their best row if the
+            # distance clears the threshold
+            best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+            best_val = jnp.max(dist, axis=0)
+            extra = (mi < 0) & (best_val > thr)
+            mi = jnp.where(extra, best_row, mi)
+            md = jnp.where(extra, best_val, md)
+        return mi, md
+
+    def impl(dist):
+        if dist.ndim == 2:
+            return one(dist)
+        return jax.vmap(one)(dist)
+
+    return apply(impl, (dist_matrix,), n_out=2, nondiff=True,
+                 name="bipartite_match")
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=0, name=None):
+    """Gather targets by match indices (reference detection.py:1307).
+    input (B, N, K), matched_indices (B, M) → out (B, M, K), weights
+    (B, M, 1): mismatch slots get mismatch_value / weight 0."""
+    def impl(inp, match):
+        idx = jnp.maximum(match, 0)
+        out = jnp.take_along_axis(inp, idx[..., None], axis=1)
+        matched = (match >= 0)[..., None]
+        out = jnp.where(matched, out, mismatch_value)
+        wt = matched.astype(inp.dtype)
+        return out, wt
+
+    return apply(impl, (input, matched_indices), n_out=2, nondiff=True,
+                 name="target_assign")
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, match_type="per_prediction",
+             mining_type="max_negative", normalize=True,
+             sample_size=None, name=None):
+    """SSD multibox loss (reference detection.py:1410). Static-shape
+    redesign: gt is (B, G, 4) xyxy normalized + (B, G) labels with padded
+    slots marked by all-zero boxes; matching, hard-negative mining
+    (max_negative), smooth-L1 loc loss and softmax conf loss all run
+    under jit. Returns (B, M) per-prior weighted loss (sum it for the
+    scalar)."""
+    if mining_type != "max_negative":
+        raise NotImplementedError("only max_negative mining on TPU")
+    var = list(prior_box_var) if isinstance(prior_box_var, (list, tuple)) \
+        else None
+
+    def impl(loc, conf, gt_box, gt_label, prior, *maybe_var):
+        b, m, _ = loc.shape
+        g = gt_box.shape[1]
+        pvar = maybe_var[0] if maybe_var else (
+            jnp.asarray(var, loc.dtype) if var is not None
+            else jnp.asarray([0.1, 0.1, 0.2, 0.2], loc.dtype))
+        valid = jnp.any(jnp.abs(gt_box) > 0, axis=-1)  # (B, G)
+        iou = _pairwise_iou(gt_box, jnp.broadcast_to(
+            prior[None], (b,) + prior.shape))  # (B, G, M)
+        iou = jnp.where(valid[..., None], iou, -1.0)
+
+        # bipartite pass
+        def one(dist):
+            def body(_, carry):
+                mi, dm = carry
+                flat = jnp.argmax(dm)
+                i, j = flat // m, flat % m
+                ok = dm[i, j] > 0
+                mi = jnp.where(ok, mi.at[j].set(i.astype(jnp.int32)), mi)
+                dm = jnp.where(ok,
+                               dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
+                return mi, dm
+            mi0 = jnp.full((m,), -1, jnp.int32)
+            mi, _ = lax.fori_loop(0, min(g, m), body, (mi0, dist))
+            return mi
+
+        match = jax.vmap(one)(iou)  # (B, M)
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(iou, axis=1).astype(jnp.int32)
+            best_val = jnp.max(iou, axis=1)
+            extra = (match < 0) & (best_val > overlap_threshold)
+            match = jnp.where(extra, best_row, match)
+        pos = match >= 0  # (B, M)
+
+        # loc loss: smooth-L1 on encoded offsets, positives only
+        gidx = jnp.maximum(match, 0)
+        mgt = jnp.take_along_axis(gt_box, gidx[..., None], axis=1)
+        pw = prior[:, 2] - prior[:, 0]
+        ph = prior[:, 3] - prior[:, 1]
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        gw = mgt[..., 2] - mgt[..., 0]
+        gh = mgt[..., 3] - mgt[..., 1]
+        gcx = mgt[..., 0] + gw / 2
+        gcy = mgt[..., 1] + gh / 2
+        pv = jnp.broadcast_to(pvar.reshape(-1, 4), (m, 4))
+        tx = (gcx - pcx) / pw / pv[:, 0]
+        ty = (gcy - pcy) / ph / pv[:, 1]
+        tw = jnp.log(jnp.maximum(gw / pw, 1e-10)) / pv[:, 2]
+        th = jnp.log(jnp.maximum(gh / ph, 1e-10)) / pv[:, 3]
+        tgt_loc = jnp.stack([tx, ty, tw, th], axis=-1)
+        diff = loc - tgt_loc
+        sl1 = jnp.where(jnp.abs(diff) < 1.0, 0.5 * diff * diff,
+                        jnp.abs(diff) - 0.5).sum(-1)
+        loc_loss = jnp.where(pos, sl1, 0.0) * loc_loss_weight
+
+        # conf loss: softmax CE against matched label / background
+        mlbl = jnp.take_along_axis(gt_label, gidx, axis=1)
+        tgt_cls = jnp.where(pos, mlbl, background_label)
+        logp = jax.nn.log_softmax(conf, axis=-1)
+        ce = -jnp.take_along_axis(logp, tgt_cls[..., None],
+                                  axis=-1)[..., 0]
+
+        # hard negative mining: top (ratio·npos) negatives by conf loss
+        npos = jnp.sum(pos, axis=1)  # (B,)
+        nneg = jnp.minimum((npos * neg_pos_ratio).astype(jnp.int32),
+                           m - npos)
+        neg_cand = (~pos) & (jnp.max(iou, axis=1) < neg_overlap)
+        neg_score = jnp.where(neg_cand, ce, -jnp.inf)
+        order = jnp.argsort(-neg_score, axis=1)
+        rank = jnp.argsort(order, axis=1)  # rank of each prior
+        neg_sel = rank < nneg[:, None]
+        conf_loss = jnp.where(pos | neg_sel, ce, 0.0) * conf_loss_weight
+
+        total = loc_loss + conf_loss
+        if normalize:
+            total = total / jnp.maximum(npos.astype(loc.dtype),
+                                        1.0)[:, None]
+        return total
+
+    args = (location, confidence, gt_box, gt_label, prior_box)
+    if prior_box_var is not None and var is None:
+        args = args + (prior_box_var,)
+    return apply(impl, args, name="ssd_loss")
+
+
+# ---------------------------------------------------------------------------
+# NMS family (fixed-size top-k outputs + validity sentinel)
+
+def _nms_keep(boxes, scores, iou_threshold, normalized=True, eta=1.0):
+    """Sequential greedy NMS over boxes (K,4) ranked by scores (K,).
+    Returns keep mask (K,) bool. O(K²) IoU + lax.fori_loop (static shapes;
+    `iou[:, i]` is a dynamic-slice of static size) — jit-safe."""
+    k = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sb = boxes[order]
+    iou = _pairwise_iou(sb, sb, normalized)
+    rng = jnp.arange(k)
+
+    def body(i, carry):
+        keep, thr = carry
+        col = iou[:, i]
+        sup = jnp.any((rng < i) & keep & (col > thr))
+        ki = keep[i] & ~sup
+        keep = keep.at[i].set(ki)
+        thr = jnp.where(ki & (eta < 1.0) & (thr > 0.5), thr * eta, thr)
+        return keep, thr
+
+    keep0 = scores[order] > -jnp.inf
+    keep, _ = lax.fori_loop(
+        0, k, body, (keep0, jnp.asarray(iou_threshold, boxes.dtype)))
+    inv = jnp.argsort(order)
+    return keep[inv]
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_index=False):
+    """Multi-class NMS (reference detection.py:3082,
+    operators/detection/multiclass_nms_op.cc). bboxes (N, M, 4);
+    scores (N, C, M). Static-shape output: (N, keep_top_k, 6) rows
+    [label, score, x1, y1, x2, y2] ranked by score with label = -1 in
+    empty slots, plus a (N,) count of valid detections (the reference's
+    LoD), plus flat indices when return_index."""
+    nms_top_k = int(nms_top_k)
+    keep_top_k = int(keep_top_k) if keep_top_k > 0 else None
+
+    def impl(bboxes, scores):
+        n, c, m = scores.shape
+        ktop = min(nms_top_k, m) if nms_top_k > 0 else m
+
+        def per_image(boxes, sc):
+            def per_class(cls_scores):
+                s = jnp.where(cls_scores > score_threshold, cls_scores,
+                              -jnp.inf)
+                top_s, top_i = lax.top_k(s, ktop)
+                cb = boxes[top_i]
+                keep = _nms_keep(cb, top_s, nms_threshold, normalized,
+                                 nms_eta) & (top_s > -jnp.inf)
+                return jnp.where(keep, top_s, -jnp.inf), top_i
+            cls_s, cls_i = jax.vmap(per_class)(sc)  # (C, ktop)
+            if background_label >= 0:
+                cls_s = cls_s.at[background_label].set(-jnp.inf)
+            labels = jnp.broadcast_to(jnp.arange(c)[:, None],
+                                      (c, ktop))
+            flat_s = cls_s.reshape(-1)
+            flat_l = labels.reshape(-1)
+            flat_i = cls_i.reshape(-1)
+            kk = keep_top_k or flat_s.shape[0]
+            kk = min(kk, flat_s.shape[0])
+            sel_s, sel = lax.top_k(flat_s, kk)
+            sel_l = flat_l[sel]
+            sel_b = boxes[flat_i[sel]]
+            validk = sel_s > -jnp.inf
+            out = jnp.concatenate([
+                jnp.where(validk, sel_l, -1).astype(boxes.dtype)[:, None],
+                jnp.where(validk, sel_s, 0.0)[:, None],
+                jnp.where(validk[:, None], sel_b, 0.0)], axis=-1)
+            return out, jnp.sum(validk.astype(jnp.int32)), \
+                jnp.where(validk, flat_i[sel], -1)
+
+        out, counts, idx = jax.vmap(per_image)(bboxes, scores)
+        return (out, counts, idx) if return_index else (out, counts)
+
+    return apply(impl, (bboxes, scores), n_out=3 if return_index else 2,
+                 nondiff=True, name="multiclass_nms")
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     name=None):
+    """SSD inference head: decode + multiclass NMS (reference
+    detection.py:541). loc (N, M, 4) offsets; scores (N, M, C) softmax-ed
+    here; priors (M, 4)+(M, 4). Returns ((N, keep_top_k, 6), (N,))."""
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", axis=0)
+    probs = apply(lambda s: jax.nn.softmax(s, axis=-1).transpose(0, 2, 1),
+                  (scores,), name="softmax_transpose")
+    return multiclass_nms(decoded, probs, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold, True, nms_eta,
+                          background_label)
+
+
+# ---------------------------------------------------------------------------
+# RoI ops
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None,
+              name=None):
+    """RoI Align (reference detection.py:2381 roi_* family,
+    operators/roi_align_op.h). input (N, C, H, W); rois (R, 4) xyxy in
+    input-image coords; rois_num (N,) counts per image (defaults to all
+    rois on image 0 — the LoD-free contract). Bilinear sampling averaged
+    over a per-bin sample grid.
+
+    Static-shape note: the reference's adaptive sampling_ratio<=0 mode
+    sizes the grid per-roi (ceil(roi/pool)) — a data-dependent shape XLA
+    cannot compile. Here sampling_ratio<=0 uses a FIXED 2×2 grid per bin
+    (the detectron default, and exact for rois up to 2× the pooled size);
+    pass an explicit sampling_ratio for denser grids."""
+    sr = int(sampling_ratio)
+
+    def impl(x, rois, *maybe_num):
+        n, c, h, w = x.shape
+        r = rois.shape[0]
+        if maybe_num:
+            # rois_num (N,): counts per image → batch index per roi
+            counts = maybe_num[0]
+            batch_idx = jnp.repeat(jnp.arange(n), counts, axis=0,
+                                   total_repeat_length=r)
+        else:
+            batch_idx = jnp.zeros((r,), jnp.int32)
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_w = rw / pooled_width
+        bin_h = rh / pooled_height
+        gx = sr if sr > 0 else 2
+        gy = sr if sr > 0 else 2
+
+        # sample coords (R, PH, PW, gy, gx)
+        py = jnp.arange(pooled_height, dtype=x.dtype)
+        px = jnp.arange(pooled_width, dtype=x.dtype)
+        sy = (jnp.arange(gy, dtype=x.dtype) + 0.5) / gy
+        sx = (jnp.arange(gx, dtype=x.dtype) + 0.5) / gx
+        yy = y1[:, None, None] + (py[None, :, None] + sy[None, None, :]) * \
+            bin_h[:, None, None]  # (R, PH, gy)
+        xx = x1[:, None, None] + (px[None, :, None] + sx[None, None, :]) * \
+            bin_w[:, None, None]  # (R, PW, gx)
+
+        def bilinear(img, ys, xs):
+            # img (C, H, W); ys (PH, gy); xs (PW, gx) →  (C, PH, PW)
+            y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+            y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            ly = jnp.clip(ys - y0, 0.0, 1.0)
+            lx = jnp.clip(xs - x0, 0.0, 1.0)
+            # gather rows then cols: (C, PH, gy, W) → (C, PH, gy, PW, gx)
+            def gy_(img, yi):
+                return img[:, yi, :]  # (C, PH, gy, W)
+            r0 = gy_(img, y0i)
+            r1 = gy_(img, y1i)
+            def gx_(rows, xi):
+                return rows[:, :, :, xi]  # (C, PH, gy, PW, gx)
+            v00 = gx_(r0, x0i)
+            v01 = gx_(r0, x1i)
+            v10 = gx_(r1, x0i)
+            v11 = gx_(r1, x1i)
+            ly_ = ly[None, :, :, None, None]
+            lx_ = lx[None, None, None, :, :]
+            val = (v00 * (1 - ly_) * (1 - lx_) + v01 * (1 - ly_) * lx_ +
+                   v10 * ly_ * (1 - lx_) + v11 * ly_ * lx_)
+            return jnp.mean(val, axis=(2, 4))  # avg over sample grid
+
+        imgs = x[batch_idx]  # (R, C, H, W)
+        out = jax.vmap(bilinear)(imgs, yy, xx)
+        return out  # (R, C, PH, PW)
+
+    args = (input, rois)
+    if rois_num is not None:
+        args = args + (rois_num,)
+    return apply(impl, args, name="roi_align")
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_num=None, name=None):
+    """RoI max pooling (reference operators/roi_pool_op.h). Same contract
+    as roi_align but hard bin edges + max."""
+    def impl(x, rois, *maybe_num):
+        n, c, h, w = x.shape
+        r = rois.shape[0]
+        if maybe_num:
+            counts = maybe_num[0]
+            batch_idx = jnp.repeat(jnp.arange(n), counts, axis=0,
+                                   total_repeat_length=r)
+        else:
+            batch_idx = jnp.zeros((r,), jnp.int32)
+        x1 = jnp.round(rois[:, 0] * spatial_scale)
+        y1 = jnp.round(rois[:, 1] * spatial_scale)
+        x2 = jnp.round(rois[:, 2] * spatial_scale)
+        y2 = jnp.round(rois[:, 3] * spatial_scale)
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+
+        ygrid = jnp.arange(h, dtype=x.dtype)
+        xgrid = jnp.arange(w, dtype=x.dtype)
+
+        def one(img, x1_, y1_, rw_, rh_):
+            # bin index of every pixel row/col for this roi; outside → -1.
+            # Separable two-stage masked max (rows then cols) keeps the
+            # largest intermediate at (PH, C, W) — never the (C,PH,PW,H,W)
+            # broadcast a joint mask would need.
+            by = jnp.floor((ygrid - y1_) * pooled_height / rh_)
+            bx = jnp.floor((xgrid - x1_) * pooled_width / rw_)
+            by = jnp.where((ygrid >= y1_) & (ygrid <= y1_ + rh_ - 1), by,
+                           -1.0)
+            bx = jnp.where((xgrid >= x1_) & (xgrid <= x1_ + rw_ - 1), bx,
+                           -1.0)
+            rowmax = []
+            for p in range(pooled_height):
+                msk = (by == p)[None, :, None]  # (1, H, 1)
+                rowmax.append(jnp.max(jnp.where(msk, img, -jnp.inf),
+                                      axis=1))  # (C, W)
+            rows = jnp.stack(rowmax)  # (PH, C, W)
+            colmax = []
+            for q in range(pooled_width):
+                msk = (bx == q)[None, None, :]  # (1, 1, W)
+                colmax.append(jnp.max(jnp.where(msk, rows, -jnp.inf),
+                                      axis=2))  # (PH, C)
+            out = jnp.stack(colmax, axis=-1)  # (PH, C, PW)
+            out = jnp.transpose(out, (1, 0, 2))  # (C, PH, PW)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        imgs = x[batch_idx]
+        return jax.vmap(one)(imgs, x1, y1, rw, rh)
+
+    args = (input, rois)
+    if rois_num is not None:
+        args = args + (rois_num,)
+    return apply(impl, args, name="roi_pool")
+
+
+# ---------------------------------------------------------------------------
+# proposals
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposal generation (reference detection.py:2745). Static-shape:
+    returns (N, post_nms_top_n, 4) proposals + (N, post_nms_top_n) scores
+    (invalid slots score 0). scores (N, A, H, W); bbox_deltas
+    (N, 4A, H, W); anchors/variances (H, W, A, 4)."""
+    def impl(scores, deltas, im_info, anchors, variances):
+        n, a, h, w = scores.shape
+        sc = scores.transpose(0, 2, 3, 1).reshape(n, -1)  # (N, HWA)
+        dl = deltas.reshape(n, a, 4, h, w).transpose(0, 3, 4, 1, 2) \
+            .reshape(n, -1, 4)
+        anc = anchors.reshape(-1, 4)
+        varr = variances.reshape(-1, 4)
+        k = min(pre_nms_top_n, sc.shape[1])
+
+        def per_image(s, d, im):
+            top_s, top_i = lax.top_k(s, k)
+            an = anc[top_i]
+            va = varr[top_i]
+            de = d[top_i]
+            aw = an[:, 2] - an[:, 0] + 1.0
+            ah_ = an[:, 3] - an[:, 1] + 1.0
+            acx = an[:, 0] + aw / 2
+            acy = an[:, 1] + ah_ / 2
+            cx = va[:, 0] * de[:, 0] * aw + acx
+            cy = va[:, 1] * de[:, 1] * ah_ + acy
+            bw = jnp.exp(jnp.minimum(va[:, 2] * de[:, 2], 30.0)) * aw
+            bh = jnp.exp(jnp.minimum(va[:, 3] * de[:, 3], 30.0)) * ah_
+            props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                               cx + bw / 2 - 1, cy + bh / 2 - 1], -1)
+            hh, ww = im[0] - 1.0, im[1] - 1.0
+            props = jnp.stack([
+                jnp.clip(props[:, 0], 0, ww), jnp.clip(props[:, 1], 0, hh),
+                jnp.clip(props[:, 2], 0, ww), jnp.clip(props[:, 3], 0, hh),
+            ], -1)
+            ms = min_size * im[2]
+            keep_sz = ((props[:, 2] - props[:, 0] + 1 >= ms) &
+                       (props[:, 3] - props[:, 1] + 1 >= ms))
+            s2 = jnp.where(keep_sz, top_s, -jnp.inf)
+            keep = _nms_keep(props, s2, nms_thresh, False, eta) & \
+                (s2 > -jnp.inf)
+            s3 = jnp.where(keep, s2, -jnp.inf)
+            kk = min(post_nms_top_n, k)
+            fs, fi = lax.top_k(s3, kk)
+            fp = props[fi]
+            ok = fs > -jnp.inf
+            return jnp.where(ok[:, None], fp, 0.0), jnp.where(ok, fs, 0.0)
+
+        return jax.vmap(per_image)(sc, dl, im_info)
+
+    return apply(impl, (scores, bbox_deltas, im_info, anchors, variances),
+                 n_out=2, nondiff=True, name="generate_proposals")
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, rois_num=None, name=None):
+    """Assign RoIs to FPN levels (reference detection.py:3363). Static
+    shape: returns per-level (R, 4) roi tensors where off-level rows are
+    zeroed + a mask list + restore index."""
+    nlvl = max_level - min_level + 1
+
+    def impl(rois):
+        w = rois[:, 2] - rois[:, 0]
+        h = rois[:, 3] - rois[:, 1]
+        scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+        lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+        lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+        outs = []
+        for L in range(min_level, max_level + 1):
+            m = (lvl == L)
+            outs.append(jnp.where(m[:, None], rois, 0.0))
+            outs.append(m)
+        order = jnp.argsort(lvl)
+        restore = jnp.argsort(order)
+        return tuple(outs) + (restore,)
+
+    return apply(impl, (fpn_rois,), n_out=2 * nlvl + 1, nondiff=True,
+                 name="distribute_fpn_proposals")
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Merge per-level RoIs by score (reference detection.py:3519). Inputs
+    are lists of (R_i, 4)/(R_i,) tensors; output (post_nms_top_n, 4)."""
+    k = len(multi_rois)
+
+    def impl(*args):
+        rois = jnp.concatenate(args[:k], axis=0)
+        scores = jnp.concatenate(args[k:], axis=0)
+        kk = min(int(post_nms_top_n), scores.shape[0])
+        top_s, top_i = lax.top_k(scores, kk)
+        return rois[top_i], top_s
+
+    return apply(impl, tuple(multi_rois) + tuple(multi_scores), n_out=2,
+                 nondiff=True, name="collect_fpn_proposals")
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1,
+                   name=None, min_max_aspect_ratios_order=False):
+    """SSD multibox head (reference detection.py:1991): conv loc/conf
+    predictions + priors for a list of feature maps. Returns
+    (mbox_locs (N, M, 4), mbox_confs (N, M, C), priors (M, 4), vars)."""
+    from . import nn_ops as F
+    from .. import nn as nn_mod
+
+    nin = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio interpolation
+        min_sizes, max_sizes = [], []
+        mr, xr = int(min_ratio), int(max_ratio)
+        step = int(math.floor((xr - mr) / (nin - 2))) if nin > 2 else 0
+        for ratio in range(mr, xr + 1, max(step, 1)):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:nin - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:nin - 1]
+
+    locs, confs, boxes_all, vars_all = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ms = min_sizes[i]
+        xs = max_sizes[i] if max_sizes else None
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[0],
+                                            (list, tuple)) else aspect_ratios
+        st = steps[i] if steps else (
+            (step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0))
+        if not isinstance(st, (list, tuple)):
+            st = (st, st)
+        pb, pv = prior_box(feat, image, ms, xs, ar, variance, flip, clip,
+                           st, offset,
+                           min_max_aspect_ratios_order=
+                           min_max_aspect_ratios_order)
+        npri = int(np.prod(pb.shape[:-1]) // (pb.shape[0] * pb.shape[1]))
+        boxes_all.append(pb.reshape([-1, 4]))
+        vars_all.append(pv.reshape([-1, 4]))
+        cin = feat.shape[1]
+        loc_conv = nn_mod.Conv2D(cin, npri * 4, kernel_size, stride=stride,
+                                 padding=pad)
+        conf_conv = nn_mod.Conv2D(cin, npri * num_classes, kernel_size,
+                                  stride=stride, padding=pad)
+        loc = loc_conv(feat).transpose([0, 2, 3, 1]).reshape([
+            feat.shape[0], -1, 4])
+        conf = conf_conv(feat).transpose([0, 2, 3, 1]).reshape([
+            feat.shape[0], -1, num_classes])
+        locs.append(loc)
+        confs.append(conf)
+
+    from .manip import concat
+    return (concat(locs, axis=1), concat(confs, axis=1),
+            concat(boxes_all, axis=0), concat(vars_all, axis=0))

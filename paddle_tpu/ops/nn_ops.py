@@ -581,14 +581,15 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
                                  name=None):
     """Plain XLA attention (B, H, S, D). Flash/pallas variant in
     ops/pallas/flash_attention.py; ring variant in parallel/ring_attention."""
+    p_drop = float(dropout_p) if training else 0.0
     attrs = dict(is_causal=is_causal, scale=scale)
 
-    def impl(q, k, v, *mask, is_causal, scale):
+    def impl(q, k, v, *rest, is_causal, scale):
         d = q.shape[-1]
         s = scale if scale is not None else 1.0 / np.sqrt(d)
         logits = jnp.einsum("...qd,...kd->...qk", q, k) * s
-        if mask:
-            m = mask[0]
+        if attn_mask is not None:
+            m = rest[0]
             if m.dtype == jnp.bool_:
                 logits = jnp.where(m, logits, -1e9)
             else:
@@ -598,13 +599,21 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             causal = jnp.tril(jnp.ones((sq, sk), jnp.bool_))
             logits = jnp.where(causal, logits, -1e9)
         probs = jax.nn.softmax(logits, axis=-1)
+        if p_drop > 0.0:
+            # dropout on the attention PROBABILITIES (reference semantics:
+            # the attn_dropout in multihead attention / what the fused
+            # Pallas kernel does in-kernel), not on the context output
+            keep = jax.random.bernoulli(rest[-1], 1.0 - p_drop,
+                                        probs.shape)
+            probs = jnp.where(keep, probs / (1.0 - p_drop), 0.0)
         return jnp.einsum("...qk,...kd->...qd", probs, v)
 
-    args = (q, k, v) if attn_mask is None else (q, k, v, attn_mask)
-    out = apply(impl, args, attrs, name="sdpa")
-    if dropout_p > 0.0 and training:
-        out = dropout(out, p=dropout_p, training=training)
-    return out
+    args = (q, k, v)
+    if attn_mask is not None:
+        args = args + (attn_mask,)
+    if p_drop > 0.0:
+        args = args + (prandom.next_key_graph(),)
+    return apply(impl, args, attrs, name="sdpa")
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",

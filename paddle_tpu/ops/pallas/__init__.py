@@ -16,6 +16,13 @@ def interpret_mode():
         d.platform in ("tpu", "axon") for d in jax.devices())
 
 
+def on_tpu():
+    """True when the compiled-kernel path is live. Layers use this to
+    auto-enable Pallas kernels on TPU while keeping CPU tests on the
+    (fast) XLA path; interpret-mode tests opt in via force flags."""
+    return not interpret_mode()
+
+
 from . import layer_norm as layer_norm_mod
 from . import softmax_xent as softmax_xent_mod
 from . import flash_attention as flash_attention_mod

@@ -1,13 +1,30 @@
-"""Flash attention Pallas kernel (reference: the fused attention the
-reference approximates with fused_elemwise + softmax kernels; modern
-flash-style tiling is the TPU-native formulation).
+"""Flash attention Pallas kernels — fused mask + attention dropout + fused
+backward (reference: the fused attention stack the reference approximates
+with paddle/fluid/operators/fused/fused_elemwise_activation_op.cu +
+softmax_with_cross_entropy_op.cu; flash-style tiling is the TPU-native
+formulation).
 
-Forward: grid (batch*heads, q-blocks); for each q-block a fori_loop walks
-k/v-blocks with the online-softmax recurrence (running max m, normalizer l,
-accumulator acc in VMEM scratch) — attention never materializes the S×S
-matrix in HBM. Backward currently recomputes with the standard einsum
-formulation under XLA (documented trade-off; a full flash backward kernel
-is a later-round optimization).
+Forward: grid (batch*heads, q-blocks); each program walks k/v-blocks with
+the online-softmax recurrence (running max m, normalizer l, accumulator
+acc) so the S×S score matrix never hits HBM. The additive attention mask
+(key bias [B,1,1,Sk] or full [.,.,Sq,Sk]) is added to the scores inside
+the kernel, and attention-probability dropout is drawn in-kernel from the
+TPU PRNG, seeded per (bh, q-block, k-block) tile so the backward
+regenerates the identical keep-mask without ever storing it.
+
+Backward: two kernels. dQ: grid (bh, q-blocks) loops k-blocks; dK/dV:
+grid (bh, k-blocks) loops q-blocks, accumulating dv = pd^T @ dO and
+dk = ds^T @ Q. Both recompute p = exp(s - m) / l from the saved PER-ROW
+(max m, normalizer l) — deliberately NOT the folded lse = m + log l: with
+a finite large-negative additive mask (the -1e9 convention) s and m are
+~1e9-scale where f32 ulp is 64, so s − m reproduces the forward's (and
+sdpa's) rounding exactly while s − (m + log l) would silently lose the
+entire log-normalizer. delta = rowsum(dO∘O) is one cheap XLA reduction
+outside the kernels (the identity Σ_k p_k·dp_k = rowsum(dO∘O) holds under
+dropout too). Row stats are stored (…, 1) between passes and broadcast to
+(…, 128) lanes only transiently around each kernel call (Mosaic-trivial
+layouts without holding 128× residual HBM — same lane-replication scheme
+as the upstream pallas TPU attention kernel).
 """
 from __future__ import annotations
 
@@ -19,11 +36,53 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_NEG_INF = -1e30
+_LANES = 128
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, causal, scale,
-                block_q):
-    # q_ref: (1, BQ, D); k_ref/v_ref: (1, SK, D)
+
+def _dropout_keep(seed_ref, bh, qi, j, shape, threshold):
+    """Regeneratable dropout keep-mask for one (BQ, BK) score tile, drawn
+    from the TPU PRNG seeded per tile (so fwd and both bwd kernels
+    regenerate the identical mask without storing it)."""
+    pltpu.prng_seed(seed_ref[0], seed_ref[1], bh, qi, j)
+    bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return bits >= jnp.uint32(threshold)
+
+
+def _host_keep_mask(seed, bh, sq_pad, sk_pad, dropout_p):
+    """Interpret-mode (CPU test) substitute: the TPU PRNG primitives have
+    no CPU lowering, so precompute the whole keep-mask in XLA from the
+    same seed (deterministic → fwd/bwd see identical masks) and thread it
+    through as a kernel operand (0.0 = drop, 1.0 = keep)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed[0]), seed[1])
+    u = jax.random.uniform(key, (bh, sq_pad, sk_pad))
+    return (u >= dropout_p).astype(jnp.float32)
+
+
+def _masked_scores(q, k, mask_ref, qi, j, *, block_q, block_k, sq, sk,
+                   causal, mask_mode):
+    """Scaled scores + additive bias with invalid positions at _NEG_INF.
+    q is pre-scaled f32 (BQ, D); k is f32 (BK, D). mask_ref rows are
+    already positioned by the BlockSpec ((1,BK) key bias broadcasts down,
+    (BQ,BK) full bias adds elementwise)."""
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+    if mask_mode in ("key", "full"):
+        s = s + mask_ref[0, :, pl.ds(j * block_k, block_k)].astype(
+            jnp.float32)
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    valid = (q_pos < sq) & (k_pos < sk)
+    if causal:
+        valid = valid & (q_pos >= k_pos)
+    return jnp.where(valid, s, _NEG_INF), valid
+
+
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, keep_ref, o_ref,
+                m_ref, l_ref, *, block_q, block_k, sq, sk, causal, scale,
+                mask_mode, dropout_p, threshold, drop_mode):
+    # q_ref: (1, BQ, D); k_ref/v_ref: (1, SKp, D); mask_ref: (1,{1,BQ},SKp)
     q = q_ref[0].astype(jnp.float32) * scale
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
 
     m0 = jnp.full((q.shape[0], 1), -jnp.inf, jnp.float32)
@@ -39,21 +98,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, causal, scale,
         row_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_k, 1), 0)
         v = jnp.where(row_pos < sk, v, 0.0)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        # mask keys past the true sequence end (tail block when
-        # sk % block_k != 0 reads padding)
-        s = jnp.where(k_pos < sk, s, -jnp.inf)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, s.shape, 0)
-            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        s, _ = _masked_scores(q, k, mask_ref, qi, j, block_q=block_q,
+                              block_k=block_k, sq=sq, sk=sk, causal=causal,
+                              mask_mode=mask_mode)
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        # rows with every key masked: keep the exp argument finite
+        m_safe = jnp.where(m_new <= _NEG_INF, 0.0, m_new)
         p = jnp.exp(s - m_safe)
-        p = jnp.where(jnp.isneginf(s), 0.0, p)
-        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        p = jnp.where(s <= _NEG_INF, 0.0, p)
+        corr = jnp.where(m <= _NEG_INF, 0.0, jnp.exp(m - m_safe))
         l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_p > 0.0:
+            if drop_mode == "prng":
+                keep = _dropout_keep(seed_ref, bh, qi, j, p.shape,
+                                     threshold)
+            else:
+                keep = keep_ref[0, :, pl.ds(j * block_k, block_k)] > 0.5
+            p = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
         acc_new = acc * corr + jnp.dot(p, v,
                                        preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
@@ -63,96 +124,444 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sk, causal, scale,
         nk, pl.cdiv((qi + 1) * block_q, block_k))
     m, l, acc = jax.lax.fori_loop(0, nk_needed, body, (m0, l0, acc0))
     o_ref[0] = (acc / jnp.maximum(l, 1e-20)).astype(o_ref.dtype)
+    m_fin = jnp.where(m <= _NEG_INF, 0.0, m)
+    m_ref[0] = jax.lax.broadcast_in_dim(m_fin, m_ref.shape[1:], (0, 1))
+    l_ref[0] = jax.lax.broadcast_in_dim(l, l_ref.shape[1:], (0, 1))
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, keep_ref,
+                   m_ref, linv_ref, delta_ref, do_ref, dq_ref, *, block_q,
+                   block_k, sq, sk, causal, scale, mask_mode, dropout_p,
+                   threshold, drop_mode):
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    mrow = m_ref[0][:, :1]       # (BQ, 1)
+    linv = linv_ref[0][:, :1]    # (BQ, 1)
+    delta = delta_ref[0][:, :1]  # (BQ, 1)
+    bh = pl.program_id(0)
+    qi = pl.program_id(1)
+    dq0 = jnp.zeros((q.shape[0], q_ref.shape[2]), jnp.float32)
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s, valid = _masked_scores(q, k, mask_ref, qi, j, block_q=block_q,
+                                  block_k=block_k, sq=sq, sk=sk,
+                                  causal=causal, mask_mode=mask_mode)
+        # p = exp(s − m)/l: same rounding as the forward recurrence even
+        # for ~1e9-scale masked scores (see module docstring)
+        p = jnp.where(valid, jnp.exp(s - mrow) * linv, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            if drop_mode == "prng":
+                keep = _dropout_keep(seed_ref, bh, qi, j, p.shape,
+                                     threshold)
+            else:
+                keep = keep_ref[0, :, pl.ds(j * block_k, block_k)] > 0.5
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    nk = pl.cdiv(sk, block_k)
+    nk_needed = nk if not causal else jnp.minimum(
+        nk, pl.cdiv((qi + 1) * block_q, block_k))
+    dq = jax.lax.fori_loop(0, nk_needed, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, mask_ref, keep_ref,
+                    m_ref, linv_ref, delta_ref, do_ref, dk_ref, dv_ref, *,
+                    block_q, block_k, sq, sk, causal, scale, mask_mode,
+                    dropout_p, threshold, drop_mode):
+    # this program owns ONE k-block (grid (bh, k-blocks)) and loops
+    # q-blocks. q_ref/do_ref: (1, SQp, D); k_ref/v_ref: (1, BK, D);
+    # mask_ref: (1, {1, SQp}, BK); m/linv/delta: (1, SQp, LANES)
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        mrow = m_ref[0, pl.ds(qi * block_q, block_q), :][:, :1]
+        linv = linv_ref[0, pl.ds(qi * block_q, block_q), :][:, :1]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :][:, :1]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if mask_mode == "key":
+            s = s + mask_ref[0, :, :].astype(jnp.float32)  # (1, BK)
+        elif mask_mode == "full":
+            s = s + mask_ref[0, pl.ds(qi * block_q, block_q), :].astype(
+                jnp.float32)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        valid = (q_pos < sq) & (k_pos < sk)
+        if causal:
+            valid = valid & (q_pos >= k_pos)
+        p = jnp.where(valid, jnp.exp(jnp.where(valid, s, _NEG_INF) - mrow)
+                      * linv, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        pd = p
+        if dropout_p > 0.0:
+            if drop_mode == "prng":
+                keep = _dropout_keep(seed_ref, bh, qi, j, p.shape,
+                                     threshold)
+            else:
+                keep = keep_ref[0, pl.ds(qi * block_q, block_q), :] > 0.5
+            pd = jnp.where(keep, p / (1.0 - dropout_p), 0.0)
+            dp = jnp.where(keep, dp / (1.0 - dropout_p), 0.0)
+        dv = dv + jnp.dot(pd.T, do, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        # q above is pre-scaled, so ds^T @ (q·scale) is already dk
+        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    nq = pl.cdiv(sq, block_q)
+    q_start = 0 if not causal else (j * block_k) // block_q
+    dk, dv = jax.lax.fori_loop(q_start, nq, body,
+                               (jnp.zeros_like(k), jnp.zeros_like(v)))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _mask_mode(mask_shape, b, h, sq, sk):
+    """Static tiling decision from the mask's 4D-normalized shape:
+    'key' (broadcasts over queries), 'full', or 'fallback'."""
+    if mask_shape is None:
+        return None
+    shape = (1,) * (4 - len(mask_shape)) + tuple(mask_shape)
+    if len(shape) != 4:
+        return "fallback"
+    mb, mh, msq, msk = shape
+    if msk != sk or mb not in (1, b) or mh not in (1, h) or \
+            msq not in (1, sq):
+        return "fallback"
+    return "key" if msq == 1 else "full"
+
+
+def _canon_mask(m):
+    """Numeric canonicalization: bool→additive, f32, 4D."""
+    if m.dtype == jnp.bool_:
+        m = jnp.where(m, 0.0, _NEG_INF).astype(jnp.float32)
+    else:
+        m = m.astype(jnp.float32)
+    while m.ndim < 4:
+        m = m[None]
+    return m
+
+
+def _mask_operand(mask, mode, h, sq_pad, sk_pad):
+    """mask (mb,mh,msq,msk) → ((G, {1|SQp}, SKp) array, bh→G index fn)."""
+    mb, mh, msq, msk = mask.shape
+    pad_q = (sq_pad - msq) if mode == "full" else 0
+    m = jnp.pad(mask, [(0, 0), (0, 0), (0, pad_q), (0, sk_pad - msk)])
+    m3 = m.reshape(mb * mh, m.shape[2], sk_pad)
+
+    def bh_to_g(i):
+        if mb == 1 and mh == 1:
+            return 0
+        if mb == 1:
+            return i % h
+        if mh == 1:
+            return i // h
+        return i
+
+    return m3, bh_to_g
+
+
+def _pad_axis(x, axis, new):
+    if x.shape[axis] == new:
+        return x
+    pads = [(0, 0)] * x.ndim
+    pads[axis] = (0, new - x.shape[axis])
+    return jnp.pad(x, pads)
+
+
+def _lanes(stat, sq_pad):
+    """(BH, SQ, 1) row stat → transient lane-replicated (BH, SQp, LANES)."""
+    stat = _pad_axis(stat, 1, sq_pad)
+    return jnp.broadcast_to(stat, stat.shape[:2] + (_LANES,))
+
+
+def _flash_fwd_res(q, k, v, mask, mask_mode, seed, causal, scale, block_q,
+                   block_k, dropout_p):
     from . import interpret_mode
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    bq = min(block_q, sq)
+    bq = min(block_q, max(sq, 8))
     bk = min(block_k, sk)
-    q3 = q.reshape(b * h, sq, d)
-    k3 = k.reshape(b * h, sk, d)
-    v3 = v.reshape(b * h, sk, d)
     # pad K/V up to a block multiple: a manual pl.ds read past the end
     # CLAMPS its start (dynamic-slice semantics) and would silently re-read
-    # earlier rows; the kernel masks positions >= true sk
+    # earlier rows; the kernels mask positions >= the true sk
     sk_pad = -(-sk // bk) * bk
-    if sk_pad != sk:
-        padw = [(0, 0), (0, sk_pad - sk), (0, 0)]
-        k3 = jnp.pad(k3, padw)
-        v3 = jnp.pad(v3, padw)
+    sq_pad = -(-sq // bq) * bq
+    q3 = q.reshape(b * h, sq, d)
+    k3 = _pad_axis(k.reshape(b * h, sk, d), 1, sk_pad)
+    v3 = _pad_axis(v.reshape(b * h, sk, d), 1, sk_pad)
     s = scale if scale is not None else 1.0 / np.sqrt(d)
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_k=bk, sk=sk, causal=causal,
-                          scale=s, block_q=bq),
+    threshold = min(int(dropout_p * 4294967296.0), 4294967295)
+
+    if mask_mode in ("key", "full"):
+        m3, bh_to_g = _mask_operand(mask, mask_mode, h, sq_pad, sk_pad)
+        if mask_mode == "key":
+            mspec = pl.BlockSpec((1, 1, sk_pad),
+                                 lambda i, j: (bh_to_g(i), 0, 0),
+                                 memory_space=pltpu.VMEM)
+        else:  # block the query dim: only (BQ, SKp) of bias in VMEM
+            mspec = pl.BlockSpec((1, bq, sk_pad),
+                                 lambda i, j: (bh_to_g(i), j, 0),
+                                 memory_space=pltpu.VMEM)
+    else:
+        m3 = jnp.zeros((1, 1, sk_pad), jnp.float32)
+        mspec = pl.BlockSpec((1, 1, sk_pad), lambda i, j: (0, 0, 0),
+                             memory_space=pltpu.VMEM)
+    seed2 = jnp.asarray(seed, jnp.int32).reshape(2)
+    interp = interpret_mode()
+    drop_mode = "mask" if (interp and dropout_p > 0.0) else "prng"
+    if drop_mode == "mask":
+        keep3 = _host_keep_mask(seed2, b * h, sq_pad, sk_pad, dropout_p)
+        kspec = pl.BlockSpec((1, bq, sk_pad), lambda i, j: (i, j, 0),
+                             memory_space=pltpu.VMEM)
+    else:
+        keep3 = jnp.zeros((1, 1, 1), jnp.float32)
+        kspec = pl.BlockSpec((1, 1, 1), lambda i, j: (0, 0, 0),
+                             memory_space=pltpu.VMEM)
+
+    out, mrow, lrow = pl.pallas_call(
+        functools.partial(
+            _fwd_kernel, block_q=bq, block_k=bk, sq=sq, sk=sk,
+            causal=causal, scale=s, mask_mode=mask_mode,
+            dropout_p=dropout_p, threshold=threshold, drop_mode=drop_mode),
         grid=(b * h, pl.cdiv(sq, bq)),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, sk_pad, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, sk_pad, d), lambda i, j: (i, 0, 0),
                          memory_space=pltpu.VMEM),
+            mspec,
+            kspec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, _LANES), jnp.float32),
+        ],
+        interpret=interp,
+    )(seed2, q3, k3, v3, m3, keep3)
+    # keep only one lane as residuals (128× smaller across the fwd→bwd gap)
+    return out.reshape(b, h, sq, d), mrow[..., :1], lrow[..., :1]
+
+
+def _flash_bwd(q, k, v, mask, mask_mode, seed, out, mrow, lrow, g, causal,
+               scale, block_q, block_k, dropout_p):
+    from . import interpret_mode
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, max(sq, 8))
+    bk = min(block_k, sk)
+    sk_pad = -(-sk // bk) * bk
+    sq_pad = -(-sq // bq) * bq
+    s = scale if scale is not None else 1.0 / np.sqrt(d)
+    threshold = min(int(dropout_p * 4294967296.0), 4294967295)
+
+    q3 = q.reshape(b * h, sq, d)
+    k3 = _pad_axis(k.reshape(b * h, sk, d), 1, sk_pad)
+    v3 = _pad_axis(v.reshape(b * h, sk, d), 1, sk_pad)
+    do3 = g.reshape(b * h, sq, d)
+    # delta_i = Σ_d dO_id·O_id (= Σ_k p_ik·dp_ik — valid under dropout too)
+    delta = jnp.sum(do3.astype(jnp.float32) *
+                    out.reshape(b * h, sq, d).astype(jnp.float32), axis=-1,
+                    keepdims=True)
+    linv = 1.0 / jnp.maximum(lrow, 1e-20)
+    mb_l = _lanes(mrow, sq_pad)
+    linv_l = _lanes(linv, sq_pad)
+    delta_l = _lanes(delta, sq_pad)
+
+    if mask_mode in ("key", "full"):
+        m3, bh_to_g = _mask_operand(mask, mask_mode, h, sq_pad, sk_pad)
+    else:
+        m3 = jnp.zeros((1, 1, sk_pad), jnp.float32)
+        bh_to_g = lambda i: 0
+    seed2 = jnp.asarray(seed, jnp.int32).reshape(2)
+    msq_blk = 1 if mask_mode != "full" else sq_pad
+    interp = interpret_mode()
+    drop_mode = "mask" if (interp and dropout_p > 0.0) else "prng"
+    if drop_mode == "mask":
+        keep3 = _host_keep_mask(seed2, b * h, sq_pad, sk_pad, dropout_p)
+        kspec_q = pl.BlockSpec((1, bq, sk_pad), lambda i, j: (i, j, 0),
+                               memory_space=pltpu.VMEM)
+        kspec_kv = pl.BlockSpec((1, sq_pad, bk), lambda i, j: (i, 0, j),
+                                memory_space=pltpu.VMEM)
+    else:
+        keep3 = jnp.zeros((1, 1, 1), jnp.float32)
+        kspec_q = pl.BlockSpec((1, 1, 1), lambda i, j: (0, 0, 0),
+                               memory_space=pltpu.VMEM)
+        kspec_kv = kspec_q
+    if mask_mode == "full":
+        mspec_q = pl.BlockSpec((1, bq, sk_pad),
+                               lambda i, j: (bh_to_g(i), j, 0),
+                               memory_space=pltpu.VMEM)
+    else:
+        mspec_q = pl.BlockSpec((1, 1, sk_pad),
+                               lambda i, j: (bh_to_g(i), 0, 0),
+                               memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, block_q=bq, block_k=bk, sq=sq, sk=sk,
+            causal=causal, scale=s, mask_mode=mask_mode,
+            dropout_p=dropout_p, threshold=threshold, drop_mode=drop_mode),
+        grid=(b * h, pl.cdiv(sq, bq)),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sk_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            mspec_q,
+            kspec_q,
+            pl.BlockSpec((1, bq, _LANES), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, _LANES), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda i, j: (i, j, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-        interpret=interpret_mode(),
-    )(q3, k3, v3)
-    return out.reshape(b, h, sq, d)
+        interpret=interp,
+    )(seed2, q3, k3, v3, m3, keep3, mb_l, linv_l, delta_l, do3)
+
+    # dK/dV pass needs whole-Q operands padded to the block multiple
+    q3p = _pad_axis(q3, 1, sq_pad)
+    do3p = _pad_axis(do3, 1, sq_pad)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, block_q=bq, block_k=bk, sq=sq, sk=sk,
+            causal=causal, scale=s, mask_mode=mask_mode,
+            dropout_p=dropout_p, threshold=threshold, drop_mode=drop_mode),
+        grid=(b * h, pl.cdiv(sk, bk)),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, sq_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, msq_blk, bk), lambda i, j: (bh_to_g(i), 0, j),
+                         memory_space=pltpu.VMEM),
+            kspec_kv,
+            pl.BlockSpec((1, sq_pad, _LANES), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq_pad, _LANES), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq_pad, _LANES), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, sq_pad, d), lambda i, j: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, bk, d), lambda i, j: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk_pad, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk_pad, d), v.dtype),
+        ],
+        interpret=interp,
+    )(seed2, q3p, k3, v3, m3, keep3, mb_l, linv_l, delta_l, do3p)
+    dk = dk[:, :sk].reshape(b, h, sk, d)
+    dv = dv[:, :sk].reshape(b, h, sk, d)
+    return dq.reshape(b, h, sq, d), dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 6, 7, 8, 9, 10))
+def _flash(q, k, v, mask, mask_mode, seed, causal, scale, block_q, block_k,
+           dropout_p):
+    out, _, _ = _flash_fwd_res(q, k, v, mask, mask_mode, seed, causal,
+                               scale, block_q, block_k, dropout_p)
+    return out
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k):
-    out = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+def _fwd(q, k, v, mask, mask_mode, seed, causal, scale, block_q, block_k,
+         dropout_p):
+    out, mrow, lrow = _flash_fwd_res(q, k, v, mask, mask_mode, seed, causal,
+                                     scale, block_q, block_k, dropout_p)
+    return out, (q, k, v, mask, seed, out, mrow, lrow)
 
 
-def _bwd(causal, scale, block_q, block_k, res, g):
-    # recompute-based backward (XLA): standard attention gradients
-    q, k, v = res
-    d = q.shape[-1]
-    s = scale if scale is not None else 1.0 / np.sqrt(d)
-
-    def ref_attn(q, k, v):
-        logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                            k.astype(jnp.float32)) * s
-        if causal:
-            # top-left aligned (query i sees keys j <= i), matching the
-            # forward kernel's absolute-position mask for sq != sk
-            sq, sk = logits.shape[-2:]
-            mask = jnp.tril(jnp.ones((sq, sk), bool))
-            logits = jnp.where(mask, logits, -1e30)
-        p = jax.nn.softmax(logits, axis=-1)
-        return jnp.einsum("bhqk,bhkd->bhqd", p,
-                          v.astype(jnp.float32)).astype(q.dtype)
-
-    _, vjp = jax.vjp(ref_attn, q, k, v)
-    return vjp(g)
+def _bwd(mask_mode, causal, scale, block_q, block_k, dropout_p, res, g):
+    q, k, v, mask, seed, out, mrow, lrow = res
+    dq, dk, dv = _flash_bwd(q, k, v, mask, mask_mode, seed, out, mrow,
+                            lrow, g, causal, scale, block_q, block_k,
+                            dropout_p)
+    # mask is an input-derived bias — not differentiated (reference parity)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    dseed = np.zeros(np.shape(seed), jax.dtypes.float0)
+    return dq, dk, dv, dmask, dseed
 
 
 _flash.defvjp(_fwd, _bwd)
 
 
 def flash_attention(q, k, v, attn_mask=None, causal=False, scale=None,
-                    block_q=256, block_k=256, dropout_p=0.0, training=False,
-                    name=None):
-    """Framework op: flash attention over (B, H, S, D). attn_mask and
-    attention dropout are not fused — both fall back to plain sdpa so
-    behavior matches the unfused path exactly."""
+                    block_q=512, block_k=512, dropout_p=0.0, training=False,
+                    force=False, name=None):
+    """Framework op: flash attention over (B, H, S, D). The additive (or
+    bool) attn_mask and attention-probability dropout are fused into the
+    kernels; mask shapes the kernel can't tile (non-broadcastable to
+    (B,H,Sq,Sk)) fall back to plain sdpa with identical semantics.
+    Off-TPU the op also falls back to sdpa (the interpret-mode kernel is
+    emulator-speed) unless force=True (kernel correctness tests)."""
     from ...dispatch import apply
-    if attn_mask is not None or (dropout_p > 0.0 and training):
-        from ..nn_ops import scaled_dot_product_attention
-        return scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=causal, scale=scale,
-            dropout_p=dropout_p, training=training)
+    from ... import random as prandom
+    from . import on_tpu
 
-    def impl(q, k, v):
-        return _flash(q, k, v, causal, scale, block_q, block_k)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    p_drop = float(dropout_p) if training else 0.0
+    has_mask = attn_mask is not None
+    mode = _mask_mode(attn_mask.shape if has_mask else None, b, h, sq, sk)
+    if mode == "fallback" or (not on_tpu() and not force):
+        from ..nn_ops import scaled_dot_product_attention as sdpa
+        return sdpa(q, k, v, attn_mask=attn_mask, is_causal=causal,
+                    scale=scale, dropout_p=p_drop, training=training)
 
-    return apply(impl, (q, k, v), name="pallas_flash_attention")
+    def impl(q, k, v, *rest):
+        m = _canon_mask(rest[0]) if has_mask else None
+        if p_drop > 0.0:
+            raw = jnp.ravel(rest[-1])[:2]
+            seed = jax.lax.bitcast_convert_type(raw, jnp.int32)
+        else:
+            seed = jnp.zeros((2,), jnp.int32)
+        return _flash(q, k, v, m, mode, seed, causal, scale, block_q,
+                      block_k, p_drop)
+
+    args = (q, k, v)
+    if has_mask:
+        args = args + (attn_mask,)
+    if p_drop > 0.0:
+        args = args + (prandom.next_key_graph(),)
+    return apply(impl, args, name="pallas_flash_attention")

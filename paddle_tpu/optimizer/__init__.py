@@ -326,9 +326,12 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, lazy_mode=False,
-                 use_fused=False, **kw):
+                 use_fused=None, **kw):
         super().__init__(learning_rate, parameters, **kw)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        if use_fused is None:  # auto: Pallas fused update on TPU
+            from ..ops.pallas import on_tpu
+            use_fused = on_tpu()
         self._use_fused = use_fused
 
     def _pre_param(self, p):

@@ -1,0 +1,415 @@
+"""Detection op tests vs numpy references (SURVEY §4; reference test
+strategy: python/paddle/fluid/tests/unittests/test_*_op.py for yolo_box,
+multiclass_nms, iou_similarity, box_coder, roi_align...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops import detection as D
+
+
+def np_iou(a, b, normalized=True):
+    off = 0.0 if normalized else 1.0
+    n, m = a.shape[0], b.shape[0]
+    out = np.zeros((n, m), "f4")
+    for i in range(n):
+        for j in range(m):
+            ix1 = max(a[i, 0], b[j, 0])
+            iy1 = max(a[i, 1], b[j, 1])
+            ix2 = min(a[i, 2], b[j, 2])
+            iy2 = min(a[i, 3], b[j, 3])
+            iw = max(ix2 - ix1 + off, 0.0)
+            ih = max(iy2 - iy1 + off, 0.0)
+            inter = iw * ih
+            ua = max(a[i, 2] - a[i, 0] + off, 0) * \
+                max(a[i, 3] - a[i, 1] + off, 0)
+            ub = max(b[j, 2] - b[j, 0] + off, 0) * \
+                max(b[j, 3] - b[j, 1] + off, 0)
+            u = ua + ub - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+def rand_boxes(rng, n, scale=100.0):
+    xy = rng.rand(n, 2) * scale
+    wh = rng.rand(n, 2) * scale * 0.3 + 1.0
+    return np.concatenate([xy, xy + wh], -1).astype("f4")
+
+
+class TestGeometry:
+    def test_iou_similarity(self):
+        rng = np.random.RandomState(0)
+        a, b = rand_boxes(rng, 7), rand_boxes(rng, 5)
+        got = D.iou_similarity(pt.to_tensor(a), pt.to_tensor(b)).numpy()
+        np.testing.assert_allclose(got, np_iou(a, b), rtol=1e-5, atol=1e-6)
+
+    def test_iou_unnormalized(self):
+        rng = np.random.RandomState(1)
+        a, b = rand_boxes(rng, 4), rand_boxes(rng, 4)
+        got = D.iou_similarity(pt.to_tensor(a), pt.to_tensor(b),
+                               box_normalized=False).numpy()
+        np.testing.assert_allclose(got, np_iou(a, b, False), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(2)
+        priors = rand_boxes(rng, 6, 1.0)
+        targets = rand_boxes(rng, 3, 1.0)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = D.box_coder(pt.to_tensor(priors), var, pt.to_tensor(targets),
+                          code_type="encode_center_size")
+        dec = D.box_coder(pt.to_tensor(priors), var, enc,
+                          code_type="decode_center_size", axis=0)
+        # decoding the encoding of target t against prior m recovers t
+        dec = dec.numpy()
+        for i in range(3):
+            for j in range(6):
+                np.testing.assert_allclose(dec[i, j], targets[i], rtol=1e-4,
+                                           atol=1e-4)
+
+    def test_box_clip(self):
+        rng = np.random.RandomState(3)
+        boxes = rand_boxes(rng, 8, 300.0)
+        im = np.array([[200.0, 150.0, 1.0]], "f4")
+        got = D.box_clip(pt.to_tensor(boxes), pt.to_tensor(im)).numpy()
+        assert got[..., 0].max() <= 149.0 and got[..., 1].max() <= 199.0
+        assert got.min() >= 0.0
+
+    def test_polygon_box_transform(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 8, 3, 4).astype("f4")
+        got = D.polygon_box_transform(pt.to_tensor(x)).numpy()
+        # channel 0 is x-offset at every pixel: out = col_index - offset
+        cols = np.tile(np.arange(4, dtype="f4"), (3, 1))
+        np.testing.assert_allclose(got[0, 0], cols - x[0, 0], rtol=1e-6)
+        rows = np.tile(np.arange(3, dtype="f4")[:, None], (1, 4))
+        np.testing.assert_allclose(got[1, 3], rows - x[1, 3], rtol=1e-6)
+
+
+class TestPriors:
+    def test_prior_box_shapes_and_range(self):
+        feat = pt.to_tensor(np.zeros((1, 8, 4, 6), "f4"))
+        img = pt.to_tensor(np.zeros((1, 3, 64, 96), "f4"))
+        boxes, var = D.prior_box(feat, img, min_sizes=[16.0],
+                                 max_sizes=[32.0], aspect_ratios=[2.0],
+                                 flip=True, clip=True)
+        # priors: 1 (ar=1,min) + 1 (sqrt(min*max)) + 2 (ar=2, 1/2) = 4
+        assert boxes.shape == [4, 6, 4, 4]
+        b = boxes.numpy()
+        assert b.min() >= 0.0 and b.max() <= 1.0
+        assert var.shape == [4, 6, 4, 4]
+        np.testing.assert_allclose(var.numpy()[0, 0, 0], [0.1, 0.1, 0.2,
+                                                          0.2], rtol=1e-6)
+        # centers step across the image uniformly
+        cx = (b[..., 0] + b[..., 2]) / 2
+        np.testing.assert_allclose(cx[0, 1, 0] - cx[0, 0, 0], 16.0 / 96,
+                                   rtol=1e-4)
+
+    def test_density_prior_box(self):
+        feat = pt.to_tensor(np.zeros((1, 8, 3, 3), "f4"))
+        img = pt.to_tensor(np.zeros((1, 3, 48, 48), "f4"))
+        boxes, var = D.density_prior_box(feat, img, densities=[2],
+                                         fixed_sizes=[8.0],
+                                         fixed_ratios=[1.0],
+                                         flatten_to_2d=True)
+        assert boxes.shape == [3 * 3 * 4, 4]
+
+    def test_anchor_generator(self):
+        feat = pt.to_tensor(np.zeros((1, 8, 5, 5), "f4"))
+        anchors, var = D.anchor_generator(feat, anchor_sizes=[64.0],
+                                          aspect_ratios=[1.0],
+                                          stride=[16.0, 16.0])
+        assert anchors.shape == [5, 5, 1, 4]
+        a = anchors.numpy()[2, 2, 0]
+        # centered at (2.5*16) with size 64
+        np.testing.assert_allclose((a[0] + a[2]) / 2, 40.0, atol=0.5)
+        np.testing.assert_allclose(a[2] - a[0] + 1, 64.0, atol=1.0)
+
+
+class TestYolo:
+    def test_yolo_box_decode(self):
+        rng = np.random.RandomState(5)
+        n, na, c, h, w = 2, 2, 3, 4, 4
+        x = rng.randn(n, na * (5 + c), h, w).astype("f4")
+        img = np.array([[128, 128], [64, 96]], "i4")
+        anchors = [10, 14, 23, 27]
+        boxes, scores = D.yolo_box(pt.to_tensor(x), pt.to_tensor(img),
+                                   anchors, c, 0.01, 32)
+        assert boxes.shape == [n, h * w * na, 4]
+        assert scores.shape == [n, h * w * na, c]
+        # manual decode of one cell
+        x5 = x.reshape(n, na, 5 + c, h, w)
+        i, a, gy, gx = 0, 1, 1, 2
+        sig = lambda v: 1 / (1 + np.exp(-v))
+        bx = (gx + sig(x5[i, a, 0, gy, gx])) / w * 128
+        by = (gy + sig(x5[i, a, 1, gy, gx])) / h * 128
+        bw = np.exp(x5[i, a, 2, gy, gx]) * anchors[2] / (32 * h) * 128
+        bh = np.exp(x5[i, a, 3, gy, gx]) * anchors[3] / (32 * h) * 128
+        conf = sig(x5[i, a, 4, gy, gx])
+        exp = np.array([max(bx - bw / 2, 0), max(by - bh / 2, 0),
+                        min(bx + bw / 2, 127), min(by + bh / 2, 127)])
+        flat = (gy * w + gx) * na + a
+        got = boxes.numpy()[i, flat]
+        if conf > 0.01:
+            np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-3)
+            np.testing.assert_allclose(
+                scores.numpy()[i, flat],
+                sig(x5[i, a, 5:, gy, gx]) * conf, rtol=1e-4, atol=1e-5)
+
+    def test_yolov3_loss_runs_and_grads(self):
+        rng = np.random.RandomState(6)
+        n, nb, c, h, w = 2, 3, 4, 4, 4
+        anchors = [10, 14, 23, 27, 37, 58]
+        mask = [0, 1]
+        x = pt.to_tensor(rng.randn(n, 2 * (5 + c), h, w).astype("f4"))
+        x.stop_gradient = False
+        gt = rng.rand(n, nb, 4).astype("f4") * 0.5 + 0.25
+        gt[:, :, 2:] *= 0.3
+        gt[1, 2] = 0  # padded slot
+        lbl = rng.randint(0, c, (n, nb)).astype("i4")
+        loss = D.yolov3_loss(x, pt.to_tensor(gt), pt.to_tensor(lbl),
+                             anchors, mask, c, 0.7, 32)
+        assert loss.shape == [n]
+        total = loss.sum()
+        total.backward()
+        g = np.asarray(x.grad)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+        assert np.isfinite(loss.numpy()).all()
+
+    def test_yolov3_loss_padded_slot_ignored(self):
+        rng = np.random.RandomState(7)
+        n, c, h, w = 1, 3, 4, 4
+        anchors = [10, 14, 23, 27]
+        x = rng.randn(n, 2 * (5 + c), h, w).astype("f4")
+        gt1 = np.zeros((n, 2, 4), "f4")
+        gt1[0, 0] = [0.5, 0.5, 0.2, 0.2]
+        lbl1 = np.zeros((n, 2), "i4")
+        gt2 = gt1[:, :1]
+        lbl2 = lbl1[:, :1]
+        l1 = D.yolov3_loss(pt.to_tensor(x), pt.to_tensor(gt1),
+                           pt.to_tensor(lbl1), anchors, [0, 1], c, 0.7, 32)
+        l2 = D.yolov3_loss(pt.to_tensor(x), pt.to_tensor(gt2),
+                           pt.to_tensor(lbl2), anchors, [0, 1], c, 0.7, 32)
+        np.testing.assert_allclose(l1.numpy(), l2.numpy(), rtol=1e-5)
+
+
+class TestFocal:
+    def test_sigmoid_focal_loss(self):
+        rng = np.random.RandomState(8)
+        n, c = 6, 5
+        x = rng.randn(n, c).astype("f4")
+        lbl = rng.randint(0, c + 1, (n, 1)).astype("i4")
+        fg = np.array([3], "i4")
+        got = D.sigmoid_focal_loss(pt.to_tensor(x), pt.to_tensor(lbl),
+                                   pt.to_tensor(fg), gamma=2.0,
+                                   alpha=0.25).numpy()
+        sig = 1 / (1 + np.exp(-x))
+        exp = np.zeros_like(x)
+        for i in range(n):
+            for j in range(c):
+                t = 1.0 if lbl[i, 0] == j + 1 else 0.0
+                p = sig[i, j]
+                pt_ = t * p + (1 - t) * (1 - p)
+                a_t = t * 0.25 + (1 - t) * 0.75
+                ce = -(t * np.log(p) + (1 - t) * np.log(1 - p))
+                exp[i, j] = a_t * (1 - pt_) ** 2 * ce / 3.0
+        np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+class TestMatching:
+    def test_bipartite_match_greedy(self):
+        dist = np.array([[[0.9, 0.1, 0.3],
+                          [0.8, 0.7, 0.2]]], "f4")
+        mi, md = D.bipartite_match(pt.to_tensor(dist))
+        # greedy: (0,0)=0.9 first, then row1 best remaining col=1 (0.7)
+        np.testing.assert_array_equal(mi.numpy()[0], [0, 1, -1])
+        np.testing.assert_allclose(md.numpy()[0], [0.9, 0.7, 0.0])
+
+    def test_bipartite_per_prediction(self):
+        dist = np.array([[[0.9, 0.6, 0.3],
+                          [0.8, 0.7, 0.2]]], "f4")
+        mi, md = D.bipartite_match(pt.to_tensor(dist),
+                                   match_type="per_prediction",
+                                   dist_threshold=0.5)
+        # col2 best row is 0 with 0.3 < 0.5 → stays -1; col1 gets row 1
+        assert mi.numpy()[0, 0] == 0 and mi.numpy()[0, 1] == 1
+        assert mi.numpy()[0, 2] == -1
+
+    def test_target_assign(self):
+        inp = np.arange(24, dtype="f4").reshape(1, 6, 4)
+        match = np.array([[2, -1, 0]], "i4")
+        out, wt = D.target_assign(pt.to_tensor(inp), pt.to_tensor(match),
+                                  mismatch_value=0)
+        np.testing.assert_allclose(out.numpy()[0, 0], inp[0, 2])
+        np.testing.assert_allclose(out.numpy()[0, 1], np.zeros(4))
+        np.testing.assert_allclose(wt.numpy()[0, :, 0], [1, 0, 1])
+
+
+class TestNMS:
+    def test_nms_suppression(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                           [0, 0, 0, 0]]], "f4")
+        scores = np.zeros((1, 2, 4), "f4")
+        scores[0, 1] = [0.9, 0.8, 0.7, 0.0]  # class 1
+        out, num = D.multiclass_nms(pt.to_tensor(boxes),
+                                    pt.to_tensor(scores),
+                                    score_threshold=0.1, nms_top_k=4,
+                                    keep_top_k=3, nms_threshold=0.5,
+                                    background_label=0)
+        o, n = out.numpy()[0], int(num.numpy()[0])
+        assert n == 2  # box1 suppressed by box0, zero-box below threshold
+        assert o[0, 0] == 1 and abs(o[0, 1] - 0.9) < 1e-6
+        np.testing.assert_allclose(o[0, 2:], [0, 0, 10, 10])
+        np.testing.assert_allclose(o[1, 2:], [50, 50, 60, 60])
+        assert o[2, 0] == -1  # sentinel
+
+    def test_multiclass(self):
+        boxes = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "f4")
+        scores = np.zeros((1, 3, 2), "f4")
+        scores[0, 1] = [0.9, 0.2]
+        scores[0, 2] = [0.1, 0.8]
+        out, num = D.multiclass_nms(pt.to_tensor(boxes),
+                                    pt.to_tensor(scores), 0.15, 2, 4, 0.5,
+                                    background_label=0)
+        assert int(num.numpy()[0]) == 3
+        labels = sorted(out.numpy()[0, :3, 0].tolist())
+        assert labels == [1.0, 1.0, 2.0]
+
+    def test_detection_output_runs(self):
+        rng = np.random.RandomState(9)
+        m = 8
+        priors = np.sort(rng.rand(m, 4).astype("f4"), axis=-1)
+        pvar = np.tile(np.array([0.1, 0.1, 0.2, 0.2], "f4"), (m, 1))
+        loc = rng.randn(2, m, 4).astype("f4") * 0.1
+        conf = rng.randn(2, m, 3).astype("f4")
+        out, num = D.detection_output(pt.to_tensor(loc),
+                                      pt.to_tensor(conf),
+                                      pt.to_tensor(priors),
+                                      pt.to_tensor(pvar),
+                                      keep_top_k=5)
+        assert out.shape == [2, 5, 6]
+        assert np.isfinite(out.numpy()).all()
+
+
+class TestSSDLoss:
+    def test_ssd_loss_runs_and_positive(self):
+        rng = np.random.RandomState(10)
+        b, m, g, c = 2, 12, 3, 4
+        priors = np.sort(rng.rand(m, 4).astype("f4") * 0.8, axis=-1)
+        priors[:, 2:] = priors[:, :2] + 0.2
+        loc = pt.to_tensor(rng.randn(b, m, 4).astype("f4") * 0.1)
+        conf = pt.to_tensor(rng.randn(b, m, c).astype("f4"))
+        loc.stop_gradient = False
+        conf.stop_gradient = False
+        gt = np.zeros((b, g, 4), "f4")
+        gt[:, :2] = np.sort(rng.rand(b, 2, 4) * 0.8, axis=-1)
+        gt[:, :2, 2:] = gt[:, :2, :2] + 0.25
+        lbl = rng.randint(1, c, (b, g)).astype("i4")
+        loss = D.ssd_loss(loc, conf, pt.to_tensor(gt), pt.to_tensor(lbl),
+                          pt.to_tensor(priors))
+        assert loss.shape == [b, m]
+        s = loss.sum()
+        assert float(s.numpy()) > 0
+        s.backward()
+        assert np.isfinite(np.asarray(conf.grad)).all()
+
+
+class TestRoI:
+    def test_roi_align_center_value(self):
+        # constant image → every pooled value equals the constant
+        x = np.full((1, 2, 8, 8), 3.0, "f4")
+        rois = np.array([[0.0, 0.0, 7.0, 7.0]], "f4")
+        out = D.roi_align(pt.to_tensor(x), pt.to_tensor(rois), 2, 2, 1.0)
+        assert out.shape == [1, 2, 2, 2]
+        np.testing.assert_allclose(out.numpy(), 3.0, rtol=1e-5)
+
+    def test_roi_align_gradient(self):
+        rng = np.random.RandomState(11)
+        x = pt.to_tensor(rng.rand(1, 1, 6, 6).astype("f4"))
+        x.stop_gradient = False
+        rois = pt.to_tensor(np.array([[1.0, 1.0, 4.0, 4.0]], "f4"))
+        out = D.roi_align(x, rois, 2, 2, 1.0, sampling_ratio=2)
+        out.sum().backward()
+        assert np.abs(np.asarray(x.grad)).sum() > 0
+
+    def test_roi_pool_max(self):
+        x = np.arange(16, dtype="f4").reshape(1, 1, 4, 4)
+        rois = np.array([[0.0, 0.0, 3.0, 3.0]], "f4")
+        out = D.roi_pool(pt.to_tensor(x), pt.to_tensor(rois), 2, 2, 1.0)
+        np.testing.assert_allclose(out.numpy()[0, 0],
+                                   [[5.0, 7.0], [13.0, 15.0]])
+
+
+class TestProposals:
+    def test_generate_proposals_shapes(self):
+        rng = np.random.RandomState(12)
+        n, a, h, w = 1, 3, 4, 4
+        scores = rng.rand(n, a, h, w).astype("f4")
+        deltas = rng.randn(n, 4 * a, h, w).astype("f4") * 0.1
+        im_info = np.array([[64.0, 64.0, 1.0]], "f4")
+        feat = pt.to_tensor(np.zeros((n, 8, h, w), "f4"))
+        anchors, var = D.anchor_generator(feat, anchor_sizes=[16.0, 32.0,
+                                                              64.0],
+                                          aspect_ratios=[1.0],
+                                          stride=[16.0, 16.0])
+        props, sc = D.generate_proposals(pt.to_tensor(scores),
+                                         pt.to_tensor(deltas),
+                                         pt.to_tensor(im_info), anchors,
+                                         var, pre_nms_top_n=20,
+                                         post_nms_top_n=8, min_size=1.0)
+        assert props.shape == [n, 8, 4]
+        p = props.numpy()
+        assert p.min() >= 0.0 and p.max() <= 63.0
+
+    def test_distribute_and_collect_fpn(self):
+        rng = np.random.RandomState(13)
+        rois = rand_boxes(rng, 10, 200.0)
+        outs = D.distribute_fpn_proposals(pt.to_tensor(rois), 2, 5, 4, 224)
+        assert len(outs) == 2 * 4 + 1
+        lvl_rois = [outs[2 * i] for i in range(4)]
+        masks = [outs[2 * i + 1] for i in range(4)]
+        total = sum(m.numpy().sum() for m in masks)
+        assert total == 10
+        scores = [pt.to_tensor(rng.rand(10).astype("f4")) for _ in range(4)]
+        merged, ms = D.collect_fpn_proposals(lvl_rois, scores, 2, 5, 6)
+        assert merged.shape == [6, 4]
+
+
+class TestJit:
+    def test_yolo_pipeline_under_jit(self):
+        """SSD/YOLO loss pipelines compile under jit (VERDICT #3 done
+        criterion)."""
+        from paddle_tpu import jit
+        rng = np.random.RandomState(14)
+        n, nb, c, h, w = 2, 3, 4, 4, 4
+        anchors = [10, 14, 23, 27]
+
+        def step(x, gt, lbl):
+            return D.yolov3_loss(x, gt, lbl, anchors, [0, 1], c, 0.7,
+                                 32).sum()
+
+        fn = jit.to_static(step)
+        x = pt.to_tensor(rng.randn(n, 2 * (5 + c), h, w).astype("f4"))
+        gt = pt.to_tensor((rng.rand(n, nb, 4) * 0.4 + 0.2).astype("f4"))
+        lbl = pt.to_tensor(rng.randint(0, c, (n, nb)).astype("i4"))
+        eager = step(x, gt, lbl)
+        jitted = fn(x, gt, lbl)
+        np.testing.assert_allclose(eager.numpy(), jitted.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_nms_under_jit(self):
+        from paddle_tpu import jit
+        rng = np.random.RandomState(15)
+        boxes = pt.to_tensor(rand_boxes(rng, 16, 50.0)[None])
+        scores = pt.to_tensor(rng.rand(1, 3, 16).astype("f4"))
+
+        def f(b, s):
+            out, num = D.multiclass_nms(b, s, 0.2, 8, 5, 0.4,
+                                        background_label=0)
+            return out, num
+
+        fn = jit.to_static(f)
+        o1, n1 = f(boxes, scores)
+        o2, n2 = fn(boxes, scores)
+        np.testing.assert_allclose(o1.numpy(), o2.numpy(), rtol=1e-5)
+        assert int(n1.numpy()[0]) == int(n2.numpy()[0])

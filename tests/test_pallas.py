@@ -72,7 +72,7 @@ def test_flash_attention_matches_sdpa(causal):
     k = rng.randn(b, h, s, d).astype("f4")
     v = rng.randn(b, h, s, d).astype("f4")
     out = flash_attention(pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
-                          causal=causal, block_q=32, block_k=32)
+                          causal=causal, block_q=32, block_k=32, force=True)
     logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
     if causal:
         mask = np.tril(np.ones((s, s), bool))
@@ -90,7 +90,7 @@ def test_flash_attention_backward():
     k = pt.to_tensor(rng.randn(b, h, s, d).astype("f4"), stop_gradient=False)
     v = pt.to_tensor(rng.randn(b, h, s, d).astype("f4"), stop_gradient=False)
     flash_attention(q, k, v, causal=True, block_q=16,
-                    block_k=16).sum().backward()
+                    block_k=16, force=True).sum().backward()
     from paddle_tpu.nn import functional as F
     q2 = pt.to_tensor(q.numpy(), stop_gradient=False)
     k2 = pt.to_tensor(k.numpy(), stop_gradient=False)
@@ -156,7 +156,7 @@ def test_flash_attention_unaligned_seq():
     k = rng.randn(b, h, s, d).astype("f4")
     v = rng.randn(b, h, s, d).astype("f4")
     out = flash_attention(pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
-                          block_q=32, block_k=32)
+                          block_q=32, block_k=32, force=True)
     logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d)
     e = np.exp(logits - logits.max(-1, keepdims=True))
     p = e / e.sum(-1, keepdims=True)
@@ -164,8 +164,161 @@ def test_flash_attention_unaligned_seq():
     np.testing.assert_allclose(out.numpy(), ref, atol=2e-3)
 
 
-def test_flash_attention_dropout_falls_back():
+def test_flash_attention_key_mask_fused():
+    """Additive key-padding mask ([B,1,1,Sk], the BERT shape) is fused into
+    the kernel and matches sdpa exactly (VERDICT r2 #1)."""
+    b, h, s, d = 2, 2, 32, 8
+    rng = np.random.RandomState(5)
+    q = rng.randn(b, h, s, d).astype("f4")
+    k = rng.randn(b, h, s, d).astype("f4")
+    v = rng.randn(b, h, s, d).astype("f4")
+    m = np.where(rng.rand(b, 1, 1, s) < 0.3, -1e9, 0.0).astype("f4")
+    out = flash_attention(pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v),
+                          attn_mask=pt.to_tensor(m), block_q=16,
+                          block_k=16, force=True)
+    logits = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(d) + m
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(out.numpy(), ref, atol=2e-3)
+
+
+def test_flash_attention_key_mask_grads():
+    """Backward through the fused key-mask path (the default BERT path)
+    matches sdpa — guards the (1,BK) broadcast branches in both backward
+    kernels."""
+    b, h, s, d = 2, 2, 24, 8
+    rng = np.random.RandomState(9)
+    qn = rng.randn(b, h, s, d).astype("f4")
+    kn = rng.randn(b, h, s, d).astype("f4")
+    vn = rng.randn(b, h, s, d).astype("f4")
+    mn = np.where(rng.rand(b, 1, 1, s) < 0.3, -1e9, 0.0).astype("f4")
+    q = pt.to_tensor(qn, stop_gradient=False)
+    k = pt.to_tensor(kn, stop_gradient=False)
+    v = pt.to_tensor(vn, stop_gradient=False)
+    flash_attention(q, k, v, attn_mask=pt.to_tensor(mn), block_q=16,
+                    block_k=16, force=True).sum().backward()
+    from paddle_tpu.nn import functional as F
+    q2 = pt.to_tensor(qn, stop_gradient=False)
+    k2 = pt.to_tensor(kn, stop_gradient=False)
+    v2 = pt.to_tensor(vn, stop_gradient=False)
+    F.scaled_dot_product_attention(
+        q2, k2, v2, attn_mask=pt.to_tensor(mn)).sum().backward()
+    for a, bb in ((q, q2), (k, k2), (v, v2)):
+        np.testing.assert_allclose(np.asarray(a.grad), np.asarray(bb.grad),
+                                   atol=3e-3)
+
+
+def test_flash_attention_fully_masked_row_grads():
+    """Regression (review r3): rows whose every visible key carries a
+    finite -1e9 bias must still produce sdpa-matching gradients — the
+    backward reconstructs p from (m, l), not the folded lse, so 1e9-scale
+    scores round identically to the forward."""
+    b, h, s, d = 1, 1, 24, 8
+    rng = np.random.RandomState(10)
+    qn = rng.randn(b, h, s, d).astype("f4")
+    kn = rng.randn(b, h, s, d).astype("f4")
+    vn = rng.randn(b, h, s, d).astype("f4")
+    mn = np.zeros((1, 1, s, s), "f4")
+    mn[0, 0, 3, :] = -1e9   # row 3 fully masked (finite bias, not -inf)
+    mn[0, 0, 7, :20] = -1e9  # row 7 nearly fully masked
+    q = pt.to_tensor(qn, stop_gradient=False)
+    k = pt.to_tensor(kn, stop_gradient=False)
+    v = pt.to_tensor(vn, stop_gradient=False)
+    flash_attention(q, k, v, attn_mask=pt.to_tensor(mn),
+                    block_q=8, block_k=8, force=True).sum().backward()
+    from paddle_tpu.nn import functional as F
+    q2 = pt.to_tensor(qn, stop_gradient=False)
+    k2 = pt.to_tensor(kn, stop_gradient=False)
+    v2 = pt.to_tensor(vn, stop_gradient=False)
+    F.scaled_dot_product_attention(
+        q2, k2, v2, attn_mask=pt.to_tensor(mn)).sum().backward()
+    for a, bb in ((q, q2), (k, k2), (v, v2)):
+        np.testing.assert_allclose(np.asarray(a.grad), np.asarray(bb.grad),
+                                   atol=3e-3)
+
+
+def test_flash_attention_full_mask_grads():
+    """Full [1,1,Sq,Sk] additive mask: forward + grads match sdpa."""
+    b, h, s, d = 1, 2, 24, 8
+    rng = np.random.RandomState(6)
+    qn = rng.randn(b, h, s, d).astype("f4")
+    kn = rng.randn(b, h, s, d).astype("f4")
+    vn = rng.randn(b, h, s, d).astype("f4")
+    mn = (rng.randn(1, 1, s, s) * 2).astype("f4")
+    q = pt.to_tensor(qn, stop_gradient=False)
+    k = pt.to_tensor(kn, stop_gradient=False)
+    v = pt.to_tensor(vn, stop_gradient=False)
+    flash_attention(q, k, v, attn_mask=pt.to_tensor(mn), block_q=16,
+                    block_k=16, force=True).sum().backward()
+    from paddle_tpu.nn import functional as F
+    q2 = pt.to_tensor(qn, stop_gradient=False)
+    k2 = pt.to_tensor(kn, stop_gradient=False)
+    v2 = pt.to_tensor(vn, stop_gradient=False)
+    F.scaled_dot_product_attention(
+        q2, k2, v2, attn_mask=pt.to_tensor(mn)).sum().backward()
+    for a, bb in ((q, q2), (k, k2), (v, v2)):
+        np.testing.assert_allclose(np.asarray(a.grad), np.asarray(bb.grad),
+                                   atol=3e-3)
+
+
+def test_flash_attention_dropout_fused():
+    """Attention dropout is fused in-kernel: deterministic per seed,
+    seed-sensitive, output stays correctly scaled (VERDICT r2 #1 — the
+    old sdpa fallback under dropout is gone)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import _flash
+    b, h, s, d = 1, 2, 16, 8
+    rng = np.random.RandomState(7)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    s1 = jnp.asarray([1, 2], jnp.int32)
+    s2 = jnp.asarray([3, 4], jnp.int32)
+    o1 = _flash(q, k, v, None, None, s1, False, None, 16, 16, 0.4)
+    o1b = _flash(q, k, v, None, None, s1, False, None, 16, 16, 0.4)
+    o2 = _flash(q, k, v, None, None, s2, False, None, 16, 16, 0.4)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o1b))
+    assert np.abs(np.asarray(o1) - np.asarray(o2)).max() > 1e-4
+
+
+def test_flash_attention_dropout_grad_finite_difference():
+    """The fused backward regenerates the identical dropout mask: custom
+    VJP matches finite differences (mask is fixed given the seed)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import _flash
+    b, h, s, d = 1, 1, 16, 8
+    rng = np.random.RandomState(8)
+    q = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    w = jnp.asarray(rng.randn(b, h, s, d), jnp.float32)
+    seed = jnp.asarray([5, 6], jnp.int32)
+
+    def f(q, k, v):
+        return jnp.sum(_flash(q, k, v, None, None, seed, False, None, 16,
+                              16, 0.3) * w)
+
+    gq, gk, gv = jax.grad(f, (0, 1, 2))(q, k, v)
+    eps, i = 1e-3, (0, 0, 3, 5)
+    for arr, g, which in ((q, gq, "q"), (k, gk, "k"), (v, gv, "v")):
+        args = {"q": [arr if which == "q" else q, k, v],
+                "k": [q, arr if which == "k" else k, v],
+                "v": [q, k, arr if which == "v" else v]}[which]
+        idx = {"q": 0, "k": 1, "v": 2}[which]
+        plus = list(args)
+        plus[idx] = args[idx].at[i].add(eps)
+        minus = list(args)
+        minus[idx] = args[idx].at[i].add(-eps)
+        fd = (f(*plus) - f(*minus)) / (2 * eps)
+        np.testing.assert_allclose(float(fd), float(g[i]), rtol=5e-2,
+                                   atol=5e-3)
+
+
+def test_flash_wrapper_dropout_no_fallback_shape():
     b, h, s, d = 1, 1, 16, 8
     q = pt.to_tensor(np.random.randn(b, h, s, d).astype("f4"))
-    out = flash_attention(q, q, q, dropout_p=0.5, training=True)
+    out = flash_attention(q, q, q, dropout_p=0.5, training=True,
+                          block_q=16, block_k=16, force=True)
     assert out.shape == [b, h, s, d]
