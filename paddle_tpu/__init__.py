@@ -49,6 +49,7 @@ from . import fluid
 from . import reader
 from .reader import batch
 from . import distribution
+from . import quantization
 from . import dataset
 
 # dygraph/static mode management (reference: fluid.enable_dygraph /

@@ -11,9 +11,14 @@ from . import imikolov
 from . import uci_housing
 from . import movielens
 from . import wmt16
+from . import wmt14
 from . import conll05
 from . import sentiment
+from . import voc2012
+from . import mq2007
+from . import image
 from . import flowers
 
 __all__ = ["common", "mnist", "cifar", "imdb", "imikolov", "uci_housing",
-           "movielens", "wmt16", "conll05", "sentiment", "flowers"]
+           "movielens", "wmt14", "wmt16", "conll05", "sentiment",
+           "flowers", "voc2012", "mq2007", "image"]

@@ -1,0 +1,86 @@
+"""Image preprocessing utilities (reference:
+python/paddle/dataset/image.py — resize_short, center_crop, random_crop,
+left_right_flip, to_chw, simple_transform, load_and_transform).
+
+The reference shells out to cv2; these are pure-numpy equivalents
+(nearest-neighbor resize) so the input pipeline has no native-deps —
+heavy augmentation belongs in the host-side C++ loader (csrc), not here.
+Images are HWC uint8/float arrays like the reference's."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def resize_short(im, size):
+    """Resize so the SHORT side == size, keeping aspect (reference:
+    image.py:197)."""
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, max(1, int(round(w * size / h)))
+    else:
+        nh, nw = max(1, int(round(h * size / w))), size
+    ys = (np.arange(nh) * (h / nh)).astype(np.int64).clip(0, h - 1)
+    xs = (np.arange(nw) * (w / nw)).astype(np.int64).clip(0, w - 1)
+    return im[ys][:, xs]
+
+
+def to_chw(im, order=(2, 0, 1)):
+    """reference: image.py:225."""
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    """reference: image.py:249."""
+    h, w = im.shape[:2]
+    y0 = max((h - size) // 2, 0)
+    x0 = max((w - size) // 2, 0)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def random_crop(im, size, is_color=True, rng=None):
+    """reference: image.py:277."""
+    rng = rng or np.random
+    h, w = im.shape[:2]
+    y0 = rng.randint(0, max(h - size, 0) + 1)
+    x0 = rng.randint(0, max(w - size, 0) + 1)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    """reference: image.py:305."""
+    return im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None, rng=None):
+    """reference: image.py:327 — resize_short → crop (random+flip when
+    training, center otherwise) → CHW float → mean-subtract."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng=rng)
+        if (rng or np.random).randint(2):
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    im = to_chw(im).astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, "float32")
+        im -= mean.reshape(-1, 1, 1) if mean.ndim == 1 else mean
+    return im
+
+
+def load_image(path, is_color=True):
+    """reference: image.py:167 — without cv2 only .npy payloads load."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    raise NotImplementedError(
+        "offline build: store images as .npy (cv2 is not a dependency)")
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    """reference: image.py:383."""
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -1,0 +1,102 @@
+"""MQ2007 learning-to-rank (reference: python/paddle/dataset/mq2007.py —
+LETOR 46-feature query-document data; readers in 'pointwise' (feature,
+relevance), 'pairwise' ((better, worse) feature pairs) and 'listwise'
+(label list, feature list per query) formats).
+
+Real files: drop MQ2007 train.txt/test.txt under
+``DATA_HOME/mq2007/`` (svmlight-ish ``rel qid:n 1:v ... #doc``) and they
+are parsed; otherwise a deterministic synthetic corpus with a planted
+linear relevance function is generated (common.py offline policy)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+FEATURES = 46
+QUERIES = {"train": 60, "test": 15}
+DOCS_PER_QUERY = 12
+
+
+def _parse_real(path):
+    queries = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = int(float(parts[0]))
+            qid = parts[1].split(":")[1]
+            feat = np.zeros((FEATURES,), "f4")
+            for kv in parts[2:]:
+                k, v = kv.split(":")
+                idx = int(k) - 1
+                if 0 <= idx < FEATURES:
+                    feat[idx] = float(v)
+            queries.setdefault(qid, []).append((rel, feat))
+    return list(queries.values())
+
+
+def _synthetic(split):
+    w = common.rng_for("mq2007-w").randn(FEATURES).astype("f4")
+    rs = common.rng_for(f"mq2007-{split}")
+    queries = []
+    for _ in range(QUERIES[split]):
+        docs = []
+        for _ in range(DOCS_PER_QUERY):
+            feat = rs.rand(FEATURES).astype("f4")
+            score = float(feat @ w)
+            docs.append((score, feat))
+        scores = np.array([s for s, _ in docs])
+        # relevance 0..2 by within-query score tertile
+        t1, t2 = np.quantile(scores, [0.33, 0.66])
+        queries.append([(int(s > t1) + int(s > t2), f) for s, f in docs])
+    return queries
+
+
+def _load(split):
+    real = common.data_path("mq2007", f"{split}.txt")
+    if os.path.exists(real):
+        return _parse_real(real)
+    return _synthetic(split)
+
+
+def _reader(split, format):
+    def pointwise():
+        for q in _load(split):
+            for rel, feat in q:
+                yield feat, float(rel)
+
+    def pairwise():
+        for q in _load(split):
+            for i, (ri, fi) in enumerate(q):
+                for rj, fj in q[i + 1:]:
+                    if ri > rj:
+                        yield fi, fj
+                    elif rj > ri:
+                        yield fj, fi
+
+    def listwise():
+        for q in _load(split):
+            labels = [float(rel) for rel, _ in q]
+            feats = [f for _, f in q]
+            yield labels, feats
+
+    return {"pointwise": pointwise, "pairwise": pairwise,
+            "listwise": listwise}[format]
+
+
+def train(format="pairwise"):
+    """reference: mq2007.py __reader__(train, format)."""
+    return _reader("train", format)
+
+
+def test(format="pairwise"):
+    return _reader("test", format)
+
+
+def fetch():
+    pass

@@ -1,0 +1,59 @@
+"""VOC2012 segmentation (reference: python/paddle/dataset/voc2012.py —
+train/val/test readers yielding (image CHW uint8→float, label HW uint8
+class mask) with 21 classes).
+
+Synthetic fallback (common.py offline policy): deterministic images of
+colored rectangles whose pixel-exact masks are the labels — the same
+(image, mask) contract, learnable by a small segmentation net."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import common
+
+CLASSES = 21  # 20 object classes + background
+H = W = 64
+TRAIN_N, VAL_N, TEST_N = 200, 40, 40
+
+
+def _sample(rs):
+    img = np.zeros((3, H, W), "f4")
+    mask = np.zeros((H, W), "u1")
+    img += rs.rand(3, 1, 1) * 0.1  # background tint
+    for _ in range(int(rs.randint(1, 4))):
+        cls = int(rs.randint(1, CLASSES))
+        y0, x0 = rs.randint(0, H - 16), rs.randint(0, W - 16)
+        h, w = rs.randint(8, 24), rs.randint(8, 24)
+        y1, x1 = min(y0 + h, H), min(x0 + w, W)
+        color = common.rng_for(f"voc-cls-{cls}").rand(3)
+        img[:, y0:y1, x0:x1] = color[:, None, None] + \
+            0.05 * rs.randn(3, y1 - y0, x1 - x0)
+        mask[y0:y1, x0:x1] = cls
+    return img.astype("f4"), mask
+
+
+def _reader(n, seed_name):
+    def creator():
+        rs = common.rng_for(seed_name)
+        for _ in range(n):
+            yield _sample(rs)
+    return creator
+
+
+def train():
+    """reference: voc2012.py:train."""
+    return _reader(TRAIN_N, "voc-train")
+
+
+def val():
+    return _reader(VAL_N, "voc-val")
+
+
+def test():
+    return _reader(TEST_N, "voc-test")
+
+
+def fetch():
+    pass

@@ -1,0 +1,71 @@
+"""WMT14 en-fr translation pairs (reference:
+python/paddle/dataset/wmt14.py — train/test readers yielding
+(src_ids, trg_ids, trg_next_ids); dict_size-truncated vocabs with
+<s>=0, <e>=1, <unk>=2).
+
+Synthetic fallback (common.py offline policy): the same deterministic
+cipher-translation construction as wmt16 but with wmt14's reader
+signature (train(dict_size)/test(dict_size)) and vocab conventions."""
+from __future__ import annotations
+
+import numpy as np
+
+from . import common
+
+BOS, EOS, UNK = 0, 1, 2
+TRAIN_N = 3000
+TEST_N = 300
+_DEFAULT_DICT = 30000
+
+
+def _perm(dict_size):
+    rs = common.rng_for("wmt14-perm")
+    perm = np.arange(3, dict_size)
+    rs.shuffle(perm)
+    return perm
+
+
+def _samples(n, seed_name, dict_size):
+    rs = common.rng_for(seed_name)
+    perm = _perm(dict_size)
+    out = []
+    for _ in range(n):
+        length = int(rs.randint(4, 24))
+        src = rs.randint(3, dict_size, (length,)).astype("int64")
+        trg = perm[src - 3]
+        trg_in = np.concatenate([[BOS], trg]).astype("int64")
+        trg_next = np.concatenate([trg, [EOS]]).astype("int64")
+        out.append((list(src), list(trg_in), list(trg_next)))
+    return out
+
+
+def _reader(n, seed_name, dict_size):
+    def creator():
+        for s in _samples(n, seed_name, dict_size):
+            yield s
+    return creator
+
+
+def train(dict_size=_DEFAULT_DICT):
+    return _reader(TRAIN_N, "wmt14-train", dict_size)
+
+
+def test(dict_size=_DEFAULT_DICT):
+    return _reader(TEST_N, "wmt14-test", dict_size)
+
+
+def get_dict(dict_size, reverse=True):
+    """reference: wmt14.py:get_dict — (src_dict, trg_dict); reverse=True
+    maps id→word (the reference default)."""
+    src = {"<s>": BOS, "<e>": EOS, "<unk>": UNK}
+    for i in range(3, dict_size):
+        src[f"w{i}"] = i
+    trg = dict(src)
+    if reverse:
+        return ({v: k for k, v in src.items()},
+                {v: k for k, v in trg.items()})
+    return src, trg
+
+
+def fetch():
+    pass

@@ -1,0 +1,484 @@
+"""AST-based dygraph→static conversion (ProgramTranslator).
+
+TPU-native rebuild of the reference's dygraph_to_static
+(reference: python/paddle/fluid/dygraph/dygraph_to_static/
+program_translator.py:249 ProgramTranslator,
+ifelse_transformer.py / loop_transformer.py). The reference rewrites
+Python `if`/`while` whose predicates are Variables into cond/while ops in
+a static Program; here the rewrite targets `lax.cond`/`lax.while_loop`
+through ops.control_flow — which already run plain Python when the
+predicate is concrete, so transformed code behaves identically in eager
+mode and becomes compiled control flow under `jit.to_static` tracing
+(where a plain Python `if` would silently bake one branch).
+
+Transform scope (the reference's core cases):
+* ``if``/``elif``/``else`` statements → ``convert_ifelse`` with the
+  branch-assigned names threaded as explicit operands,
+* ``while`` loops (without break/continue) → ``convert_while`` with the
+  body-assigned names as loop carry,
+* ``and`` / ``or`` / ``not`` inside the converted predicates →
+  ``convert_and/or/not`` (tensor-aware, short-circuit preserved for
+  Python values).
+
+Functions whose source can't be rewritten (no source, exotic syntax)
+fall back to trace-only conversion with a debug log — matching the
+reference's "don't transform what you can't prove" behavior.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import numpy as np
+import jax
+
+from .tensor import Tensor
+from .utils.log import get_logger
+
+_log = get_logger("paddle_tpu.d2s")
+
+
+class _Undefined:
+    """Name not bound on (at least) one path into a converted region."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name):
+        self.name = name
+
+    def __repr__(self):
+        return f"<undefined {self.name}>"
+
+
+def ld(thunk, name):
+    """Load a possibly-unbound local for use as a branch/loop operand."""
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return _Undefined(name)
+
+
+def _is_tensorish(x):
+    return isinstance(x, (Tensor, jax.Array)) or isinstance(
+        x, jax.core.Tracer)
+
+
+def convert_ifelse(pred, true_fn, false_fn, names, operands,
+                   fresh_flags=None):
+    """Runtime dispatch for a rewritten `if`. fresh_flags marks operands
+    that both branches assign before reading — those may enter undefined
+    (a placeholder is threaded; it is provably never read)."""
+    if isinstance(pred, Tensor):
+        concrete = not isinstance(pred.data, jax.core.Tracer)
+    elif _is_tensorish(pred):
+        concrete = not isinstance(pred, jax.core.Tracer)
+    else:
+        return true_fn(*operands) if pred else false_fn(*operands)
+    if concrete:
+        taken = bool(np.asarray(jax.device_get(
+            pred.data if isinstance(pred, Tensor) else pred)).item())
+        return true_fn(*operands) if taken else false_fn(*operands)
+    fresh_flags = fresh_flags or (False,) * len(operands)
+    ops_in = []
+    for v, n, fresh in zip(operands, names, fresh_flags):
+        if isinstance(v, _Undefined):
+            if not fresh:
+                raise ValueError(
+                    f"to_static if-conversion: variable '{n}' must be "
+                    "defined before a tensor-dependent `if` (a branch "
+                    "reads it, or only one branch assigns it)")
+            v = np.float32(0.0)  # never read: both branches overwrite
+        ops_in.append(v)
+    from .ops.control_flow import cond as _cond
+    return _cond(pred, true_fn, false_fn, tuple(ops_in))
+
+
+def convert_while(cond_fn, body_fn, names, operands):
+    """Runtime dispatch for a rewritten `while`."""
+    probe = cond_fn(*operands)
+    if not _is_tensorish(probe) and not isinstance(probe, Tensor):
+        # plain python loop
+        vals = tuple(operands)
+        while cond_fn(*vals):
+            out = body_fn(*vals)
+            vals = out if isinstance(out, tuple) else (out,)
+        return vals
+    for v, n in zip(operands, names):
+        if isinstance(v, _Undefined):
+            raise ValueError(
+                f"to_static while-conversion: loop variable '{n}' must be "
+                "initialized before a tensor-dependent `while`")
+    from .ops.control_flow import while_loop as _while
+    out = _while(cond_fn, body_fn, list(operands))
+    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+
+def convert_and(a_thunk, b_thunk):
+    a = a_thunk()
+    if not (_is_tensorish(a) or isinstance(a, Tensor)):
+        return a and b_thunk()
+    from .ops import math as M
+    return M.logical_and(_as_bool(a), _as_bool(b_thunk()))
+
+
+def convert_or(a_thunk, b_thunk):
+    a = a_thunk()
+    if not (_is_tensorish(a) or isinstance(a, Tensor)):
+        return a or b_thunk()
+    from .ops import math as M
+    return M.logical_or(_as_bool(a), _as_bool(b_thunk()))
+
+
+def convert_not(a):
+    if not (_is_tensorish(a) or isinstance(a, Tensor)):
+        return not a
+    from .ops import math as M
+    return M.logical_not(_as_bool(a))
+
+
+def _as_bool(x):
+    from .ops import math as M
+    if isinstance(x, Tensor) and x.data.dtype != jax.numpy.bool_:
+        return M.cast(x, "bool")
+    return x
+
+
+# ---------------------------------------------------------------------------
+# AST rewriting
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by simple assignments inside a statement list."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_Assign(self, node):
+        for t in node.targets:
+            self._target(t)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._target(node.target)
+        self.generic_visit(node)
+
+    def _target(self, t):
+        if isinstance(t, ast.Name):
+            self.names.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e)
+
+    # nested defs keep their own scope
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+def _reads_before_write(stmts, name):
+    """True when `name` is loaded before any statement stores it (per-
+    statement granularity; an Assign's value loads count as reads)."""
+    stored = False
+    for stmt in stmts:
+        loads = False
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Name) and node.id == name and \
+                    isinstance(node.ctx, ast.Load):
+                loads = True
+            # `x += 1` reads x even though the target ctx is Store
+            if isinstance(node, ast.AugAssign) and \
+                    isinstance(node.target, ast.Name) and \
+                    node.target.id == name:
+                loads = True
+        if loads and not stored:
+            return True
+        if _assigned([stmt]) & {name}:
+            stored = True
+    return False
+
+
+def _has_break(stmts):
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Break(self, node):
+            self.found = True
+
+        def visit_Continue(self, node):
+            self.found = True
+
+        def visit_While(self, node):
+            pass  # inner loops own their breaks
+
+        def visit_For(self, node):
+            pass
+
+        def visit_FunctionDef(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _has_early_return(stmts):
+    class V(ast.NodeVisitor):
+        found = False
+
+        def visit_Return(self, node):
+            self.found = True
+
+        def visit_FunctionDef(self, node):
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+class _BoolOpRewriter(ast.NodeTransformer):
+    """and/or/not → tensor-aware converters (inside predicates)."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        op = "_jst_and" if isinstance(node.op, ast.And) else "_jst_or"
+        expr = node.values[-1]
+        for left in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=ast.Name(id=op, ctx=ast.Load()),
+                args=[ast.Lambda(args=_empty_args(), body=left),
+                      ast.Lambda(args=_empty_args(), body=expr)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="_jst_not", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def _empty_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites if/while statements into converter calls."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # -- if ----------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_early_return(node.body) or _has_early_return(node.orelse):
+            return node  # early returns keep python semantics
+        body_assigned = _assigned(node.body)
+        else_assigned = _assigned(node.orelse)
+        out_names = sorted(body_assigned | else_assigned)
+        fresh = tuple(
+            n in body_assigned and n in else_assigned and
+            not _reads_before_write(node.body, n) and
+            not _reads_before_write(node.orelse, n)
+            for n in out_names)
+        uid = self._uid()
+        test = _BoolOpRewriter().visit(node.test)
+        tname, fname = f"_jst_true_{uid}", f"_jst_false_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], vararg=None, kwonlyargs=[], kw_defaults=[],
+            kwarg=None, defaults=[],
+            args=[ast.arg(arg=n) for n in out_names])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in out_names],
+            ctx=ast.Load()))
+        true_def = ast.FunctionDef(
+            name=tname, args=args, body=list(node.body) + [ret],
+            decorator_list=[], returns=None, type_params=[])
+        false_def = ast.FunctionDef(
+            name=fname, args=args,
+            body=(list(node.orelse) if node.orelse else []) + [ret],
+            decorator_list=[], returns=None, type_params=[])
+        loads = [_ld_expr(n) for n in out_names]
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in out_names],
+                ctx=ast.Store())] if out_names else
+            [ast.Name(id=f"_jst_void_{uid}", ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="_jst_ifelse", ctx=ast.Load()),
+                args=[test,
+                      ast.Name(id=tname, ctx=ast.Load()),
+                      ast.Name(id=fname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n)
+                                      for n in out_names], ctx=ast.Load()),
+                      ast.Tuple(elts=loads, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=b)
+                                      for b in fresh], ctx=ast.Load())],
+                keywords=[]))
+        if not out_names:
+            # still execute for side-effect-free parity; keep simple form
+            call = ast.Expr(value=call.value)
+        return [true_def, false_def, call]
+
+    # -- while -------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_break(node.body) or \
+                _has_early_return(node.body):
+            return node
+        carry = sorted(_assigned(node.body))
+        if not carry:
+            return node
+        uid = self._uid()
+        test = _BoolOpRewriter().visit(node.test)
+        cname, bname = f"_jst_cond_{uid}", f"_jst_body_{uid}"
+        args = ast.arguments(
+            posonlyargs=[], vararg=None, kwonlyargs=[], kw_defaults=[],
+            kwarg=None, defaults=[],
+            args=[ast.arg(arg=n) for n in carry])
+        cond_def = ast.FunctionDef(
+            name=cname, args=args, body=[ast.Return(value=test)],
+            decorator_list=[], returns=None, type_params=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ast.Load()) for n in carry],
+            ctx=ast.Load()))
+        body_def = ast.FunctionDef(
+            name=bname, args=args, body=list(node.body) + [ret],
+            decorator_list=[], returns=None, type_params=[])
+        loads = [_ld_expr(n) for n in carry]
+        call = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Store()) for n in carry],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="_jst_while", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      ast.Tuple(elts=[ast.Constant(value=n)
+                                      for n in carry], ctx=ast.Load()),
+                      ast.Tuple(elts=loads, ctx=ast.Load())],
+                keywords=[]))
+        return [cond_def, body_def, call]
+
+
+def _ld_expr(name):
+    """`_jst_ld(lambda: <name>, '<name>')` — tolerates unbound names."""
+    return ast.Call(
+        func=ast.Name(id="_jst_ld", ctx=ast.Load()),
+        args=[ast.Lambda(args=_empty_args(),
+                         body=ast.Name(id=name, ctx=ast.Load())),
+              ast.Constant(value=name)],
+        keywords=[])
+
+
+_HELPERS = {
+    "_jst_ifelse": convert_ifelse,
+    "_jst_while": convert_while,
+    "_jst_and": convert_and,
+    "_jst_or": convert_or,
+    "_jst_not": convert_not,
+    "_jst_ld": ld,
+}
+
+
+def _needs_transform(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While)):
+            return True
+    return False
+
+
+def convert_function(fn):
+    """AST-convert a python function for tensor-dependent control flow.
+    Returns the rewritten function, or `fn` unchanged when nothing needs
+    rewriting / the source can't be processed."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        _log.debug("to_static: no source for %r; trace-only", fn)
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if not _needs_transform(fdef):
+        return fn
+    fdef.decorator_list = []  # decorators already applied to `fn`
+    try:
+        new_tree = _ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(new_tree)
+        code = compile(new_tree, f"<to_static {fn.__name__}>", "exec")
+    except Exception as e:  # pragma: no cover - defensive
+        _log.debug("to_static: transform failed for %r (%s); trace-only",
+                   fn, e)
+        return fn
+    glb = dict(fn.__globals__)
+    glb.update(_HELPERS)
+    # freevars: rebind the closure's current cell values as globals (the
+    # documented limitation: converted functions see a snapshot of
+    # closed-over names)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass
+    loc = {}
+    exec(code, glb, loc)
+    new_fn = loc[fn.__name__]
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__wrapped_original__ = fn
+    return new_fn
+
+
+class ProgramTranslator:
+    """reference: program_translator.py:249 — global enable switch."""
+
+    _instance = None
+    enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, flag=True):
+        type(self).enabled = bool(flag)
+
+    @classmethod
+    def is_enabled(cls):
+        return cls.enabled

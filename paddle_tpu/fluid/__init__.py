@@ -27,6 +27,8 @@ from ..tensor import Tensor
 from ..static import enable_static, disable_static
 from . import layers
 from . import dygraph
+from . import nets
+from .data_feeder import DataFeeder, PyReader
 
 
 class Variable(Tensor):

@@ -1,0 +1,137 @@
+"""fluid.DataFeeder + py_reader compat surface.
+
+Rebuild of the reference feeding stack (reference:
+python/paddle/fluid/data_feeder.py:212 DataFeeder — converts a minibatch
+of python samples into the feed dict the Executor wants;
+python/paddle/fluid/layers/io.py:553 py_reader / :831 double_buffer — a
+queue the C++ executor pops from). On XLA the executor takes explicit
+feeds, so PyReader keeps the queue in python and hands out feed dicts;
+device-side double buffering is what io.DataLoader's prefetching core
+already does (csrc/core.cpp), so double_buffer is the identity on an
+already-prefetched reader.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor, convert_dtype
+from ..static import StaticVar
+
+
+class DataFeeder:
+    """reference: data_feeder.py:212. feed_list entries are static data
+    vars (or their names); `feed(minibatch)` returns {name: ndarray}."""
+
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = feed_list
+        self.place = place
+
+    def _names_dtypes(self):
+        out = []
+        for v in self.feed_vars:
+            if isinstance(v, StaticVar):
+                out.append((v.name, convert_dtype(v._dtype)))
+            elif isinstance(v, Tensor):
+                out.append((v.name, v.data.dtype))
+            else:
+                out.append((str(v), None))
+        return out
+
+    def feed(self, iterable):
+        """minibatch: iterable of per-sample tuples (one entry per feed
+        var) → {name: stacked ndarray}."""
+        rows = list(iterable)
+        if not rows:
+            raise ValueError("empty minibatch")
+        nd = self._names_dtypes()
+        ncol = len(nd)
+        cols = [[] for _ in range(ncol)]
+        for row in rows:
+            if len(row) != ncol:
+                raise ValueError(
+                    f"sample has {len(row)} fields, feed_list wants {ncol}")
+            for c, v in enumerate(row):
+                cols[c].append(np.asarray(v))
+        out = {}
+        for (name, dtype), col in zip(nd, cols):
+            arr = np.stack(col)
+            if dtype is not None:
+                arr = arr.astype(dtype)
+            out[name] = arr
+        return out
+
+
+class PyReader:
+    """reference: fluid/reader.py:PyReader + layers/io.py:py_reader. The
+    queue-into-the-executor design becomes: decorate a sample/batch
+    generator, then iterate feed dicts (XLA wants explicit feeds)."""
+
+    def __init__(self, feed_list=None, capacity=64, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self.feeder = DataFeeder(feed_list or [])
+        self.capacity = capacity
+        self._batch_gen = None
+        self._sample_gen = None
+        self._started = False
+
+    def decorate_sample_list_generator(self, generator, places=None):
+        """generator() yields minibatches: lists of per-sample tuples."""
+        self._sample_gen = generator
+        return self
+
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def decorate_batch_generator(self, generator, places=None):
+        """generator() yields ready feed tuples of batched arrays."""
+        self._batch_gen = generator
+        return self
+
+    def start(self):
+        self._started = True
+
+    def reset(self):
+        self._started = False
+
+    def __iter__(self):
+        names = [n for n, _ in self.feeder._names_dtypes()]
+        if self._batch_gen is not None:
+            for batch in self._batch_gen():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield dict(zip(names, batch))
+        elif self._sample_gen is not None:
+            for minibatch in self._sample_gen():
+                yield self.feeder.feed(minibatch)
+        else:
+            raise ValueError("PyReader: decorate a generator first")
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """reference: layers/io.py:553. Creates the feed vars and a PyReader
+    bound to them; `read_file(reader)` returns the vars."""
+    from ..static import data as sdata
+    import uuid
+    prefix = name or ("py_reader_" + uuid.uuid4().hex[:6])
+    feed_vars = [sdata(f"{prefix}_{i}", shape, dtype)
+                 for i, (shape, dtype) in enumerate(zip(shapes, dtypes))]
+    reader = PyReader(feed_list=feed_vars, capacity=capacity,
+                      use_double_buffer=use_double_buffer)
+    reader.vars = feed_vars
+    return reader
+
+
+def read_file(reader):
+    """reference: layers/io.py:read_file — the data vars the reader
+    feeds."""
+    vars_ = getattr(reader, "vars", None)
+    if vars_ is None:
+        raise ValueError("read_file expects a py_reader(...) result")
+    return vars_ if len(vars_) > 1 else vars_[0]
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference: layers/io.py:831. Device-side prefetch is handled by the
+    DataLoader's native prefetching core; identity here."""
+    return reader

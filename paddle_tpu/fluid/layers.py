@@ -40,6 +40,7 @@ from ..ops.crf import linear_chain_crf, crf_decoding  # noqa: F401
 from ..ops.ctc import warpctc, ctc_greedy_decoder  # noqa: F401
 from ..distribution import (Uniform, Normal, Categorical,  # noqa: F401
                             MultivariateNormalDiag)
+from .data_feeder import py_reader, read_file, double_buffer  # noqa: F401
 from ..ops.detection import (iou_similarity, box_coder,  # noqa: F401
                              box_clip, prior_box, density_prior_box,
                              anchor_generator, yolo_box, yolov3_loss,
@@ -94,6 +95,23 @@ def _param(attr, shape, dtype, default_init, is_bias=False):
             p.stop_gradient = True
             p.trainable = False
     return p
+
+
+def sequence_conv(input, num_filters, filter_size=3, padding_start=None,
+                  param_attr=None, bias_attr=None, act=None, length=None,
+                  name=None):
+    """reference: layers/sequence_lod.py:sequence_conv (the LayerHelper,
+    param-creating form; the functional op is ops.sequence.sequence_conv).
+    Shadows the functional re-export above on purpose."""
+    from ..ops.sequence import sequence_conv as _seq_conv_op
+    d = input.shape[-1]
+    w = _param(param_attr, (filter_size * d, num_filters), "float32",
+               I.XavierUniform())
+    b = _param(bias_attr, (num_filters,), "float32", I.Constant(0.0),
+               is_bias=True)
+    out = _seq_conv_op(input, w, b, filter_size=filter_size,
+                       padding_start=padding_start, length=length)
+    return _act(out, act)
 
 
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,

@@ -1,0 +1,107 @@
+"""fluid.nets — the book-example composite blocks.
+
+Rebuild of the reference's nets.py (reference: python/paddle/fluid/nets.py
+— simple_img_conv_pool:29, img_conv_group:139, sequence_conv_pool:252,
+glu:320, scaled_dot_product_attention:362). These compose the fluid-compat
+param-creating layers (fluid/layers.py) exactly the way the reference
+composes its LayerHelper ops, so book examples port with an import swap.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..ops import nn_ops as F
+from . import layers as FL
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+           "glu", "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    """reference: nets.py:29 — conv2d then pool2d."""
+    conv_out = FL.conv2d(input, num_filters=num_filters,
+                         filter_size=filter_size, stride=conv_stride,
+                         padding=conv_padding, dilation=conv_dilation,
+                         groups=conv_groups, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    return FL.pool2d(conv_out, pool_size=pool_size, pool_type=pool_type,
+                     pool_stride=pool_stride, pool_padding=pool_padding,
+                     global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """reference: nets.py:139 — the VGG block: N convs (+BN +dropout)
+    then one pool."""
+    tmp = input
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+    n = len(conv_num_filter)
+
+    def per(v):
+        return v if isinstance(v, (list, tuple)) else [v] * n
+
+    padding = per(conv_padding)
+    fsize = per(conv_filter_size)
+    with_bn = per(conv_with_batchnorm)
+    drop = per(conv_batchnorm_drop_rate)
+    pattr = param_attr if isinstance(param_attr, (list, tuple)) \
+        else [param_attr] * n
+
+    for i in range(n):
+        act = conv_act if not with_bn[i] else None
+        tmp = FL.conv2d(tmp, num_filters=conv_num_filter[i],
+                        filter_size=fsize[i], padding=padding[i],
+                        param_attr=pattr[i], act=act)
+        if with_bn[i]:
+            tmp = FL.batch_norm(tmp, act=conv_act)
+            if drop[i] > 0:
+                tmp = F.dropout(tmp, p=drop[i])
+    return FL.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                     pool_stride=pool_stride)
+
+
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       lengths=None):
+    """reference: nets.py:252 — sequence_conv then sequence_pool. Input is
+    the padded (B, T, D) formulation; `lengths` masks padding."""
+    conv = FL.sequence_conv(input, num_filters=num_filters,
+                            filter_size=filter_size, param_attr=param_attr,
+                            bias_attr=bias_attr, act=act, length=lengths)
+    from ..ops.sequence import sequence_pool
+    return sequence_pool(conv, pool_type=pool_type, length=lengths)
+
+
+def glu(input, dim=-1):
+    """reference: nets.py:320 — split in half on `dim`; a * sigmoid(b)."""
+    a, b = ops.split(input, 2, axis=dim)
+    return a * ops.sigmoid(b)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """reference: nets.py:362 — multi-head attention over (B, S, D)
+    q/k/v; returns (B, Sq, D_v)."""
+    if queries.shape[-1] % num_heads != 0:
+        raise ValueError("hidden size must divide num_heads")
+    b = queries.shape[0]
+
+    def split_heads(x):
+        s, d = x.shape[1], x.shape[2]
+        return x.reshape([b, s, num_heads, d // num_heads]).transpose(
+            [0, 2, 1, 3])
+
+    q, k, v = split_heads(queries), split_heads(keys), split_heads(values)
+    ctx = F.scaled_dot_product_attention(q, k, v, dropout_p=dropout_rate,
+                                         training=dropout_rate > 0)
+    s = ctx.shape[2]
+    return ctx.transpose([0, 2, 1, 3]).reshape([b, s, -1])

@@ -106,6 +106,8 @@ def load_inference_model(path_prefix):
 # rebuilt over orbax for multi-host sharded state)
 
 def orbax_save(path, state_dict, step=None):
+    """Sharded checkpoint save (reference: fleet save_persistables /
+    python/paddle/fluid/io.py:save_persistables — rebuilt over orbax)."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     tree = _to_numpy_tree(state_dict)
@@ -114,12 +116,41 @@ def orbax_save(path, state_dict, step=None):
                tree, force=True)
 
 
-def orbax_restore(path, step=None):
+def orbax_restore(path, step=None, template=None):
+    """Restore an orbax checkpoint. With `template` (a state_dict whose
+    leaves are live — possibly mesh-sharded — Tensors/arrays), every
+    restored leaf is placed with the template leaf's sharding, so a
+    dp×tp-sharded model resumes with placement preserved."""
+    import jax as _jax
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = ocp.PyTreeCheckpointer()
-    return ckptr.restore(path if step is None else
+    tree = ckptr.restore(path if step is None else
                          os.path.join(path, str(step)))
+    if template is None:
+        return tree
+
+    def place(t, value):
+        arr = t.data if isinstance(t, Tensor) else t
+        if isinstance(arr, _jax.Array):
+            return _jax.device_put(value, arr.sharding)
+        return value
+
+    def walk(tmpl, got):
+        if isinstance(got, dict):
+            return {k: walk(tmpl[k], v) if isinstance(tmpl, dict) and
+                    k in tmpl else v for k, v in got.items()}
+        if isinstance(got, (list, tuple)):
+            if not isinstance(tmpl, (list, tuple)) or \
+                    len(tmpl) != len(got):
+                raise ValueError(
+                    f"orbax_restore: checkpoint list of {len(got)} entries "
+                    "does not match the live template "
+                    f"({len(tmpl) if isinstance(tmpl, (list, tuple)) else type(tmpl).__name__})")
+            return type(got)(walk(a, b) for a, b in zip(tmpl, got))
+        return place(tmpl, got)
+
+    return walk(template, tree)
 
 
 class CheckpointManager:

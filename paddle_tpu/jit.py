@@ -103,6 +103,12 @@ class StaticFunction:
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__"),
                                  updated=())
+        from .dygraph_to_static import ProgramTranslator, convert_function
+        if ProgramTranslator.is_enabled():
+            # AST pass first (reference: ProgramTranslator) so tensor-
+            # dependent python if/while become lax control flow instead of
+            # silently baking the traced branch
+            fn = convert_function(fn)
         self._fn = fn
         self._models = models
         self._optimizers = optimizers
@@ -230,7 +236,9 @@ def to_static(function=None, input_spec=None, models=None, optimizers=None,
     """Decorator/wrapper: compile a dygraph step into one XLA computation.
 
     reference: paddle.jit.to_static (dygraph_to_static/program_translator.py)
-    — here via functional-state tracing instead of AST rewriting.
+    — functional-state tracing, preceded by the AST pass
+    (dygraph_to_static.convert_function) that rewrites tensor-dependent
+    python `if`/`while` into lax control flow.
     """
     def wrap(fn):
         return StaticFunction(fn, models=models, optimizers=optimizers,

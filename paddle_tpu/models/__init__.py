@@ -33,4 +33,7 @@ def __getattr__(name):
     if name in ("Word2Vec", "SkipGram"):
         from . import word2vec
         return getattr(word2vec, name)
+    if name in ("YOLOv3", "SSD"):
+        from . import detection
+        return getattr(detection, name)
     raise AttributeError(name)

@@ -137,8 +137,12 @@ class Bert(nn.Layer):
         else:
             am = None
         x = self.embeddings(input_ids, token_type_ids)
-        for layer in self.encoder:
-            x = layer(x, am)
+        if isinstance(self.encoder, nn.LayerList):
+            for layer in self.encoder:
+                x = layer(x, am)
+        else:
+            # e.g. parallel.pipeline.PipelineStack replacing the trunk
+            x = self.encoder(x, am) if am is not None else self.encoder(x)
         pooled = ops.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

@@ -1,0 +1,192 @@
+"""Detection model zoo: YOLOv3 and SSD.
+
+Rebuild of the reference detection pipelines (reference: the YOLOv3 /
+SSD configs the fluid detection ops serve —
+python/paddle/fluid/layers/detection.py yolov3_loss:912 / yolo_box:1038 /
+ssd_loss:1410 / detection_output:541 / multi_box_head:1991; models in
+the era's PaddleDetection used exactly these ops).
+
+TPU-first: whole train step jits (static-shape padded gt boxes), NMS is
+the fixed-size top-k formulation, convs are NCHW MXU convolutions with
+BN+ReLU fused by XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn, ops
+from ..ops import detection as D
+from ..ops import nn_ops as F
+
+__all__ = ["YOLOv3", "SSD", "DEFAULT_ANCHORS", "DEFAULT_ANCHOR_MASKS"]
+
+DEFAULT_ANCHORS = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119,
+                   116, 90, 156, 198, 373, 326]
+DEFAULT_ANCHOR_MASKS = [[6, 7, 8], [3, 4, 5], [0, 1, 2]]
+
+
+def _conv_bn(cin, cout, k=3, stride=1):
+    return nn.Sequential(
+        nn.Conv2D(cin, cout, k, stride=stride, padding=k // 2,
+                  bias_attr=False),
+        nn.BatchNorm2D(cout),
+        nn.LeakyReLU(0.1),
+    )
+
+
+class _DarkNetTiny(nn.Layer):
+    """Small darknet-style backbone emitting 3 scales (C3, C4, C5)."""
+
+    def __init__(self, width=32):
+        super().__init__()
+        w = width
+        self.stem = _conv_bn(3, w, 3)
+        self.down1 = _conv_bn(w, w * 2, 3, stride=2)      # /2
+        self.block1 = _conv_bn(w * 2, w * 2, 3)
+        self.down2 = _conv_bn(w * 2, w * 4, 3, stride=2)  # /4
+        self.block2 = _conv_bn(w * 4, w * 4, 3)
+        self.down3 = _conv_bn(w * 4, w * 8, 3, stride=2)  # /8 → C3
+        self.block3 = _conv_bn(w * 8, w * 8, 3)
+        self.down4 = _conv_bn(w * 8, w * 16, 3, stride=2)  # /16 → C4
+        self.block4 = _conv_bn(w * 16, w * 16, 3)
+        self.down5 = _conv_bn(w * 16, w * 32, 3, stride=2)  # /32 → C5
+        self.block5 = _conv_bn(w * 32, w * 32, 3)
+
+    def forward(self, x):
+        x = self.block1(self.down1(self.stem(x)))
+        x = self.block2(self.down2(x))
+        c3 = self.block3(self.down3(x))
+        c4 = self.block4(self.down4(c3))
+        c5 = self.block5(self.down5(c4))
+        return c3, c4, c5
+
+
+class YOLOv3(nn.Layer):
+    """YOLOv3 with a compact darknet backbone. forward → 3 raw head
+    outputs (N, A*(5+C), H, W) at strides 32/16/8; `loss` applies
+    yolov3_loss per scale; `predict` decodes + multiclass-NMS."""
+
+    def __init__(self, num_classes=80, anchors=None, anchor_masks=None,
+                 width=32):
+        super().__init__()
+        self.num_classes = num_classes
+        self.anchors = anchors or list(DEFAULT_ANCHORS)
+        self.anchor_masks = anchor_masks or [list(m) for m in
+                                             DEFAULT_ANCHOR_MASKS]
+        self.backbone = _DarkNetTiny(width)
+        w = width
+        chans = [w * 32, w * 16, w * 8]
+        heads = []
+        for i, mask in enumerate(self.anchor_masks):
+            cout = len(mask) * (5 + num_classes)
+            heads.append(nn.Sequential(
+                _conv_bn(chans[i], chans[i] // 2, 1),
+                nn.Conv2D(chans[i] // 2, cout, 1),
+            ))
+        self.heads = nn.LayerList(heads)
+        self.downsamples = [32, 16, 8]
+
+    def forward(self, x):
+        c3, c4, c5 = self.backbone(x)
+        feats = [c5, c4, c3]
+        return [head(f) for head, f in zip(self.heads, feats)]
+
+    def loss(self, outputs, gt_box, gt_label, gt_score=None,
+             ignore_thresh=0.7):
+        total = None
+        for out, mask, ds in zip(outputs, self.anchor_masks,
+                                 self.downsamples):
+            l = D.yolov3_loss(out, gt_box, gt_label, self.anchors, mask,
+                              self.num_classes, ignore_thresh, ds,
+                              gt_score=gt_score).sum()
+            total = l if total is None else total + l
+        return total
+
+    def predict(self, outputs, img_size, conf_thresh=0.01,
+                nms_threshold=0.45, nms_top_k=400, keep_top_k=100):
+        boxes_all, scores_all = [], []
+        for out, mask, ds in zip(outputs, self.anchor_masks,
+                                 self.downsamples):
+            sub_anchors = []
+            for m in mask:
+                sub_anchors += self.anchors[2 * m:2 * m + 2]
+            b, s = D.yolo_box(out, img_size, sub_anchors,
+                              self.num_classes, conf_thresh, ds)
+            boxes_all.append(b)
+            scores_all.append(s)
+        boxes = ops.concat(boxes_all, axis=1)
+        scores = ops.concat(scores_all, axis=1)
+        # (N, M, C) → (N, C, M) for multiclass_nms
+        scores = scores.transpose([0, 2, 1])
+        return D.multiclass_nms(boxes, scores, conf_thresh, nms_top_k,
+                                keep_top_k, nms_threshold,
+                                background_label=-1)
+
+
+class SSD(nn.Layer):
+    """SSD over a compact VGG-ish backbone: per-scale loc/conf heads +
+    priors; `loss` = ssd_loss, `predict` = detection_output."""
+
+    def __init__(self, num_classes=21, image_size=128, width=32):
+        super().__init__()
+        self.num_classes = num_classes
+        self.image_size = image_size
+        w = width
+        self.stage1 = nn.Sequential(
+            _conv_bn(3, w), _conv_bn(w, w),
+            nn.MaxPool2D(2, 2),
+            _conv_bn(w, w * 2), _conv_bn(w * 2, w * 2),
+            nn.MaxPool2D(2, 2),
+            _conv_bn(w * 2, w * 4),
+        )  # /4
+        self.stage2 = nn.Sequential(
+            nn.MaxPool2D(2, 2), _conv_bn(w * 4, w * 8))   # /8
+        self.stage3 = nn.Sequential(
+            nn.MaxPool2D(2, 2), _conv_bn(w * 8, w * 8))   # /16
+        chans = [w * 4, w * 8, w * 8]
+        self._scale_cfg = [
+            dict(min_size=image_size * 0.1, max_size=image_size * 0.25),
+            dict(min_size=image_size * 0.25, max_size=image_size * 0.5),
+            dict(min_size=image_size * 0.5, max_size=image_size * 0.9),
+        ]
+        self.loc_heads = nn.LayerList()
+        self.conf_heads = nn.LayerList()
+        self._npriors = []
+        for c in chans:
+            npri = 4  # ar 1 (min), sqrt(min*max), 2, 1/2
+            self._npriors.append(npri)
+            self.loc_heads.append(nn.Conv2D(c, npri * 4, 3, padding=1))
+            self.conf_heads.append(
+                nn.Conv2D(c, npri * num_classes, 3, padding=1))
+
+    def forward(self, x):
+        f1 = self.stage1(x)
+        f2 = self.stage2(f1)
+        f3 = self.stage3(f2)
+        feats = [f1, f2, f3]
+        locs, confs, priors, pvars = [], [], [], []
+        n = x.shape[0]
+        for feat, loc_h, conf_h, cfg, npri in zip(
+                feats, self.loc_heads, self.conf_heads, self._scale_cfg,
+                self._npriors):
+            loc = loc_h(feat).transpose([0, 2, 3, 1]).reshape([n, -1, 4])
+            conf = conf_h(feat).transpose([0, 2, 3, 1]).reshape(
+                [n, -1, self.num_classes])
+            pb, pv = D.prior_box(
+                feat, x, min_sizes=[cfg["min_size"]],
+                max_sizes=[cfg["max_size"]], aspect_ratios=[2.0],
+                flip=True, clip=True)
+            locs.append(loc)
+            confs.append(conf)
+            priors.append(pb.reshape([-1, 4]))
+            pvars.append(pv.reshape([-1, 4]))
+        return (ops.concat(locs, 1), ops.concat(confs, 1),
+                ops.concat(priors, 0), ops.concat(pvars, 0))
+
+    def loss(self, locs, confs, priors, pvars, gt_box, gt_label):
+        return D.ssd_loss(locs, confs, gt_box, gt_label, priors,
+                          pvars).sum()
+
+    def predict(self, locs, confs, priors, pvars, keep_top_k=100):
+        return D.detection_output(locs, confs, priors, pvars,
+                                  keep_top_k=keep_top_k)

@@ -520,6 +520,28 @@ def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
 # ---------------------------------------------------------------------------
 # matching / assignment
 
+def _greedy_bipartite(dist):
+    """Greedy bipartite scan over one (N, M) distance matrix → per-column
+    (match_indices, match_dist). Shared by bipartite_match and ssd_loss
+    (reference: bipartite_match_op.cc BipartiteMatch)."""
+    n, m = dist.shape
+
+    def body(_, carry):
+        mi, md, dm = carry
+        flat = jnp.argmax(dm)
+        i, j = flat // m, flat % m
+        ok = dm[i, j] > 0
+        mi = jnp.where(ok, mi.at[j].set(i.astype(jnp.int32)), mi)
+        md = jnp.where(ok, md.at[j].set(dist[i, j]), md)
+        dm = jnp.where(ok, dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
+        return mi, md, dm
+
+    mi0 = jnp.full((m,), -1, jnp.int32)
+    md0 = jnp.zeros((m,), dist.dtype)
+    mi, md, _ = lax.fori_loop(0, min(n, m), body, (mi0, md0, dist))
+    return mi, md
+
+
 def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
                     name=None):
     """Greedy bipartite matching (reference detection.py:1218,
@@ -527,24 +549,10 @@ def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
     M priors). Returns (match_indices (B, M) int32 — row matched to each
     column, -1 if none — and match_dist (B, M))."""
     per_pred = match_type == "per_prediction"
-    thr = float(dist_threshold or 0.5)
+    thr = 0.5 if dist_threshold is None else float(dist_threshold)
 
     def one(dist):
-        n, m = dist.shape
-
-        def body(_, carry):
-            mi, md, dm = carry
-            flat = jnp.argmax(dm)
-            i, j = flat // m, flat % m
-            ok = dm[i, j] > 0
-            mi = jnp.where(ok, mi.at[j].set(i.astype(jnp.int32)), mi)
-            md = jnp.where(ok, md.at[j].set(dist[i, j]), md)
-            dm = jnp.where(ok, dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
-            return mi, md, dm
-
-        mi0 = jnp.full((m,), -1, jnp.int32)
-        md0 = jnp.zeros((m,), dist.dtype)
-        mi, md, _ = lax.fori_loop(0, min(n, m), body, (mi0, md0, dist))
+        mi, md = _greedy_bipartite(dist)
         if per_pred:
             # second pass: unmatched columns take their best row if the
             # distance clears the threshold
@@ -609,22 +617,8 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
             prior[None], (b,) + prior.shape))  # (B, G, M)
         iou = jnp.where(valid[..., None], iou, -1.0)
 
-        # bipartite pass
-        def one(dist):
-            def body(_, carry):
-                mi, dm = carry
-                flat = jnp.argmax(dm)
-                i, j = flat // m, flat % m
-                ok = dm[i, j] > 0
-                mi = jnp.where(ok, mi.at[j].set(i.astype(jnp.int32)), mi)
-                dm = jnp.where(ok,
-                               dm.at[i, :].set(-1.0).at[:, j].set(-1.0), dm)
-                return mi, dm
-            mi0 = jnp.full((m,), -1, jnp.int32)
-            mi, _ = lax.fori_loop(0, min(g, m), body, (mi0, dist))
-            return mi
-
-        match = jax.vmap(one)(iou)  # (B, M)
+        # bipartite pass (shared greedy scan)
+        match = jax.vmap(lambda d: _greedy_bipartite(d)[0])(iou)  # (B, M)
         if match_type == "per_prediction":
             best_row = jnp.argmax(iou, axis=1).astype(jnp.int32)
             best_val = jnp.max(iou, axis=1)

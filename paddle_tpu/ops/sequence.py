@@ -217,6 +217,12 @@ def sequence_expand_as(x, y_length, maxlen=None, name=None):
     must be concrete.)"""
     ln = as_tensor(y_length)
     if maxlen is None:
+        if isinstance(ln.data, jax.core.Tracer):
+            raise ValueError(
+                "sequence_expand_as: pass maxlen= (the static T) under "
+                "jit/static mode — y_length is traced so its max cannot "
+                "size the output")
+        # eager: documented host sync to read the dynamic width
         maxlen = int(np.asarray(jax.device_get(ln.data)).max())
 
     def impl(x, ln, t):

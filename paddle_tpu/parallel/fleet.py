@@ -16,6 +16,7 @@ parallelism (see parallel/embedding.py) since TPU pods have no PS role.
 """
 from __future__ import annotations
 
+import os
 import re
 
 import numpy as np
@@ -118,6 +119,7 @@ class Fleet:
         self._strategy = None
         self._mesh = None
         self._initialized = False
+        self._model = None  # last distributed_model, for save_persistables
 
     # -- lifecycle ----------------------------------------------------------
     def init(self, role_maker=None, is_collective=True, strategy=None,
@@ -163,6 +165,12 @@ class Fleet:
         on TPU placement IS the broadcast.)"""
         mesh = self._mesh
         for name, p in model.named_parameters():
+            # params already mesh-placed with a non-trivial spec (e.g. a
+            # PipelineStack's pp-stacked weights) keep their placement
+            cur = getattr(p.data, "sharding", None)
+            if isinstance(cur, NamedSharding) and \
+                    any(ax is not None for ax in cur.spec):
+                continue
             spec = param_spec_fn(name, p.data.shape) if param_spec_fn else P()
             p.data = jax.device_put(p.data, NamedSharding(mesh, spec or P()))
         for name, b in model.named_buffers():
@@ -205,17 +213,71 @@ class Fleet:
                 param_spec_fn = lambda n, s: megatron_param_spec(
                     n, s, tensor_axis=axis)
         self.shard_model(model, param_spec_fn)
+        self._model = model
         return model
+
+    def pipeline_stack(self, blocks, spec_fn=None):
+        """Stage-shard a trunk of identical blocks over the mesh's pp
+        axis (reference: Fleet pipeline strategy / PipelineOptimizer —
+        see parallel/pipeline.py for the GSPMD redesign). Returns a
+        drop-in Layer replacing the LayerList."""
+        from .pipeline import PipelineStack
+        axis = self._strategy.tensor_axis
+        if spec_fn is None and self._mesh is not None and \
+                axis in self._mesh.axis_names and self._mesh.shape[axis] > 1:
+            spec_fn = lambda n, s: megatron_param_spec(n, s,
+                                                       tensor_axis=axis)
+        return PipelineStack(blocks, mesh=self._mesh,
+                             pipeline_axis=self._strategy.pipeline_axis,
+                             spec_fn=spec_fn)
 
     # -- io parity ----------------------------------------------------------
     def save_persistables(self, executor=None, dirname=None,
-                          main_program=None):
+                          main_program=None, model=None, optimizer=None):
+        """Save the distributed model's (and optionally optimizer's) state
+        as an orbax checkpoint (reference: fleet_base.py
+        save_persistables → io.save_persistables). The sharded arrays are
+        gathered on save; load_persistables re-places them onto each
+        parameter's live sharding."""
         from .. import io as pio
-        if dirname:
-            pio.save({}, dirname + "/fleet.ckpt")
+        model = model or self._model
+        if dirname is None or model is None:
+            raise ValueError("save_persistables needs dirname= and a model "
+                             "(pass model= or call distributed_model first)")
+        state = {"model": model.state_dict()}
+        if optimizer is not None:
+            state["optimizer"] = optimizer.state_dict()
+        pio.orbax_save(dirname, state)
 
-    def save_inference_model(self, *args, **kwargs):
-        pass
+    def load_persistables(self, executor=None, dirname=None,
+                          main_program=None, model=None, optimizer=None):
+        """Restore save_persistables output with placement preserved."""
+        from .. import io as pio
+        model = model or self._model
+        if dirname is None or model is None:
+            raise ValueError("load_persistables needs dirname= and a model")
+        template = {"model": model.state_dict()}
+        if optimizer is not None:
+            template["optimizer"] = optimizer.state_dict()
+        state = pio.orbax_restore(dirname, template=template)
+        model.set_state_dict(state["model"])
+        if optimizer is not None and "optimizer" in state:
+            optimizer.set_state_dict(state["optimizer"])
+        return state
+
+    def save_inference_model(self, dirname=None, feeded_var_names=None,
+                             target_vars=None, executor=None,
+                             main_program=None, model=None,
+                             input_spec=None):
+        """Export the (gathered) model for inference (reference:
+        fleet_base.py save_inference_model → io.save_inference_model)."""
+        from .. import io as pio
+        model = model or self._model
+        if dirname is None or model is None:
+            raise ValueError("save_inference_model needs dirname= and a "
+                             "model")
+        pio.save_inference_model(os.path.join(dirname, "model"), model,
+                                 input_spec=input_spec)
 
 
 class DistributedOptimizer:

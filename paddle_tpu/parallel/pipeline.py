@@ -1,0 +1,103 @@
+"""User-facing pipeline (pp) stage sharding for the fleet bridge.
+
+TPU-native rebuild of Fleet's pipeline strategy (reference:
+python/paddle/fluid/optimizer.py:PipelineOptimizer +
+incubate/fleet/collective DistributedStrategy pipeline mode). The
+reference splits the Program into per-device section programs and
+streams microbatches between them. The GSPMD formulation used here:
+a trunk of IDENTICAL blocks (transformer encoder layers) has its
+per-block parameters stacked on a leading axis sharded over the mesh's
+`pp` axis — every stage's weights live only on its pipeline group — and
+the forward is one `lax.scan` over the stacked axis. XLA then streams
+each stage's (stage-resident) weights/activations with its own
+collectives. This is the standard JAX/GSPMD pipeline recipe
+("stacked-scan with stage-sharded weights"); the lower-level explicit
+GPipe microbatch schedule over `ppermute` lives in parallel/megatron.py.
+
+The stacked module is a drop-in replacement for a LayerList trunk:
+optimizer/state_dict/checkpoint all see ordinary (sharded) Parameters.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..tensor import Tensor, Parameter
+from ..dispatch import apply
+from .. import autograd as _ag
+from ..nn.layer import Layer
+
+__all__ = ["PipelineStack"]
+
+
+class PipelineStack(Layer):
+    """Stack N identical blocks into stage-sharded scanned weights.
+
+    blocks: list/LayerList of structurally identical Layers (same param
+    names/shapes). mesh + pipeline_axis: where the stacked axis lives.
+    spec_fn(name, shape) -> PartitionSpec gives the per-block placement
+    (e.g. megatron tp specs); the pp axis is prepended to it.
+    """
+
+    def __init__(self, blocks, mesh=None, pipeline_axis="pp",
+                 spec_fn=None):
+        super().__init__()
+        blocks = list(blocks)
+        if not blocks:
+            raise ValueError("PipelineStack needs at least one block")
+        self._template = blocks[0]
+        # template params are NOT trainable on their own — exclude the
+        # template from registration (its holders get swapped per step)
+        self._sub_layers.pop("_template", None)
+        object.__setattr__(self, "_template", blocks[0])
+
+        names = list(blocks[0].state_dict().keys())
+        self._names = names
+        self._flat_names = []
+        for name in names:
+            per = [b.state_dict()[name].data for b in blocks]
+            stk = jnp.stack(per)
+            if mesh is not None:
+                spec = spec_fn(name, per[0].shape) if spec_fn else P()
+                full = P(*((pipeline_axis,) + tuple(spec)))
+                stk = jax.device_put(stk, NamedSharding(mesh, full))
+            flat = "stk_" + name.replace(".", "__")
+            setattr(self, flat, Parameter(stk))
+            self._flat_names.append(flat)
+        self.num_blocks = len(blocks)
+
+    def forward(self, x, *extras):
+        stacked = [self._parameters[n] for n in self._flat_names]
+        template = self._template
+        # the template is unregistered (its params are placeholders), so
+        # train/eval mode must be forwarded by hand
+        template.train() if self.training else template.eval()
+        names = self._names
+
+        def impl(x, *rest):
+            stk = rest[:len(names)]
+            extra_arr = rest[len(names):]
+
+            def body(h, slices):
+                holders = template.state_dict()
+                saved = {}
+                try:
+                    for name, sl in zip(names, slices):
+                        saved[name] = holders[name].data
+                        holders[name].data = sl
+                    with _ag.no_grad():
+                        out = template(Tensor(h),
+                                       *[Tensor(e) for e in extra_arr])
+                    out = out.data if isinstance(out, Tensor) else out
+                finally:
+                    for name, v in saved.items():
+                        holders[name].data = v
+                return out, None
+
+            h, _ = lax.scan(body, x, tuple(stk))
+            return h
+
+        return apply(impl, (x,) + tuple(stacked) + tuple(extras),
+                     name="pipeline_stack")

@@ -1,0 +1,278 @@
+"""paddle_tpu.quantization — QAT fake-quant + post-training int8.
+
+TPU-native rebuild of the reference's slim quantization
+(reference: python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py:147 QuantizationTransformPass — inserts
+fake_quantize/dequantize ops on conv/mul inputs; post_training_quantization.py
+— calibrates activation scales from sample data then freezes int8 weights).
+
+The reference rewrites the static Program graph; here quantization is a
+Layer transform (the dygraph-natural form):
+
+* :func:`quant_aware` wraps every Linear / Conv2D in a fake-quant layer:
+  weights quantize per-channel abs-max each step, activations through a
+  moving-average abs-max observer (a persistable buffer, like the
+  reference's MovingAverageAbsMaxScale op). The quant-dequant uses a
+  straight-through estimator (custom rounding VJP), so training under
+  jit/GSPMD just works.
+* :func:`convert` freezes a calibrated/trained model for inference:
+  weights stored int8 + per-channel scales (the int8 tensors are what a
+  serving stack ships; compute dequantizes into bf16 for the MXU).
+* :func:`quant_post_static` = run calibration batches through the
+  observers, then convert (PTQ).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor, Parameter, as_tensor
+from .dispatch import apply
+from . import nn
+from .nn.layer import Layer
+
+__all__ = ["fake_quant", "QuantConfig", "quant_aware", "convert",
+           "quant_post_static", "QuantedLinear", "QuantedConv2D",
+           "QuantizedLinear", "QuantizedConv2D"]
+
+
+@jax.custom_vjp
+def _ste_round(x):
+    return jnp.round(x)
+
+
+def _ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_bwd(_, g):
+    return (g,)  # straight-through: d round(x)/dx := 1
+
+
+_ste_round.defvjp(_ste_fwd, _ste_bwd)
+
+
+def _qdq(x, scale, bits):
+    """Quantize-dequantize with STE. scale broadcasts against x."""
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(_ste_round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def fake_quant(x, scale, bits=8, name=None):
+    """Framework op: fake quantization (reference: fake_quantize_op.cc
+    FakeQuantizeDequantizeAbsMax)."""
+    return apply(lambda x, s: _qdq(x, s, bits), (x, as_tensor(scale)),
+                 name="fake_quant")
+
+
+class QuantConfig:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max"):
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.moving_rate = moving_rate
+        self.weight_quantize_type = weight_quantize_type
+        self.activation_quantize_type = activation_quantize_type
+
+
+class _QuantedBase(Layer):
+    """Shared QAT machinery: activation observer + weight fake-quant."""
+
+    def __init__(self, inner, config, ch_axis):
+        super().__init__()
+        self.inner = inner
+        self._cfg = config
+        self._ch_axis = ch_axis  # weight output-channel axis
+        self.register_buffer("act_scale",
+                             Tensor(jnp.zeros((), jnp.float32)),
+                             persistable=True)
+        self._calibrating = False
+
+    def _observe(self, x):
+        """Moving-average abs-max observer → fake-quant activations. The
+        whole update is functional (like BatchNorm's running stats), so
+        the observer advances both eagerly AND under jit tracing — the
+        new scale is written back through the buffer holder, which
+        to_static threads as mutable state."""
+        cur = apply(lambda x: jnp.max(jnp.abs(x)).astype(jnp.float32),
+                    (x,), nondiff=True, name="abs_max")
+        r = self._cfg.moving_rate
+        if self.training or self._calibrating:
+            new_scale = apply(
+                lambda old, cur: jnp.where(old > 0.0,
+                                           r * old + (1 - r) * cur, cur),
+                (self.act_scale, cur), nondiff=True, name="ma_scale")
+            self.act_scale.data = new_scale.data
+            scale = new_scale
+        else:
+            # eval before any calibration: fall back to the batch abs-max
+            scale = apply(lambda s, cur: jnp.where(s > 0.0, s, cur),
+                          (self.act_scale, cur), nondiff=True,
+                          name="scale_or_cur")
+        return fake_quant(x, scale, self._cfg.activation_bits)
+
+    def _wq(self, w):
+        if self._cfg.weight_quantize_type == "channel_wise_abs_max":
+            axes = tuple(i for i in range(w.data.ndim)
+                         if i != self._ch_axis)
+            scale = apply(
+                lambda w: jnp.max(jnp.abs(w), axis=axes, keepdims=True),
+                (w,), nondiff=True, name="w_abs_max")
+        else:
+            scale = apply(lambda w: jnp.max(jnp.abs(w)), (w,),
+                          nondiff=True, name="w_abs_max")
+        return fake_quant(w, scale, self._cfg.weight_bits)
+
+
+class QuantedLinear(_QuantedBase):
+    """reference: QuantizationTransformPass on mul/matmul ops."""
+
+    def __init__(self, inner, config):
+        super().__init__(inner, config, ch_axis=1)  # (in, out)
+
+    def forward(self, x):
+        from .ops import nn_ops as F
+        x = self._observe(x)
+        w = self._wq(self.inner.weight)
+        out = x @ w
+        if self.inner.bias is not None:
+            out = out + self.inner.bias
+        return out
+
+
+class QuantedConv2D(_QuantedBase):
+    """reference: QuantizationTransformPass on conv2d ops."""
+
+    def __init__(self, inner, config):
+        super().__init__(inner, config, ch_axis=0)  # (out, in, kh, kw)
+
+    def forward(self, x):
+        from .ops import nn_ops as F
+        x = self._observe(x)
+        w = self._wq(self.inner.weight)
+        return F.conv2d(x, w, self.inner.bias, **self.inner._attrs)
+
+
+def _wrap(layer, config):
+    for name, child in list(layer._sub_layers.items()):
+        if isinstance(child, nn.Linear):
+            layer._sub_layers[name] = QuantedLinear(child, config)
+        elif isinstance(child, nn.Conv2D):
+            layer._sub_layers[name] = QuantedConv2D(child, config)
+        else:
+            _wrap(child, config)
+    return layer
+
+
+def quant_aware(model, config=None):
+    """Insert fake-quant wrappers on every Linear/Conv2D (reference:
+    QuantizationTransformPass.apply). Train as usual afterwards."""
+    return _wrap(model, config or QuantConfig())
+
+
+# ---------------------------------------------------------------------------
+# frozen int8 inference layers
+
+def _freeze_weight(w, ch_axis, bits):
+    qmax = 2 ** (bits - 1) - 1
+    arr = w.data if isinstance(w, Tensor) else jnp.asarray(w)
+    axes = tuple(i for i in range(arr.ndim) if i != ch_axis)
+    scale = jnp.maximum(jnp.max(jnp.abs(arr), axis=axes, keepdims=True),
+                        1e-8)
+    q = jnp.clip(jnp.round(arr / scale * qmax), -qmax, qmax).astype(
+        jnp.int8)
+    return q, (scale / qmax).astype(jnp.float32)
+
+
+class QuantizedLinear(Layer):
+    """Frozen int8 linear (reference: QuantizationFreezePass output —
+    int8 weight + per-channel scale). Weight ships int8; the matmul
+    dequantizes into the activation dtype for the MXU."""
+
+    def __init__(self, inner, bits=8):
+        super().__init__()
+        q, scale = _freeze_weight(inner.weight, 1, bits)
+        self.register_buffer("qweight", Tensor(q), persistable=True)
+        self.register_buffer("wscale", Tensor(scale), persistable=True)
+        self.bias = inner.bias
+
+    def forward(self, x):
+        def impl(x, q, s, *b):
+            w = q.astype(x.dtype) * s.astype(x.dtype)
+            out = x @ w
+            if b:
+                out = out + b[0]
+            return out
+
+        args = (x, self.qweight, self.wscale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply(impl, args, name="quantized_linear")
+
+
+class QuantizedConv2D(Layer):
+    def __init__(self, inner, bits=8):
+        super().__init__()
+        q, scale = _freeze_weight(inner.weight, 0, bits)
+        self.register_buffer("qweight", Tensor(q), persistable=True)
+        self.register_buffer("wscale", Tensor(scale), persistable=True)
+        self.bias = inner.bias
+        self._conv_attrs = dict(inner._attrs)
+
+    def forward(self, x):
+        from .ops import nn_ops as F
+        w = apply(lambda q, s: q.astype(jnp.float32) * s,
+                  (self.qweight, self.wscale), nondiff=True,
+                  name="dequant_w")
+        return F.conv2d(x, w, self.bias, **self._conv_attrs)
+
+
+def convert(model, bits=8):
+    """Freeze a quant_aware (or plain) model for int8 inference
+    (reference: QuantizationFreezePass + convert)."""
+    def _conv(layer):
+        for name, child in list(layer._sub_layers.items()):
+            if isinstance(child, QuantedLinear):
+                layer._sub_layers[name] = QuantizedLinear(child.inner, bits)
+            elif isinstance(child, QuantedConv2D):
+                layer._sub_layers[name] = QuantizedConv2D(child.inner, bits)
+            elif isinstance(child, nn.Linear):
+                layer._sub_layers[name] = QuantizedLinear(child, bits)
+            elif isinstance(child, nn.Conv2D):
+                layer._sub_layers[name] = QuantizedConv2D(child, bits)
+            else:
+                _conv(child)
+        return layer
+
+    model = _conv(model)
+    model.eval()
+    return model
+
+
+def quant_post_static(model, sample_batches, config=None, bits=8):
+    """Post-training quantization (reference:
+    post_training_quantization.py): run calibration batches through
+    observers, then freeze."""
+    config = config or QuantConfig()
+    model = quant_aware(model, config)
+    for m in model.sublayers(include_self=True):
+        if isinstance(m, _QuantedBase):
+            m._calibrating = True
+    model.eval()
+    from . import autograd
+    with autograd.no_grad():
+        for batch in sample_batches:
+            if isinstance(batch, (tuple, list)):
+                model(*batch)
+            else:
+                model(batch)
+    for m in model.sublayers(include_self=True):
+        if isinstance(m, _QuantedBase):
+            m._calibrating = False
+    return convert(model, bits)

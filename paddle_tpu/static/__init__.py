@@ -366,6 +366,11 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, scope=None):
         program = program or default_main_program()
+        dp_mesh = None
+        if isinstance(program, CompiledProgram):
+            if program._data_parallel:
+                dp_mesh = program._dp_mesh
+            program = program.program
         feed = feed or {}
         fetch_list = fetch_list or []
         if not program.global_block().ops:
@@ -380,6 +385,30 @@ class Executor:
             if isinstance(v, Tensor):
                 v = v.data
             feed_arrays[k] = jnp.asarray(v)
+
+        if dp_mesh is not None:
+            # CompiledProgram.with_data_parallel: batch-shard every feed
+            # over the mesh; params ride replicated and GSPMD partitions
+            # the compiled step (reference: compiler.py graph replication)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ndev = dp_mesh.devices.size
+            for k, a in feed_arrays.items():
+                if a.ndim >= 1 and a.shape[0] % ndev == 0:
+                    spec = P(*(("dp",) + (None,) * (a.ndim - 1)))
+                else:
+                    if a.ndim >= 1:
+                        raise ValueError(
+                            f"with_data_parallel: feed '{k}' batch dim "
+                            f"{a.shape[0]} is not divisible by the "
+                            f"{ndev}-device mesh")
+                    spec = P()
+                feed_arrays[k] = jax.device_put(
+                    a, NamedSharding(dp_mesh, spec))
+            rep = NamedSharding(dp_mesh, P())
+            for n, holder in program.param_vars.items():
+                cur = getattr(holder.data, "sharding", None)
+                if cur != rep:
+                    holder.data = jax.device_put(holder.data, rep)
 
         param_names = sorted(program.param_vars)
         opt_entries = program.optimizers
@@ -529,17 +558,26 @@ class ExecutionStrategy:
 
 
 class CompiledProgram:
-    """reference: compiler.py:CompiledProgram.with_data_parallel → on TPU
-    the Executor's jit already compiles; data parallelism is expressed with
-    paddle_tpu.parallel (Mesh + shard_map) instead of SSA graph replication."""
+    """reference: compiler.py:CompiledProgram.with_data_parallel. The
+    reference replicates the SSA graph per GPU and all-reduces gradients;
+    here with_data_parallel builds a 1-axis device mesh and Executor.run
+    shards every feed on its batch dim over it — XLA GSPMD partitions the
+    whole compiled step (grad all-reduces included), which is the TPU
+    shape of the same feature."""
 
     def __init__(self, program, build_strategy=None):
         self.program = program
         self.build_strategy = build_strategy or BuildStrategy()
         self._data_parallel = False
+        self._dp_mesh = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, places=None):
+        from jax.sharding import Mesh
+        devices = list(places) if places and not isinstance(
+            places[0], (str, int)) else jax.devices()
+        if len(devices) > 1:
+            self._dp_mesh = Mesh(np.array(devices), ("dp",))
         self._data_parallel = True
         return self
 
@@ -548,13 +586,17 @@ class CompiledProgram:
 
 
 class ParallelExecutor:
-    """reference: parallel_executor.py — thin parity shim over Executor (XLA
-    GSPMD replaces the SSA multi-device executor)."""
+    """reference: parallel_executor.py — multi-device execution. Wraps the
+    program in CompiledProgram.with_data_parallel so feeds batch-shard
+    over all devices and GSPMD partitions the compiled step (the XLA
+    replacement for the reference's SSA multi-device executor)."""
 
     def __init__(self, use_cuda=False, loss_name=None, main_program=None,
                  **kwargs):
         self._exe = Executor()
-        self._program = main_program or default_main_program()
+        prog = main_program or default_main_program()
+        self._program = CompiledProgram(prog).with_data_parallel(
+            loss_name=loss_name)
 
     def run(self, fetch_list=None, feed=None, return_numpy=True):
         return self._exe.run(self._program, feed=feed,

@@ -184,6 +184,17 @@ class Tensor:
                 f"set_value shape mismatch: {value.shape} vs {self.data.shape}")
         if was_jax and not isinstance(value, jax.core.Tracer):
             value = jnp.array(value, copy=True)
+        # keep the holder's mesh placement: restoring a checkpoint into a
+        # dp×tp-sharded parameter must not silently re-replicate it
+        old = self.data
+        if (isinstance(old, jax.Array)
+                and not isinstance(old, jax.core.Tracer)
+                and not isinstance(value, jax.core.Tracer)):
+            try:
+                if value.sharding != old.sharding:
+                    value = jax.device_put(value, old.sharding)
+            except (AttributeError, ValueError):
+                pass
         self.data = value
         return self
 

@@ -1,5 +1,7 @@
 """paddle_tpu.utils — profiler, debug guards, logging (reference:
-python/paddle/fluid/profiler.py, platform/profiler; debugger)."""
+python/paddle/fluid/profiler.py, platform/profiler; log_helper.py)."""
 from . import profiler
 from . import debug
+from . import log
 from .debug import check_nan_inf, enable_nan_guard
+from .log import get_logger, logger

@@ -1,0 +1,49 @@
+"""paddle_tpu.utils.log — framework logging.
+
+Rebuild of the reference's logging helpers (reference:
+python/paddle/fluid/log_helper.py get_logger — a configured
+``logging.Logger`` per subsystem that doesn't propagate to root).
+"""
+from __future__ import annotations
+
+import logging
+import os
+
+_loggers = {}
+
+_FMT = "%(asctime)s %(levelname)s [%(name)s] %(message)s"
+
+
+def get_logger(name="paddle_tpu", level=None, fmt=_FMT):
+    """A configured, non-propagating logger (reference:
+    log_helper.py:get_logger). Level defaults to $PADDLE_TPU_LOG_LEVEL or
+    INFO."""
+    if name in _loggers:
+        logger = _loggers[name]
+        if level is not None:
+            logger.setLevel(level)
+        return logger
+    logger = logging.getLogger(name)
+    if level is None:
+        level = getattr(logging,
+                        os.environ.get("PADDLE_TPU_LOG_LEVEL", "INFO"),
+                        logging.INFO)
+    logger.setLevel(level)
+    logger.propagate = False
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter(fmt))
+        logger.addHandler(handler)
+    _loggers[name] = logger
+    return logger
+
+
+logger = get_logger()
+
+
+def set_level(level):
+    """Set the level on every framework logger at once."""
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    for lg in _loggers.values():
+        lg.setLevel(level)

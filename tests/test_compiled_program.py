@@ -1,0 +1,93 @@
+"""CompiledProgram.with_data_parallel / ParallelExecutor over the 8-device
+mesh (VERDICT r2 #9; reference: python/paddle/fluid/compiler.py,
+parallel_executor.py): feeds batch-shard over the mesh and training
+matches the single-device Executor numerically."""
+import numpy as np
+import pytest
+import jax
+
+import paddle_tpu as pt
+from paddle_tpu import static, optimizer as opt
+from paddle_tpu.fluid import layers as FL
+
+
+def _build_program():
+    prog, sprog = static.Program(), static.Program()
+    with static.program_guard(prog, sprog):
+        x = static.data("x", [None, 8], "float32")
+        y = static.data("y", [None, 1], "float32")
+        h = FL.fc(x, 16, act="relu")
+        out = FL.fc(h, 1)
+        loss = ((out - y) ** 2).mean()
+        sgd = opt.SGD(learning_rate=0.1)
+        sgd.minimize(loss)
+    return prog, sprog, loss
+
+
+def _data(n=64):
+    rng = np.random.RandomState(0)
+    x = rng.rand(n, 8).astype("f4")
+    y = (x.sum(-1, keepdims=True) * 0.5).astype("f4")
+    return x, y
+
+
+def test_with_data_parallel_matches_single_device():
+    x, y = _data()
+
+    pt.enable_static()
+    try:
+        pt.seed(7)
+        prog, sprog, loss = _build_program()
+        exe = static.Executor()
+        exe.run(sprog)
+        ref = [float(exe.run(prog, feed={"x": x, "y": y},
+                             fetch_list=[loss])[0]) for _ in range(5)]
+
+        pt.seed(7)
+        prog2, sprog2, loss2 = _build_program()
+        exe2 = static.Executor()
+        exe2.run(sprog2)
+        cp = static.CompiledProgram(prog2).with_data_parallel(
+            loss_name=loss2.name)
+        got = [float(exe2.run(cp, feed={"x": x, "y": y},
+                              fetch_list=[loss2])[0]) for _ in range(5)]
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+        # params actually live replicated on the 8-device mesh
+        p = next(iter(prog2.param_vars.values()))
+        assert len(p.data.sharding.device_set) == len(jax.devices())
+        assert ref[-1] < ref[0]
+    finally:
+        pt.disable_static()
+
+
+def test_with_data_parallel_rejects_indivisible_batch():
+    pt.enable_static()
+    try:
+        pt.seed(0)
+        prog, sprog, loss = _build_program()
+        exe = static.Executor()
+        exe.run(sprog)
+        cp = static.CompiledProgram(prog).with_data_parallel(
+            loss_name=loss.name)
+        x, y = _data(n=30)  # 30 % 8 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            exe.run(cp, feed={"x": x, "y": y}, fetch_list=[loss])
+    finally:
+        pt.disable_static()
+
+
+def test_parallel_executor_runs_sharded():
+    x, y = _data()
+    pt.enable_static()
+    try:
+        pt.seed(3)
+        prog, sprog, loss = _build_program()
+        static.Executor().run(sprog)
+        pe = static.ParallelExecutor(loss_name=loss.name,
+                                     main_program=prog)
+        losses = [float(pe.run(feed={"x": x, "y": y},
+                               fetch_list=[loss])[0]) for _ in range(5)]
+        assert losses[-1] < losses[0]
+    finally:
+        pt.disable_static()

@@ -235,6 +235,17 @@ class TestMatching:
         assert mi.numpy()[0, 0] == 0 and mi.numpy()[0, 1] == 1
         assert mi.numpy()[0, 2] == -1
 
+    def test_bipartite_zero_threshold_respected(self):
+        """Regression (review r3): dist_threshold=0.0 must not silently
+        become 0.5."""
+        dist = np.array([[[0.9, 0.6, 0.3],
+                          [0.8, 0.7, 0.2]]], "f4")
+        mi, md = D.bipartite_match(pt.to_tensor(dist),
+                                   match_type="per_prediction",
+                                   dist_threshold=0.0)
+        # col2 best row 0 at 0.3 > 0.0 → matched now
+        assert mi.numpy()[0, 2] == 0
+
     def test_target_assign(self):
         inp = np.arange(24, dtype="f4").reshape(1, 6, 4)
         match = np.array([[2, -1, 0]], "i4")

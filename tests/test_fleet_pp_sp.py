@@ -1,0 +1,116 @@
+"""pp and sp axes through the user-facing fleet bridge (VERDICT r2 #8;
+reference: Fleet pipeline strategy, fleet_base.py + PipelineOptimizer).
+
+dp2×pp2×tp2: zoo-BERT whose encoder trunk is replaced by
+fleet.pipeline_stack (stage-sharded stacked-scan, parallel/pipeline.py);
+training losses must match the single-device run step for step.
+
+sp: the token batch is sharded over (dp, sp) and GSPMD inserts the
+sequence-parallel collectives; losses again match single-device."""
+import numpy as np
+import pytest
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer, jit
+from paddle_tpu.models.bert import BertConfig, BertForPretraining
+from paddle_tpu.parallel.fleet import Fleet, DistributedStrategy
+
+
+def _bert_and_data(batch=8, seq=16):
+    cfg = BertConfig.tiny(hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+    pt.seed(123)
+    model = BertForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("i4")
+    mlm = np.where(rng.rand(batch, seq) < 0.2,
+                   rng.randint(0, cfg.vocab_size, (batch, seq)),
+                   -1).astype("i4")
+    nsp = rng.randint(0, 2, (batch,)).astype("i4")
+    return cfg, model, ids, mlm, nsp
+
+
+def _make_step(model, o):
+    def step(ids, mlm, nsp):
+        logits, nsp_logits = model(ids)
+        loss = model.loss(logits, nsp_logits, mlm, nsp)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+    return jit.to_static(step, models=[model], optimizers=[o])
+
+
+def _reference_losses(steps=3):
+    cfg, model_ref, ids, mlm, nsp = _bert_and_data()
+    o_ref = optimizer.SGD(learning_rate=0.1,
+                          parameters=model_ref.parameters())
+    step_ref = _make_step(model_ref, o_ref)
+    return [float(step_ref(pt.to_tensor(ids), pt.to_tensor(mlm),
+                           pt.to_tensor(nsp)).numpy())
+            for _ in range(steps)], (ids, mlm, nsp)
+
+
+def test_fleet_bert_dp_pp_tp_matches_single_device():
+    ref_losses, (ids, mlm, nsp) = _reference_losses()
+
+    cfg, model, _, _, _ = _bert_and_data()
+    fleet = Fleet()
+    strategy = DistributedStrategy()
+    strategy.mesh_shape = {"dp": 2, "pp": 2, "tp": 2}
+    fleet.init(strategy=strategy)
+    # stage-shard the encoder trunk over pp, THEN place the rest (tp)
+    model.bert.encoder = fleet.pipeline_stack(list(model.bert.encoder))
+    model = fleet.distributed_model(model)
+
+    # the stacked trunk params really live on the pp axis
+    stk = model.bert.encoder
+    some = stk._parameters[stk._flat_names[0]]
+    assert isinstance(some.data.sharding, jax.sharding.NamedSharding)
+    assert some.data.sharding.spec[0] == "pp"
+
+    o = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = _make_step(model, o)
+    t = (pt.to_tensor(ids), pt.to_tensor(mlm), pt.to_tensor(nsp))
+    losses = [float(step(*t).numpy()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_fleet_bert_sp_sharded_tokens_matches_single_device():
+    ref_losses, (ids, mlm, nsp) = _reference_losses()
+
+    cfg, model, _, _, _ = _bert_and_data()
+    fleet = Fleet()
+    strategy = DistributedStrategy()
+    strategy.mesh_shape = {"dp": 2, "sp": 4}
+    fleet.init(strategy=strategy)
+    model = fleet.distributed_model(model)
+    o = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = _make_step(model, o)
+
+    # shard tokens over (dp batch, sp sequence): GSPMD inserts the
+    # sequence-parallel gathers for attention
+    from jax.sharding import NamedSharding
+    mesh = fleet.mesh
+    tok_sharding = NamedSharding(mesh, P("dp", "sp"))
+    row_sharding = NamedSharding(mesh, P("dp"))
+    t_ids = pt.to_tensor(jax.device_put(ids, tok_sharding))
+    t_mlm = pt.to_tensor(jax.device_put(mlm, tok_sharding))
+    t_nsp = pt.to_tensor(jax.device_put(nsp, row_sharding))
+    losses = [float(step(t_ids, t_mlm, t_nsp).numpy()) for _ in range(3)]
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_stack_forward_matches_layerlist():
+    """The stacked-scan trunk computes exactly what the LayerList did."""
+    cfg, model, ids, _, _ = _bert_and_data()
+    x = pt.to_tensor(ids)
+    model.eval()
+    ref, _ = model.bert(x)
+    from paddle_tpu.parallel.pipeline import PipelineStack
+    model.bert.encoder = PipelineStack(list(model.bert.encoder))
+    got, _ = model.bert(x)
+    np.testing.assert_allclose(got.numpy(), ref.numpy(), rtol=1e-5,
+                               atol=1e-5)

@@ -1,0 +1,130 @@
+"""fluid.nets composites, DataFeeder/py_reader compat, utils logger
+(VERDICT r2 missing #6/#7 + ADVICE A5)."""
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fluid, static
+from paddle_tpu.fluid import nets, layers as FL
+from paddle_tpu.fluid.data_feeder import DataFeeder, PyReader, py_reader, \
+    read_file, double_buffer
+
+
+class TestNets:
+    def test_simple_img_conv_pool(self):
+        pt.seed(0)
+        x = pt.to_tensor(np.random.rand(2, 3, 16, 16).astype("f4"))
+        out = nets.simple_img_conv_pool(x, num_filters=8, filter_size=3,
+                                        pool_size=2, pool_stride=2,
+                                        conv_padding=1, act="relu")
+        assert out.shape == [2, 8, 8, 8]
+        assert float(out.min()) >= 0.0
+
+    def test_img_conv_group(self):
+        pt.seed(1)
+        x = pt.to_tensor(np.random.rand(2, 3, 16, 16).astype("f4"))
+        out = nets.img_conv_group(x, conv_num_filter=[8, 8], pool_size=2,
+                                  conv_act="relu", pool_stride=2,
+                                  conv_with_batchnorm=True)
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_glu(self):
+        x = pt.to_tensor(np.random.randn(4, 10).astype("f4"))
+        out = nets.glu(x, dim=-1)
+        assert out.shape == [4, 5]
+        a, b = x.numpy()[:, :5], x.numpy()[:, 5:]
+        ref = a * (1 / (1 + np.exp(-b)))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_sequence_conv_pool(self):
+        pt.seed(2)
+        x = pt.to_tensor(np.random.rand(3, 7, 6).astype("f4"))
+        out = nets.sequence_conv_pool(x, num_filters=4, filter_size=3,
+                                      act="sigmoid")
+        assert out.shape == [3, 4]
+
+    def test_scaled_dot_product_attention(self):
+        pt.seed(3)
+        q = pt.to_tensor(np.random.rand(2, 5, 8).astype("f4"))
+        out = nets.scaled_dot_product_attention(q, q, q, num_heads=2)
+        assert out.shape == [2, 5, 8]
+
+
+class TestDataFeeder:
+    def test_feed_builds_named_batches(self):
+        pt.enable_static()
+        try:
+            prog, sprog = static.Program(), static.Program()
+            with static.program_guard(prog, sprog):
+                x = static.data("img", [None, 4], "float32")
+                y = static.data("lbl", [None, 1], "int64")
+                feeder = fluid.DataFeeder(feed_list=[x, y])
+            batch = feeder.feed([(np.ones(4), [1]), (np.zeros(4), [0])])
+            assert set(batch) == {"img", "lbl"}
+            assert batch["img"].shape == (2, 4)
+            assert batch["img"].dtype == np.float32
+            # int64 canonicalizes to int32 (jax x64-off, the TPU dtype)
+            assert batch["lbl"].dtype in (np.int32, np.int64)
+        finally:
+            pt.disable_static()
+
+    def test_feed_rejects_ragged_rows(self):
+        feeder = DataFeeder(feed_list=["a", "b"])
+        with pytest.raises(ValueError, match="fields"):
+            feeder.feed([(1,)])
+
+
+class TestPyReader:
+    def test_sample_list_generator(self):
+        pt.enable_static()
+        try:
+            prog, sprog = static.Program(), static.Program()
+            with static.program_guard(prog, sprog):
+                reader = py_reader(capacity=8, shapes=[[None, 2], [None]],
+                                   dtypes=["float32", "int64"])
+                xs = read_file(reader)
+            assert len(xs) == 2
+
+            def gen():
+                for i in range(3):
+                    yield [(np.full(2, i), i), (np.full(2, i + 10), i)]
+
+            reader.decorate_sample_list_generator(gen)
+            reader.start()
+            feeds = list(reader)
+            assert len(feeds) == 3
+            first = feeds[0]
+            assert set(first) == {xs[0].name, xs[1].name}
+            assert first[xs[0].name].shape == (2, 2)
+            assert double_buffer(reader) is reader
+        finally:
+            pt.disable_static()
+
+    def test_batch_generator(self):
+        r = PyReader(feed_list=[])
+
+        def gen():
+            yield {"a": np.zeros(3)}
+        r.decorate_batch_generator(gen)
+        out = list(r)
+        assert out[0]["a"].shape == (3,)
+
+
+class TestLogger:
+    def test_get_logger_configured(self):
+        from paddle_tpu.utils import get_logger
+        lg = get_logger("paddle_tpu.test")
+        assert lg.propagate is False
+        assert lg.handlers
+        lg2 = get_logger("paddle_tpu.test")
+        assert lg is lg2 and len(lg2.handlers) == 1
+
+    def test_set_level(self):
+        from paddle_tpu.utils import get_logger
+        from paddle_tpu.utils.log import set_level
+        lg = get_logger("paddle_tpu.lvl")
+        set_level("DEBUG")
+        assert lg.level == logging.DEBUG
+        set_level("INFO")

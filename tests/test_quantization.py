@@ -1,0 +1,124 @@
+"""Quantization tests (VERDICT r2 #10; reference:
+contrib/slim/quantization/quantization_pass.py + tests in
+contrib/slim/tests/test_quantization_pass.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as opt
+from paddle_tpu import quantization as Q
+
+
+def test_fake_quant_levels_and_ste():
+    x = pt.to_tensor(np.linspace(-0.95, 0.95, 64).astype("f4"))
+    x.stop_gradient = False
+    out = Q.fake_quant(x, 1.0, bits=8)
+    vals = np.unique(np.round(out.numpy() * 127).astype("i4"))
+    assert vals.min() >= -127 and vals.max() <= 127
+    # quantization error bounded by half a step
+    assert np.abs(out.numpy() - x.numpy()).max() <= (1 / 127) / 2 + 1e-6
+    out.sum().backward()
+    # straight-through estimator: gradient is 1 inside the clip range
+    np.testing.assert_allclose(np.asarray(x.grad), 1.0, atol=1e-6)
+
+    # low-bit: 4-bit has 15 distinct levels max
+    out4 = Q.fake_quant(pt.to_tensor(np.linspace(-1, 1, 64).astype("f4")),
+                        1.0, bits=4)
+    assert len(np.unique(out4.numpy())) <= 15
+
+
+def test_quant_aware_wraps_and_trains():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = Q.quant_aware(model)
+    kinds = [type(m).__name__ for m in model.sublayers()]
+    assert kinds.count("QuantedLinear") == 2
+    o = opt.Adam(learning_rate=0.01, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.rand(32, 8).astype("f4"))
+    y = pt.to_tensor((rng.rand(32, 1) * 2).astype("f4"))
+    losses = []
+    for _ in range(30):
+        loss = ((model(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5
+    # observer accumulated a scale
+    for m in model.sublayers():
+        if isinstance(m, Q.QuantedLinear):
+            assert float(m.act_scale.numpy()) > 0
+
+
+def test_convert_int8_storage_and_accuracy():
+    pt.seed(1)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    rng = np.random.RandomState(1)
+    x = pt.to_tensor(rng.rand(8, 16).astype("f4"))
+    model.eval()
+    ref = model(x).numpy()
+    qmodel = Q.convert(model)
+    kinds = [type(m).__name__ for m in qmodel.sublayers()]
+    assert kinds.count("QuantizedLinear") == 2
+    for m in qmodel.sublayers():
+        if isinstance(m, Q.QuantizedLinear):
+            assert str(m.qweight.numpy().dtype) == "int8"
+    got = qmodel(x).numpy()
+    # int8 per-channel quantization keeps outputs close
+    denom = np.maximum(np.abs(ref), 1e-2)
+    assert np.median(np.abs(got - ref) / denom) < 0.05
+
+
+def test_quant_post_static_calibrates():
+    pt.seed(2)
+    model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+    rng = np.random.RandomState(2)
+    batches = [pt.to_tensor(rng.rand(16, 8).astype("f4"))
+               for _ in range(4)]
+    ref = model(batches[0]).numpy()
+    qmodel = Q.quant_post_static(model, batches)
+    got = qmodel(batches[0]).numpy()
+    assert np.abs(got - ref).max() < 0.2
+
+
+def test_quant_aware_trains_under_jit():
+    """Regression (review r3): QAT under jit.to_static — the observer
+    must advance as threaded buffer state and the scale select must be
+    traced, not host-evaluated (a zero scale used to collapse activations
+    to ±1e-8 under tracing)."""
+    from paddle_tpu import jit
+    pt.seed(4)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    model = Q.quant_aware(model)
+    o = opt.Adam(learning_rate=0.01, parameters=model.parameters())
+    rng = np.random.RandomState(4)
+    x = pt.to_tensor(rng.rand(32, 8).astype("f4"))
+    y = pt.to_tensor((rng.rand(32, 1) * 2).astype("f4"))
+
+    def step(xb, yb):
+        loss = ((model(xb) - yb) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    fn = jit.to_static(step, models=[model], optimizers=[o])
+    losses = [float(fn(x, y).numpy()) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.5, losses[::10]
+    for m in model.sublayers():
+        if isinstance(m, Q.QuantedLinear):
+            assert float(m.act_scale.numpy()) > 0.01
+
+
+def test_quanted_conv2d():
+    pt.seed(3)
+    m = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+    m = Q.quant_aware(m)
+    assert any(isinstance(s, Q.QuantedConv2D) for s in m.sublayers())
+    x = pt.to_tensor(np.random.rand(2, 3, 8, 8).astype("f4"))
+    out = m(x)
+    assert out.shape == [2, 8, 8, 8]
+    qm = Q.convert(m)
+    out2 = qm(x)
+    assert out2.shape == [2, 8, 8, 8]
