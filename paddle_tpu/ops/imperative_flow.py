@@ -42,8 +42,8 @@ from jax import lax
 from ..tensor import Tensor, as_tensor
 from ..dispatch import apply
 
-__all__ = ["IfElse", "Switch", "DynamicRNN", "TensorArray", "create_array",
-           "array_write", "array_read", "array_length"]
+__all__ = ["IfElse", "Switch", "While", "DynamicRNN", "TensorArray",
+           "create_array", "array_write", "array_read", "array_length"]
 
 
 # ---------------------------------------------------------------------------
@@ -246,6 +246,66 @@ class Switch:
         sw = Switch.active()
         return sw is not None and (sw._current_cond is not None or
                                    sw._in_default)
+
+
+# ---------------------------------------------------------------------------
+# While (block-style)
+
+class While:
+    """Block-style while (reference control_flow.py:While). The reference
+    records the block into a sub-program consumed by the C++ while op; the
+    eager redesign runs the block as a plain python loop over a CONCRETE
+    condition variable that block code updates in place (assign/set_value)
+    — the pattern every fluid While example uses. For compiled
+    data-dependent loops use ops.while_loop / the AST to_static pass."""
+
+    def __init__(self, cond, is_test=False, name=None):
+        self.cond = as_tensor(cond)
+        self._body = None
+
+    @contextlib.contextmanager
+    def block(self):
+        recorded = []
+        token = _WhileRecorder(recorded)
+        _while_stack.append(token)
+        try:
+            yield
+        finally:
+            _while_stack.pop()
+        import numpy as _np
+        import jax as _jax
+
+        def concrete(c):
+            return bool(_np.asarray(_jax.device_get(c.data)).item())
+
+        # strict contract: the body MUST go through While.record — raw
+        # statements in the with-block would have executed once already
+        # (python `with` semantics), which breaks the cond-initially-
+        # False case; enforcing record keeps semantics exact.
+        if not recorded:
+            raise ValueError(
+                "While.block: register the loop body with "
+                "While.record(fn) inside the block (raw statements in "
+                "the block run once regardless of the condition), or "
+                "use ops.while_loop / the AST to_static pass")
+        while concrete(self.cond):
+            for fn in recorded:
+                fn()
+
+    @staticmethod
+    def record(fn):
+        """Register the loop body callable (executed while cond holds)."""
+        if _while_stack:
+            _while_stack[-1].recorded.append(fn)
+        return fn
+
+
+class _WhileRecorder:
+    def __init__(self, recorded):
+        self.recorded = recorded
+
+
+_while_stack = []
 
 
 # ---------------------------------------------------------------------------

@@ -193,33 +193,49 @@ def _freeze_weight(w, ch_axis, bits):
 class QuantizedLinear(Layer):
     """Frozen int8 linear (reference: QuantizationFreezePass output —
     int8 weight + per-channel scale). Weight ships int8; the matmul
-    dequantizes into the activation dtype for the MXU."""
+    dequantizes into the activation dtype for the MXU. A calibrated
+    activation scale (from the QAT/PTQ observer) fake-quantizes inputs."""
 
-    def __init__(self, inner, bits=8):
+    def __init__(self, inner, bits=8, act_scale=None, act_bits=8):
         super().__init__()
         q, scale = _freeze_weight(inner.weight, 1, bits)
         self.register_buffer("qweight", Tensor(q), persistable=True)
         self.register_buffer("wscale", Tensor(scale), persistable=True)
+        self.register_buffer("act_scale", Tensor(jnp.asarray(
+            0.0 if act_scale is None else float(np.asarray(
+                jax.device_get(act_scale.data if isinstance(
+                    act_scale, Tensor) else act_scale))), jnp.float32)),
+            persistable=True)
+        self._act_bits = act_bits
         self.bias = inner.bias
 
     def forward(self, x):
-        def impl(x, q, s, *b):
+        a_bits = self._act_bits
+
+        def impl(x, q, s, ascale, *b):
+            x = jnp.where(ascale > 0.0, _qdq(x, ascale, a_bits), x)
             w = q.astype(x.dtype) * s.astype(x.dtype)
             out = x @ w
             if b:
                 out = out + b[0]
             return out
 
-        args = (x, self.qweight, self.wscale)
+        args = (x, self.qweight, self.wscale, self.act_scale)
         if self.bias is not None:
             args = args + (self.bias,)
         return apply(impl, args, name="quantized_linear")
 
 
 class QuantizedConv2D(Layer):
-    def __init__(self, inner, bits=8):
+    def __init__(self, inner, bits=8, act_scale=None, act_bits=8):
         super().__init__()
         q, scale = _freeze_weight(inner.weight, 0, bits)
+        self.register_buffer("act_scale", Tensor(jnp.asarray(
+            0.0 if act_scale is None else float(np.asarray(
+                jax.device_get(act_scale.data if isinstance(
+                    act_scale, Tensor) else act_scale))), jnp.float32)),
+            persistable=True)
+        self._act_bits = act_bits
         self.register_buffer("qweight", Tensor(q), persistable=True)
         self.register_buffer("wscale", Tensor(scale), persistable=True)
         self.bias = inner.bias
@@ -227,6 +243,9 @@ class QuantizedConv2D(Layer):
 
     def forward(self, x):
         from .ops import nn_ops as F
+        a_bits = self._act_bits
+        x = apply(lambda x, a: jnp.where(a > 0.0, _qdq(x, a, a_bits), x),
+                  (x, self.act_scale), name="act_quant")
         w = apply(lambda q, s: q.astype(jnp.float32) * s,
                   (self.qweight, self.wscale), nondiff=True,
                   name="dequant_w")
@@ -235,13 +254,18 @@ class QuantizedConv2D(Layer):
 
 def convert(model, bits=8):
     """Freeze a quant_aware (or plain) model for int8 inference
-    (reference: QuantizationFreezePass + convert)."""
+    (reference: QuantizationFreezePass + convert). Calibrated observer
+    scales from QAT/PTQ carry into the frozen layers' act_scale."""
     def _conv(layer):
         for name, child in list(layer._sub_layers.items()):
             if isinstance(child, QuantedLinear):
-                layer._sub_layers[name] = QuantizedLinear(child.inner, bits)
+                layer._sub_layers[name] = QuantizedLinear(
+                    child.inner, bits, act_scale=child.act_scale,
+                    act_bits=child._cfg.activation_bits)
             elif isinstance(child, QuantedConv2D):
-                layer._sub_layers[name] = QuantizedConv2D(child.inner, bits)
+                layer._sub_layers[name] = QuantizedConv2D(
+                    child.inner, bits, act_scale=child.act_scale,
+                    act_bits=child._cfg.activation_bits)
             elif isinstance(child, nn.Linear):
                 layer._sub_layers[name] = QuantizedLinear(child, bits)
             elif isinstance(child, nn.Conv2D):

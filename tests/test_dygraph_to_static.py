@@ -151,6 +151,20 @@ class TestEagerEquivalence:
 
 
 class TestTranslatorSwitch:
+    def test_toggle_applies_at_call_time(self):
+        """Regression (review r3): flipping the translator after the
+        StaticFunction exists changes behavior (reference semantics)."""
+        import jax
+        fn = jit.to_static(collatz_steps)
+        ProgramTranslator().enable(False)
+        try:
+            with pytest.raises(jax.errors.TracerBoolConversionError):
+                fn(pt.to_tensor(np.float32(8.0)))
+        finally:
+            ProgramTranslator().enable(True)
+        out = fn(pt.to_tensor(np.float32(8.0)))
+        assert float(out.numpy()) == 3.0
+
     def test_singleton_and_enable(self):
         a = ProgramTranslator()
         b = ProgramTranslator.get_instance()
