@@ -127,6 +127,13 @@ def backward(root: Tensor, grad_tensor=None, retain_graph=False, _only=None):
     cotangents = {id(root): seed}
     holders = {id(root): root}
 
+    def _clip_err(t, ct):
+        # reference ErrorClipByValue (fluid/clip.py): a per-var clip on
+        # the INCOMING error signal — affects both the stored .grad and
+        # everything propagated further upstream
+        eclip = getattr(t, "error_clip", None)
+        return ct if eclip is None else eclip(ct)
+
     def _accumulate_grad(t, ct):
         if t.stop_gradient or (_only is not None and id(t) not in _only):
             return
@@ -141,6 +148,7 @@ def backward(root: Tensor, grad_tensor=None, retain_graph=False, _only=None):
             if ct is None:
                 ct = _zero_cotangent(o.data)
             else:
+                ct = _clip_err(o, ct)
                 any_ct = True
                 _accumulate_grad(o, ct)
             outs_ct.append(ct)
@@ -168,7 +176,7 @@ def backward(root: Tensor, grad_tensor=None, retain_graph=False, _only=None):
 
     # Whatever is left in the accumulator belongs to leaf tensors.
     for key, ct in cotangents.items():
-        _accumulate_grad(holders[key], ct)
+        _accumulate_grad(holders[key], _clip_err(holders[key], ct))
 
     if not retain_graph:
         for node in nodes:

@@ -75,3 +75,38 @@ def clip_grad_norm_(parameters, max_norm):
     clipped = ClipGradByGlobalNorm(max_norm)(grads)
     for (p, _), (_, g) in zip(grads, clipped):
         p._grad = g
+
+
+class ErrorClipByValue:
+    """reference: fluid/clip.py ErrorClipByValue — clips the ERROR
+    (gradient of a specific var) during backward. Attach via
+    `var.error_clip = ErrorClipByValue(max=...)`; the tape applies it to
+    that tensor's incoming gradient."""
+
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def __call__(self, grad):
+        return jnp.clip(grad, self.min, self.max)
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """reference: fluid/clip.py set_gradient_clip. With param_list, the
+    strategy attaches to those parameters only (the optimizer applies it
+    per-param before its own clip); otherwise it becomes the global
+    default every optimizer without an explicit grad_clip uses."""
+    if param_list:
+        for p in param_list:
+            p.grad_clip = clip
+        return clip
+    global _global_grad_clip
+    _global_grad_clip = clip
+    return clip
+
+
+_global_grad_clip = None
+
+
+def get_gradient_clip():
+    return _global_grad_clip

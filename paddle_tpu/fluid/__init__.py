@@ -19,7 +19,11 @@ from ..device import CPUPlace, CUDAPlace, TPUPlace
 from ..param_attr import ParamAttr, WeightNormParamAttr
 from .. import initializer
 from .. import regularizer
-from .. import clip
+# fluid.clip must be the MODULE; `from .. import clip` would resolve the
+# package attribute, which paddle_tpu/__init__ rebinds to the clip
+# FUNCTION (paddle.clip parity) after importing the module.
+from importlib import import_module as _import_module
+clip = _import_module(".clip", __package__.rsplit(".", 1)[0])
 from .. import optimizer
 from .. import metric as metrics
 from .. import io
@@ -28,6 +32,11 @@ from ..static import enable_static, disable_static
 from . import layers
 from . import dygraph
 from . import nets
+from . import contrib
+from . import transpiler
+from .transpiler import (DistributeTranspiler,  # noqa: F401
+                         DistributeTranspilerConfig, memory_optimize,
+                         release_memory)
 from .data_feeder import DataFeeder, PyReader
 
 

@@ -1,0 +1,79 @@
+"""fluid.contrib facade — mixed precision + slim quantization.
+
+Rebuild of the reference contrib surface the book/benchmarks use
+(reference: python/paddle/fluid/contrib/mixed_precision/decorator.py
+:decorate, fp16_lists.py AutoMixedPrecisionLists; contrib/slim/
+quantization → paddle_tpu.quantization). The heavy machinery lives in
+paddle_tpu.amp / paddle_tpu.quantization; these names make ported fluid
+code resolve.
+"""
+from __future__ import annotations
+
+import types
+
+from .. import amp as _amp
+from .. import quantization as _quantization
+
+
+class AutoMixedPrecisionLists:
+    """reference: fp16_lists.py — white/black op lists. The bf16 policy
+    in paddle_tpu.amp white-lists matmul/conv by construction; these
+    lists are carried for API parity and future policy overrides."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None):
+        self.white_list = set(custom_white_list or [])
+        self.black_list = set(custom_black_list or [])
+        self.black_varnames = set(custom_black_varnames or [])
+
+
+class _DecoratedOptimizer:
+    """reference: decorator.py:OptimizerWithMixedPrecision — wraps an
+    optimizer so minimize() runs under auto_cast with loss scaling."""
+
+    def __init__(self, optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+                 use_dynamic_loss_scaling=True, **kw):
+        self._opt = optimizer
+        self._scaler = _amp.GradScaler(
+            enable=use_dynamic_loss_scaling,
+            init_loss_scaling=init_loss_scaling)
+        self.amp_lists = amp_lists
+
+    def __getattr__(self, item):
+        return getattr(self._opt, item)
+
+    def backward(self, loss, **kw):
+        if self._scaler is not None:
+            loss = self._scaler.scale(loss)
+        loss.backward()
+        return []
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._scaler is not None:
+            scaled = self._scaler.scale(loss)
+            scaled.backward()
+            self._scaler.step(self._opt)
+            self._scaler.update()
+            self._opt.clear_grad()
+            return [], []
+        return self._opt.minimize(loss)
+
+
+def decorate(optimizer, amp_lists=None, init_loss_scaling=2 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8,
+             use_dynamic_loss_scaling=True):
+    """reference: mixed_precision/decorator.py:decorate."""
+    return _DecoratedOptimizer(
+        optimizer, amp_lists, init_loss_scaling=init_loss_scaling,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+
+mixed_precision = types.SimpleNamespace(
+    decorate=decorate,
+    AutoMixedPrecisionLists=AutoMixedPrecisionLists,
+)
+
+slim = types.SimpleNamespace(quantization=_quantization)
+quantize = _quantization

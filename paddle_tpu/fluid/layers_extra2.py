@@ -930,30 +930,30 @@ def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
                  name="generate_mask_labels")
 
 
-def detection_map(detect_res, label, class_num, background_label=0,
-                  overlap_threshold=0.5, evaluate_difficult=True,
-                  has_state=None, input_states=None, out_states=None,
-                  ap_version="integral"):
-    """reference: detection.py:1125 — mean average precision of detection
-    results vs labeled boxes (host-side accumulation like the metric it
-    is)."""
-    det = np.asarray(jax.device_get(as_tensor(detect_res).data))
-    lab = np.asarray(jax.device_get(as_tensor(label).data))
-    if det.ndim == 2:
-        det, lab = det[None], lab[None]
+def _map_eval(det_images, lab_images, class_num, background_label=0,
+              overlap_threshold=0.5, evaluate_difficult=True,
+              ap_version="integral"):
+    """mAP over lists of per-image (det (M,6), label (G,5|6)) numpy
+    arrays; with a 6th label column, column 5 is the difficult flag and
+    evaluate_difficult=False excludes those ground truths (VOC-style).
+    Shared by detection_map and the accumulating metric.DetectionMAP."""
     aps = []
     for cls in range(class_num):
         if cls == background_label:
             continue
         scores, tps = [], []
         npos = 0
-        for b in range(det.shape[0]):
-            gt = lab[b][lab[b][:, 0] == cls][:, 1:5]
-            npos += len(gt)
-            dd = det[b][det[b][:, 0] == cls]
+        for det_b, lab_b in zip(det_images, lab_images):
+            rows = lab_b[lab_b[:, 0] == cls]
+            gt = rows[:, 1:5]
+            diff = (rows[:, 5] > 0.5) if rows.shape[1] > 5 else \
+                np.zeros(len(rows), bool)
+            if evaluate_difficult:
+                diff = np.zeros(len(rows), bool)
+            npos += int((~diff).sum())
+            dd = det_b[det_b[:, 0] == cls]
             used = np.zeros(len(gt), bool)
             for row in dd[np.argsort(-dd[:, 1])]:
-                scores.append(row[1])
                 box = row[2:6]
                 best, bi = 0.0, -1
                 for gi, gbox in enumerate(gt):
@@ -966,12 +966,19 @@ def detection_map(detect_res, label, class_num, background_label=0,
                     v = inter / ua if ua > 0 else 0.0
                     if v > best:
                         best, bi = v, gi
-                if best >= overlap_threshold and bi >= 0 and not used[bi]:
-                    tps.append(1.0)
+                if best >= overlap_threshold and bi >= 0:
+                    if diff[bi]:
+                        continue  # difficult gt: neither TP nor FP
+                    scores.append(row[1])
+                    tps.append(0.0 if used[bi] else 1.0)
                     used[bi] = True
                 else:
+                    scores.append(row[1])
                     tps.append(0.0)
-        if npos == 0 or not tps:
+        if npos == 0:
+            continue  # class absent from ground truth: no AP term
+        if not tps:
+            aps.append(0.0)  # gts exist but nothing was detected
             continue
         order = np.argsort(-np.asarray(scores))
         tp = np.asarray(tps)[order]
@@ -980,13 +987,32 @@ def detection_map(detect_res, label, class_num, background_label=0,
         fp_c = np.cumsum(fp)
         rec = tp_c / npos
         prec = tp_c / np.maximum(tp_c + fp_c, 1e-8)
-        ap = 0.0
-        for i in range(len(rec)):
-            dr = rec[i] - (rec[i - 1] if i else 0.0)
-            ap += dr * prec[i]
+        if ap_version == "11point":
+            ap = float(np.mean([prec[rec >= t].max() if (rec >= t).any()
+                                else 0.0
+                                for t in np.linspace(0, 1, 11)]))
+        else:
+            ap = 0.0
+            for i in range(len(rec)):
+                dr = rec[i] - (rec[i - 1] if i else 0.0)
+                ap += dr * prec[i]
         aps.append(ap)
-    return Tensor(jnp.asarray(float(np.mean(aps)) if aps else 0.0,
-                              jnp.float32))
+    return float(np.mean(aps)) if aps else 0.0
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.5, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """reference: detection.py:1125 — mean average precision of detection
+    results vs labeled boxes (host-side, like the metric it is)."""
+    det = np.asarray(jax.device_get(as_tensor(detect_res).data))
+    lab = np.asarray(jax.device_get(as_tensor(label).data))
+    if det.ndim == 2:
+        det, lab = det[None], lab[None]
+    m = _map_eval(list(det), list(lab), class_num, background_label,
+                  overlap_threshold, evaluate_difficult, ap_version)
+    return Tensor(jnp.asarray(m, jnp.float32))
 
 
 def roi_perspective_transform(input, rois, transformed_height,

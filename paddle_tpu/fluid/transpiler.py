@@ -1,0 +1,112 @@
+"""fluid.transpiler facade.
+
+The reference transpiler rewrites a Program for parameter-server /
+multi-device training (reference: python/paddle/fluid/transpiler/
+distribute_transpiler.py DistributeTranspiler, memory_optimization_
+transpiler.py memory_optimize/release_memory). On TPU:
+
+* PS-mode training is redesigned as sharded-embedding data parallelism
+  (SURVEY §2 row 22) — `paddle_tpu.parallel.fleet` + `parallel.embedding`
+  replace the trainer/pserver split, so DistributeTranspiler here
+  validates its config and points each role at the collective path.
+* memory_optimize / release_memory are no-ops: buffer reuse is XLA's
+  arena + donated inputs (static/__init__.py donate_argnums), which
+  already subsumes the reference's variable-reuse pass.
+"""
+from __future__ import annotations
+
+from ..utils.log import get_logger
+
+_log = get_logger("paddle_tpu.transpiler")
+
+
+class DistributeTranspilerConfig:
+    """reference: distribute_transpiler.py:DistributeTranspilerConfig."""
+
+    def __init__(self):
+        self.slice_var_up = True
+        self.split_method = None
+        self.min_block_size = 8192
+        self.sync_mode = True
+        self.mode = "collective"
+
+
+class DistributeTranspiler:
+    """reference: distribute_transpiler.py:DistributeTranspiler. The
+    trainer/pserver Program split has no TPU analogue — collectives ride
+    ICI inside one compiled step — so transpile() records the config and
+    the programs pass through unchanged; use parallel.fleet for real
+    multi-device placement."""
+
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self._role = None
+
+    def transpile(self, trainer_id, program=None, pservers="", trainers=1,
+                  sync_mode=True, startup_program=None,
+                  current_endpoint=""):
+        self.trainer_id = trainer_id
+        self.trainers = trainers
+        self._program = program
+        _log.info(
+            "DistributeTranspiler: PS graph-split is replaced by the "
+            "collective fleet path on TPU (parallel.fleet); programs "
+            "pass through unchanged")
+
+    def get_trainer_program(self, wait_port=True):
+        from ..static import default_main_program
+        return self._program or default_main_program()
+
+    def get_pserver_program(self, endpoint):
+        raise RuntimeError(
+            "TPU rebuild has no parameter servers: embeddings shard over "
+            "the mesh (parallel.embedding) and updates all-reduce over "
+            "ICI. Launch every process as a worker via "
+            "paddle_tpu.distributed.launch")
+
+    def get_pserver_programs(self, endpoint):
+        return self.get_pserver_program(endpoint)
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        from ..static import default_startup_program
+        return startup_program or default_startup_program()
+
+
+def memory_optimize(input_program=None, skip_opt_set=None,
+                    print_log=False, level=0, skip_grads=True):
+    """reference: memory_optimization_transpiler.py:memory_optimize —
+    XLA's buffer assignment + donated params already reuse memory; no-op
+    (the reference itself deprecated this pass)."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    """reference: release_memory — same rationale as memory_optimize."""
+    return None
+
+
+class HashName:
+    """reference: ps_dispatcher.py:HashName (kept for config parity)."""
+
+    def __init__(self, pserver_endpoints):
+        self.pserver_endpoints = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        eps = self.pserver_endpoints
+        return [eps[abs(hash(v.name)) % len(eps)] for v in varlist]
+
+
+class RoundRobin:
+    """reference: ps_dispatcher.py:RoundRobin."""
+
+    def __init__(self, pserver_endpoints):
+        self.pserver_endpoints = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self.pserver_endpoints[self._i])
+            self._i = (self._i + 1) % len(self.pserver_endpoints)
+        return out

@@ -170,3 +170,20 @@ def _resolve(init, default=None):
     if isinstance(init, (int, float)):
         return Constant(float(init))
     raise TypeError(f"cannot interpret initializer: {init!r}")
+
+# fluid-era aliases (reference: initializer.py __all__). The reference
+# classes take uniform= selecting the uniform/normal variant (default
+# True); these factories dispatch accordingly.
+def Xavier(uniform=True, fan_in=None, fan_out=None, seed=0, gain=1.0):
+    """reference: XavierInitializer(uniform=True, fan_in, fan_out)."""
+    cls = XavierUniform if uniform else XavierNormal
+    return cls(fan_in=fan_in, fan_out=fan_out, gain=gain)
+
+
+def MSRA(uniform=True, fan_in=None, seed=0):
+    """reference: MSRAInitializer(uniform=True, fan_in)."""
+    cls = KaimingUniform if uniform else KaimingNormal
+    return cls(fan_in=fan_in)
+
+
+BilinearInitializer = Bilinear

@@ -262,3 +262,48 @@ def accuracy(input, label, k=1):
     top = np.argsort(-pred, axis=-1)[..., :k]
     correct = (top == label[:, None]).any(axis=-1)
     return Tensor(np.asarray(correct.mean(), np.float32))
+
+
+class DetectionMAP(Metric):
+    """reference: metrics.py:DetectionMAP — accumulating detection mAP.
+    update() banks per-image detections/labels; accumulate() computes ONE
+    global-dataset mAP over everything banked (matching the reference's
+    threaded pos_count/true_pos/false_pos accumulation)."""
+
+    def __init__(self, input=None, gt_label=None, gt_box=None,
+                 gt_difficult=None, class_num=None,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral",
+                 name=None):
+        super().__init__(name)
+        self.class_num = class_num
+        self.background_label = background_label
+        self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = []
+        self._labs = []
+
+    def update(self, detect_res, label):
+        det = np.asarray(jax.device_get(
+            detect_res.data if isinstance(detect_res, Tensor)
+            else detect_res))
+        lab = np.asarray(jax.device_get(
+            label.data if isinstance(label, Tensor) else label))
+        if det.ndim == 2:
+            det, lab = det[None], lab[None]
+        self._dets.extend(list(det))
+        self._labs.extend(list(lab))
+        return None  # bank only; mAP computed once in accumulate()
+
+    def accumulate(self):
+        from .fluid.layers_extra2 import _map_eval
+        return _map_eval(self._dets, self._labs, self.class_num,
+                         self.background_label, self.overlap_threshold,
+                         self.evaluate_difficult, self.ap_version)
+
+    get_map_var = update
+    cur_map = accumulate

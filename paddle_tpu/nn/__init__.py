@@ -25,3 +25,4 @@ from .rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, LSTM,
 from . import functional
 from . import functional as F
 from .layers import NCE
+from .layers import Conv3DTranspose, InstanceNorm, TreeConv

@@ -583,3 +583,112 @@ class NCE(Layer):
 
         return apply(impl, (input, label, self.weight, self.bias, key),
                      name="nce")
+
+
+InstanceNorm = InstanceNorm2D  # fluid dygraph name (reference dygraph/nn.py)
+
+
+class Conv3DTranspose(Layer):
+    """reference: dygraph/nn.py:Conv3DTranspose → the lhs-dilated conv
+    formulation (fluid.layers_extra.conv3d_transpose math)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        ks = F._pair(kernel_size, 3)
+        self._cfg = dict(stride=F._pair(stride, 3),
+                         padding=F._pair(padding, 3),
+                         dilation=F._pair(dilation, 3), groups=groups)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + ks, attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        from ..dispatch import apply as _apply
+        import jax.numpy as jnp
+        from jax import lax
+        st, pd, dl = (self._cfg["stride"], self._cfg["padding"],
+                      self._cfg["dilation"])
+        groups = self._cfg["groups"]
+
+        def impl(x, w, *maybe_b):
+            kdims = w.shape[2:]
+            pads = [(dl[i] * (kdims[i] - 1) - pd[i],
+                     dl[i] * (kdims[i] - 1) - pd[i]) for i in range(3)]
+            wf = jnp.flip(w, axis=(2, 3, 4))
+            cin = wf.shape[0]
+            if groups > 1:
+                wf = wf.reshape(groups, cin // groups, -1, *kdims)
+                wf = jnp.moveaxis(wf, 2, 1)
+                rhs = wf.reshape(-1, cin // groups, *kdims)
+            else:
+                rhs = jnp.moveaxis(wf, 1, 0)
+            out = lax.conv_general_dilated(
+                x, rhs, window_strides=(1, 1, 1), padding=pads,
+                lhs_dilation=st, rhs_dilation=dl,
+                feature_group_count=groups,
+                dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+            if maybe_b:
+                out = out + maybe_b[0].reshape(1, -1, 1, 1, 1)
+            return out
+
+        args = (x, self.weight)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return _apply(impl, args, name="conv3d_transpose")
+
+
+class TreeConv(Layer):
+    """reference: dygraph/nn.py:TreeConv (tree-based convolution,
+    TBCNN). nodes_vector (B, N, D) + edge_set (B, E, 2) parent→child
+    edges; each node convolves over its (parent, self, children)
+    neighborhood via three weight matrices — the adjacency-matmul
+    formulation (dense, MXU-friendly) of the reference's gather kernel."""
+
+    def __init__(self, feature_size, output_size, num_filters=1,
+                 max_depth=8, act="tanh", param_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self._act = act
+        self.num_filters = num_filters
+        self.output_size = output_size
+        # three role matrices: self / parent-side / child-side
+        self.weight = self.create_parameter(
+            (3, feature_size, output_size * num_filters), attr=param_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (output_size * num_filters,), attr=bias_attr, is_bias=True)
+
+    def forward(self, nodes_vector, edge_set):
+        from ..dispatch import apply as _apply
+        import jax.numpy as jnp
+        act = self._act
+
+        def impl(x, edges, w, *b):
+            bsz, n, d = x.shape
+            par = edges[..., 0].astype(jnp.int32)
+            chi = edges[..., 1].astype(jnp.int32)
+            adj = jnp.zeros((bsz, n, n), x.dtype)
+            bidx = jnp.arange(bsz)[:, None]
+            down = adj.at[bidx, par, chi].set(1.0)   # parent → child
+            up = adj.at[bidx, chi, par].set(1.0)     # child → parent
+            self_t = jnp.einsum("bnd,do->bno", x, w[0])
+            child_t = jnp.einsum("bnm,bmd,do->bno", down, x, w[1])
+            parent_t = jnp.einsum("bnm,bmd,do->bno", up, x, w[2])
+            out = self_t + child_t + parent_t
+            if b:
+                out = out + b[0]
+            return out.reshape(bsz, n, -1, self.num_filters) \
+                if self.num_filters > 1 else out
+
+        args = (nodes_vector, edge_set, self.weight)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        out = _apply(impl, args, name="tree_conv")
+        if act:
+            out = getattr(F, act)(out) if hasattr(F, act) else \
+                getattr(ops, act)(out)
+        return out

@@ -106,9 +106,22 @@ class Optimizer:
         params_grads = [(p, p._grad) for p in self._params()
                         if not (p.stop_gradient or p._grad is None)]
         # reference order (optimizer.py:apply_gradients): clip raw grads
-        # first, then append the regularization term.
-        if self._grad_clip is not None:
-            params_grads = self._grad_clip(params_grads)
+        # first, then append the regularization term. Per-param clips
+        # (set_gradient_clip param_list) go first, then the optimizer's
+        # own clip or the fluid-global strategy.
+        per_param = []
+        for p, g in params_grads:
+            pc = getattr(p, "grad_clip", None)
+            if pc is not None and g is not None:
+                g = pc([(p, g)])[0][1]
+            per_param.append((p, g))
+        params_grads = per_param
+        grad_clip = self._grad_clip
+        if grad_clip is None:
+            from ..clip import get_gradient_clip
+            grad_clip = get_gradient_clip()
+        if grad_clip is not None:
+            params_grads = grad_clip(params_grads)
         regularized = []
         for p, g in params_grads:
             if g is None:
@@ -233,6 +246,27 @@ class Momentum(Optimizer):
         else:
             new_p = p - lr * v
         return new_p, {"velocity": v}
+
+
+class DGCMomentum(Momentum):
+    """reference: DGCMomentumOptimizer (deep gradient compression over
+    NCCL rings). On TPU the all-reduce rides ICI inside the compiled step
+    — sparsifying it would force gather/scatter HBM traffic that costs
+    more than it saves — so this keeps DGC's momentum-correction update
+    (momentum on the (virtually) compressed gradient, which for the
+    identity sparsity equals plain momentum) and accepts the DGC
+    signature for porting parity."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), parameters=None,
+                 use_nesterov=False, num_trainers=None, **kw):
+        super().__init__(learning_rate, momentum, parameters,
+                         use_nesterov, **kw)
+        self._rampup_begin_step = rampup_begin_step
+        self._sparsity = list(sparsity)
+
+
+DGCMomentumOptimizer = DGCMomentum
 
 
 class LarsMomentum(Optimizer):

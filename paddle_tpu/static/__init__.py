@@ -608,3 +608,23 @@ class ParallelExecutor:
 @contextlib.contextmanager
 def name_scope(prefix=None):
     yield
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference: backward.py:gradients — grads of targets wrt inputs.
+    Dygraph: delegates to autograd.grad; static mode: gradients are
+    produced inside Executor.run via jax.grad over the interpreter, so
+    this marks the loss exactly like append_backward."""
+    from .. import dispatch
+    if not dispatch.in_static_mode():
+        from ..autograd import grad as _grad
+        t = targets if isinstance(targets, (list, tuple)) else [targets]
+        i = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        tg = target_gradients
+        if tg is not None and not isinstance(tg, (list, tuple)):
+            tg = [tg]
+        out = _grad(t, i, grad_outputs=tg)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+    append_backward(targets if not isinstance(targets, (list, tuple))
+                    else targets[0])
+    return []

@@ -73,7 +73,8 @@ class Tensor:
     """
 
     __slots__ = ("data", "stop_gradient", "_grad", "_tape_node", "name",
-                 "persistable", "_graph_freed", "__weakref__")
+                 "persistable", "_graph_freed", "error_clip", "grad_clip",
+                 "__weakref__")
 
     def __init__(self, data, stop_gradient=True, name=None, dtype=None):
         if isinstance(data, Tensor):

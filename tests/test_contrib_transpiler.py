@@ -1,0 +1,65 @@
+"""fluid.contrib (mixed precision, slim) + fluid.transpiler facades
+(reference: contrib/mixed_precision/decorator.py, transpiler/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import fluid, nn, optimizer
+
+
+def test_mixed_precision_decorate_trains():
+    pt.seed(0)
+    m = nn.Linear(4, 1)
+    o = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    dec = fluid.contrib.mixed_precision.decorate(
+        o, init_loss_scaling=2.0 ** 10)
+    x = pt.to_tensor(np.random.RandomState(0).rand(8, 4).astype("f4"))
+    y = pt.to_tensor(np.random.RandomState(1).rand(8, 1).astype("f4"))
+    losses = []
+    for _ in range(10):
+        loss = ((m(x) - y) ** 2).mean()
+        losses.append(float(loss.numpy()))
+        dec.minimize(loss)
+    assert losses[-1] < losses[0]
+    # wrapped attributes delegate
+    assert dec._parameter_list is o._parameter_list
+
+
+def test_amp_lists_parity():
+    lists = fluid.contrib.mixed_precision.AutoMixedPrecisionLists(
+        custom_white_list={"matmul"}, custom_black_list={"softmax"})
+    assert "matmul" in lists.white_list
+
+
+def test_slim_quantization_alias():
+    from paddle_tpu import quantization
+    assert fluid.contrib.slim.quantization is quantization
+    assert fluid.contrib.quantize is quantization
+
+
+def test_distribute_transpiler_roles():
+    t = fluid.DistributeTranspiler(fluid.DistributeTranspilerConfig())
+    t.transpile(trainer_id=0, trainers=4)
+    assert t.get_trainer_program() is not None
+    with pytest.raises(RuntimeError, match="parameter server"):
+        t.get_pserver_program("127.0.0.1:6174")
+
+
+def test_memory_optimize_noop():
+    assert fluid.memory_optimize() is None
+    assert fluid.release_memory(None) is None
+
+
+def test_ps_dispatchers():
+    from paddle_tpu.fluid.transpiler import HashName, RoundRobin
+
+    class V:
+        def __init__(self, name):
+            self.name = name
+
+    eps = ["a:1", "b:2"]
+    rr = RoundRobin(eps)
+    out = rr.dispatch([V("x"), V("y"), V("z")])
+    assert out == ["a:1", "b:2", "a:1"]
+    hn = HashName(eps)
+    assert all(e in eps for e in hn.dispatch([V("x"), V("y")]))
