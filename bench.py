@@ -15,13 +15,19 @@ BERT_BASELINE_TOKENS_S = 25000.0   # Paddle V100 BERT-base seq128 approx
 RESNET_BASELINE_IMG_S = 360.0      # Paddle V100 fp32 ResNet-50 approx
 
 
-def _flash_ok():
-    """Probe the Pallas flash kernel fwd+bwd on the live device so a
-    kernel-compile failure degrades the bench to sdpa instead of zeroing
-    it."""
-    try:
-        import jax
-        import jax.numpy as jnp
+def _probe_pallas_kernels():
+    """Probe each Pallas kernel fwd+bwd on the live device and disable
+    (pallas.configure) just the ones that fail, so one kernel-compile
+    failure degrades that kernel to its XLA path instead of zeroing the
+    whole bench."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas as P
+
+    if not P.on_tpu():
+        return  # kernels default off; interpret-mode probes prove nothing
+
+    def flash():
         from paddle_tpu.ops.pallas.flash_attention import _flash
         q = jnp.ones((1, 2, 128, 64), jnp.bfloat16)
         seed = jnp.zeros((2,), jnp.int32)
@@ -31,11 +37,45 @@ def _flash_ok():
                           512, 0.1).astype(jnp.float32).sum()
 
         jax.grad(f)(q).block_until_ready()
-        return True
-    except Exception as e:  # pragma: no cover
-        print(f"flash probe failed ({type(e).__name__}); sdpa fallback",
-              flush=True)
-        return False
+
+    def layer_norm():
+        from paddle_tpu.ops.pallas.layer_norm import _layer_norm2
+        x = jnp.ones((256, 768), jnp.bfloat16)
+        w = jnp.ones((768,), jnp.float32)
+        b = jnp.zeros((768,), jnp.float32)
+
+        def f(x):
+            return _layer_norm2(x, w, b, 1e-12).astype(jnp.float32).sum()
+
+        jax.grad(f)(x).block_until_ready()
+
+    def fused_adam():
+        from paddle_tpu.ops.pallas.fused_adam import fused_adam_update
+        p = jnp.ones((2048, 768), jnp.float32)
+        new_p, _, _ = fused_adam_update(p, p * 0.01, p * 0, p * 0, 1e-3,
+                                        0.9, 0.999)
+        new_p.block_until_ready()
+
+    def softmax_xent():
+        from paddle_tpu.ops.pallas.softmax_xent import _softmax_xent2
+        x = jnp.ones((256, 30522), jnp.float32)
+        lab = jnp.zeros((256, 1), jnp.int32)
+
+        def f(x):
+            return _softmax_xent2(x, lab).sum()
+
+        jax.grad(f)(x).block_until_ready()
+
+    for name, probe in (("flash_attention", flash),
+                        ("layer_norm", layer_norm),
+                        ("fused_adam", fused_adam),
+                        ("softmax_xent", softmax_xent)):
+        try:
+            probe()
+        except Exception as e:  # pragma: no cover
+            print(f"pallas {name} probe failed ({type(e).__name__}); "
+                  f"XLA fallback", flush=True)
+            P.configure(**{name: False})
 
 
 def bench_bert(batch=32, seq=128, steps=20):
@@ -44,7 +84,7 @@ def bench_bert(batch=32, seq=128, steps=20):
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
 
     pt.seed(0)
-    cfg = BertConfig.base(use_flash_attention=_flash_ok())
+    cfg = BertConfig.base()
     model = BertForPretraining(cfg)
     o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
 
@@ -117,7 +157,13 @@ def bench_resnet(batch=128, steps=10):
 def bench_resnet_pipeline(batch=128, steps=8):
     """ResNet fed through the REAL input pipeline (io.DataLoader over the
     C++ native batcher, csrc/core.cpp) instead of one resident batch —
-    the perf evidence for the host-side arena/prefetch path."""
+    the perf evidence for the host-side arena/prefetch path.
+
+    Feeds uint8 images (like a real decoded-JPEG pipeline) and normalizes
+    on device inside the jitted step, so host→device moves 1/4 the bytes.
+    Also reports the loader-only rate (C++ shuffle+gather+prefetch), which
+    is the csrc claim proper — end-to-end additionally rides this
+    environment's tunneled H2D link."""
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt, jit, amp, io
     from paddle_tpu.models.resnet import resnet50
@@ -128,15 +174,25 @@ def bench_resnet_pipeline(batch=128, steps=8):
                      parameters=model.parameters())
     rng = np.random.RandomState(0)
     n = batch * (steps + 2)
-    x = rng.rand(n, 3, 224, 224).astype("f4")
+    x = (rng.rand(n, 3, 224, 224) * 255).astype("u1")
     y = rng.randint(0, 1000, (n,)).astype("i4")
     ds = io.TensorDataset(x, y)
     loader = io.DataLoader(ds, batch_size=batch, shuffle=True,
                            drop_last=True, use_native=True)
 
+    # loader-only rate: C++ background shuffle+assemble, no device in loop
+    for _ in loader:
+        pass  # warm epoch (thread spin-up)
+    t0 = time.perf_counter()
+    got = 0
+    for xb, _ in loader:
+        got += xb.shape[0]
+    loader_ips = got / (time.perf_counter() - t0)
+
     def step(xb, yb):
         with amp.auto_cast(dtype="bfloat16"):
-            logits = model(xb)
+            xf = (xb.astype("float32") / 255.0 - 0.45) / 0.22
+            logits = model(xf)
         loss = pt.nn.functional.cross_entropy(logits.astype("float32"), yb)
         loss.backward()
         o.step()
@@ -146,29 +202,30 @@ def bench_resnet_pipeline(batch=128, steps=8):
     fn = jit.to_static(step, models=[model], optimizers=[o])
     it = iter(loader)
     xb, yb = next(it)
-    fn(xb, yb)  # compile
+    fn(pt.to_tensor(xb), pt.to_tensor(yb))  # compile
     done = 0
     t0 = time.perf_counter()
     loss = None
     for xb, yb in it:
-        loss = fn(xb, yb)
+        loss = fn(pt.to_tensor(xb), pt.to_tensor(yb))
         done += xb.shape[0]
         if done >= batch * steps:
             break
     loss.numpy()
     dt = time.perf_counter() - t0
-    return done / dt, float(loss.numpy())
+    return done / dt, loader_ips
 
 
 def main():
+    _probe_pallas_kernels()
     bert_tps, bert_loss = bench_bert()
     rn_ips, rn_loss = bench_resnet()
     try:
-        pipe_ips, _ = bench_resnet_pipeline()
+        pipe_ips, loader_ips = bench_resnet_pipeline()
     except Exception as e:
         print(f"pipeline bench failed: {type(e).__name__}: {e}",
               flush=True)
-        pipe_ips = 0.0
+        pipe_ips, loader_ips = 0.0, 0.0
     result = {
         "metric": "bert_base_tokens/sec/chip",
         "value": round(bert_tps, 1),
@@ -177,6 +234,7 @@ def main():
         "resnet50_images_per_sec": round(rn_ips, 1),
         "resnet50_vs_baseline": round(rn_ips / RESNET_BASELINE_IMG_S, 3),
         "resnet50_pipeline_images_per_sec": round(pipe_ips, 1),
+        "loader_images_per_sec": round(loader_ips, 1),
         "bert_loss": round(bert_loss, 4),
         "resnet50_loss": round(rn_loss, 4),
     }

@@ -266,10 +266,11 @@ class LayerNorm(Layer):
             normalized_shape = (normalized_shape,)
         self._normalized_shape = tuple(normalized_shape)
         self._epsilon = epsilon
-        if use_pallas is None:  # auto: fused kernel on TPU, XLA elsewhere
-            from ..ops.pallas import on_tpu
-            use_pallas = on_tpu()
-        self._use_pallas = use_pallas and len(self._normalized_shape) == 1
+        # None = auto, resolved via pallas.enabled() when forward traces
+        # (configure() before the first jitted step; traced steps keep
+        # the choice they were compiled with)
+        self._use_pallas = use_pallas if len(self._normalized_shape) == 1 \
+            else False
         if weight_attr is False:
             self.weight = None
         else:
@@ -283,8 +284,11 @@ class LayerNorm(Layer):
                                               attr=bias_attr, is_bias=True)
 
     def forward(self, x):
-        if self._use_pallas and self.weight is not None \
-                and self.bias is not None:
+        use = self._use_pallas
+        if use is None:
+            from ..ops import pallas as P
+            use = P.enabled("layer_norm")
+        if use and self.weight is not None and self.bias is not None:
             from ..ops.pallas.layer_norm import layer_norm as pallas_ln
             return pallas_ln(x, self.weight, self.bias, self._epsilon)
         return F.layer_norm(x, self._normalized_shape, self.weight,

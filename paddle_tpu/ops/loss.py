@@ -47,12 +47,38 @@ def _picked_logp(logp, label, axis, ignore_index):
     return loss, valid
 
 
+def _fused_softmax_xent(x, label, ignore_index):
+    """Per-position loss via the Pallas fused kernel when enabled, else
+    None. The kernel scores every row ([N,V] softmax never hits HBM; an
+    ignored/OOB label matches no column → loss=lse there); masking after
+    also zeroes the cotangent into the kernel's backward at those rows.
+    Returns (loss[lead+(1,)] in x.dtype, valid[lead])."""
+    from .pallas import enabled
+    if not enabled("softmax_xent"):
+        return None
+    from .pallas.softmax_xent import _softmax_xent2
+    v = x.shape[-1]
+    lbl = label
+    if lbl.ndim == x.ndim and lbl.shape[-1] == 1:
+        lbl = jnp.squeeze(lbl, -1)
+    valid = lbl != ignore_index
+    loss = _softmax_xent2(
+        x.reshape(-1, v), lbl.reshape(-1, 1).astype(jnp.int32)
+    ).reshape(lbl.shape + (1,)).astype(x.dtype)
+    return jnp.where(valid[..., None], loss, jnp.zeros((), x.dtype)), valid
+
+
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, axis=-1,
                                return_softmax=False, name=None):
     """Fused, numerically stable (reference: the fused CUDA kernel in
     softmax_with_cross_entropy_op.cu)."""
     def impl(logits, label, soft_label, ignore_index, axis, return_softmax):
+        ax = axis % logits.ndim
+        if not soft_label and not return_softmax and ax == logits.ndim - 1:
+            fused = _fused_softmax_xent(logits, label, ignore_index)
+            if fused is not None:
+                return fused[0]
         lse = jax.scipy.special.logsumexp(logits, axis=axis, keepdims=True)
         logp = logits - lse
         if soft_label:
@@ -79,21 +105,29 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100,
     summed weights of non-ignored positions (paddle semantics)."""
     def impl(x, label, *maybe_w, soft_label, ignore_index, axis, use_softmax,
              reduction):
-        if use_softmax:
-            logp = x - jax.scipy.special.logsumexp(x, axis=axis,
-                                                   keepdims=True)
+        ax = axis % x.ndim
+        if soft_label or not (use_softmax and ax == x.ndim - 1):
+            fused = None
         else:
-            logp = jnp.log(jnp.clip(x, 1e-10, 1.0))
+            fused = _fused_softmax_xent(x, label, ignore_index)
+        if fused is None:
+            if use_softmax:
+                logp = x - jax.scipy.special.logsumexp(x, axis=axis,
+                                                       keepdims=True)
+            else:
+                logp = jnp.log(jnp.clip(x, 1e-10, 1.0))
         if soft_label:
             loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
             denom_w = jnp.ones_like(loss)
         else:
-            loss, valid = _picked_logp(logp, label, axis, ignore_index)
-            ax = axis % logp.ndim
+            if fused is not None:
+                loss, valid = fused
+            else:
+                loss, valid = _picked_logp(logp, label, axis, ignore_index)
             lbl = label
-            if lbl.ndim == logp.ndim and lbl.shape[ax] == 1:
+            if lbl.ndim == x.ndim and lbl.shape[ax] == 1:
                 lbl = jnp.squeeze(lbl, ax)
-            safe = jnp.clip(lbl, 0, logp.shape[ax] - 1).astype(jnp.int32)
+            safe = jnp.clip(lbl, 0, x.shape[ax] - 1).astype(jnp.int32)
             if maybe_w:
                 w = jnp.expand_dims(maybe_w[0][safe], ax)
                 loss = loss * w

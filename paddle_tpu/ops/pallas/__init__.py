@@ -23,6 +23,37 @@ def on_tpu():
     return not interpret_mode()
 
 
+# Per-kernel default overrides: None = auto (on on TPU, off elsewhere).
+# bench.py probes each kernel on the live device and disables just the
+# ones that fail to compile, instead of losing the whole run.
+_overrides = {}
+_KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent")
+
+
+def configure(**kernels):
+    """configure(layer_norm=False, fused_adam=None, ...) — override the
+    auto default for named kernels ('layer_norm', 'fused_adam',
+    'flash_attention', 'softmax_xent'). None restores auto.
+
+    The flag is read when an op traces, so call configure() BEFORE the
+    first jitted step — a step already compiled keeps the kernel choice
+    it was traced with."""
+    for k, v in kernels.items():
+        if k not in _KERNELS:
+            raise ValueError(
+                f"unknown pallas kernel {k!r}; known: {_KERNELS}")
+        if v is None:
+            _overrides.pop(k, None)
+        else:
+            _overrides[k] = bool(v)
+
+
+def enabled(kernel):
+    """Effective default for one kernel, honoring configure() overrides."""
+    v = _overrides.get(kernel)
+    return on_tpu() if v is None else v
+
+
 from . import layer_norm as layer_norm_mod
 from . import softmax_xent as softmax_xent_mod
 from . import flash_attention as flash_attention_mod

@@ -44,7 +44,12 @@ def _dropout_keep(seed_ref, bh, qi, j, shape, threshold):
     """Regeneratable dropout keep-mask for one (BQ, BK) score tile, drawn
     from the TPU PRNG seeded per tile (so fwd and both bwd kernels
     regenerate the identical mask without storing it)."""
-    pltpu.prng_seed(seed_ref[0], seed_ref[1], bh, qi, j)
+    # libtpu's tpu.prng_set_seed_32 takes at most TWO seed words, so fold
+    # the (bh, qi, j) tile coordinates into one mixed word (odd-constant
+    # multiplies are bijections mod 2^32; ranges are far below the
+    # constants, so distinct tiles get distinct words)
+    mixed = (seed_ref[1] * 1000003 + bh) * 1000003 + qi * 16777259 + j
+    pltpu.prng_seed(seed_ref[0], mixed)
     bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= jnp.uint32(threshold)
 
@@ -537,14 +542,14 @@ def flash_attention(q, k, v, attn_mask=None, causal=False, scale=None,
     emulator-speed) unless force=True (kernel correctness tests)."""
     from ...dispatch import apply
     from ... import random as prandom
-    from . import on_tpu
+    from . import enabled
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
     p_drop = float(dropout_p) if training else 0.0
     has_mask = attn_mask is not None
     mode = _mask_mode(attn_mask.shape if has_mask else None, b, h, sq, sk)
-    if mode == "fallback" or (not on_tpu() and not force):
+    if mode == "fallback" or (not enabled("flash_attention") and not force):
         from ..nn_ops import scaled_dot_product_attention as sdpa
         return sdpa(q, k, v, attn_mask=attn_mask, is_causal=causal,
                     scale=scale, dropout_p=p_drop, training=training)

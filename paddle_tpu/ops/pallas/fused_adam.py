@@ -56,7 +56,9 @@ def fused_adam_update(p, g, m, v, lr, beta1_pow, beta2_pow, beta1=0.9,
                       jnp.asarray(beta1_pow, jnp.float32),
                       jnp.asarray(beta2_pow, jnp.float32)])
 
-    br = min(rows, 4096)
+    # 7 VMEM refs (4 in + 3 out) × br×128×4B × 2 (double-buffer) must stay
+    # under the ~16MB scoped-VMEM limit: br=1024 → 7MB. 4096 OOMs on v5e.
+    br = min(rows, 1024)
     new_p, new_m, new_v = pl.pallas_call(
         functools.partial(_adam_kernel, beta1=beta1, beta2=beta2, eps=eps),
         grid=(pl.cdiv(rows, br),),

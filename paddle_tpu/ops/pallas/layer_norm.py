@@ -19,8 +19,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _block_rows(d):
-    # keep the (BR, D) block well under VMEM
-    target = 1 << 20  # 1M float32 elements ≈ 4MB
+    # keep the (BR, D) block well under VMEM: the bwd kernel holds 3 such
+    # blocks double-buffered, so 512K f32 elements (2MB) each stays under
+    # the ~16MB scoped-VMEM limit even in full fp32
+    target = 1 << 19
     br = max(8, min(1024, target // max(d, 1)))
     return int(8 * max(1, br // 8))
 

@@ -363,9 +363,9 @@ class Adam(Optimizer):
                  use_fused=None, **kw):
         super().__init__(learning_rate, parameters, **kw)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
-        if use_fused is None:  # auto: Pallas fused update on TPU
-            from ..ops.pallas import on_tpu
-            use_fused = on_tpu()
+        # None = auto, resolved via pallas.enabled() when the step traces
+        # (configure() before the first jitted step; traced steps keep
+        # the choice they were compiled with)
         self._use_fused = use_fused
 
     def _pre_param(self, p):
@@ -378,7 +378,11 @@ class Adam(Optimizer):
         b1, b2, eps = self._beta1, self._beta2, self._eps
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2
-        if self._use_fused:
+        use_fused = self._use_fused
+        if use_fused is None:
+            from ..ops import pallas as P
+            use_fused = P.enabled("fused_adam")
+        if use_fused:
             from ..ops.pallas.fused_adam import fused_adam_update
             new_p, m, v = fused_adam_update(
                 p, g, slots["moment1"], slots["moment2"], lr, b1p, b2p,

@@ -1,0 +1,82 @@
+"""BERT train-step ablation on the real chip: flash / pallas-LN /
+fused-adam each on-off, batch 32 and 64. Prints tok/s for each combo."""
+import itertools
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def bench(batch, seq, flash, pallas_ln, fused_adam, steps=15):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt, jit, amp
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+    from paddle_tpu.ops import pallas as P
+
+    pt.seed(0)
+    P.configure(flash_attention=flash, layer_norm=pallas_ln,
+                fused_adam=fused_adam)
+    cfg = BertConfig.base(use_flash_attention=flash)
+    model = BertForPretraining(cfg)
+    o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("i4")
+    mlm = np.where(rng.rand(batch, seq) < 0.15,
+                   rng.randint(0, cfg.vocab_size, (batch, seq)), -1
+                   ).astype("i4")
+    nsp = rng.randint(0, 2, (batch,)).astype("i4")
+
+    def step(ids, mlm, nsp):
+        with amp.auto_cast(dtype="bfloat16"):
+            logits, nsp_logits = model(ids)
+        loss = model.loss(logits.astype("float32"),
+                          nsp_logits.astype("float32"), mlm, nsp)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    fn = jit.to_static(step, models=[model], optimizers=[o])
+    t_ids, t_mlm, t_nsp = pt.to_tensor(ids), pt.to_tensor(mlm), \
+        pt.to_tensor(nsp)
+    fn(t_ids, t_mlm, t_nsp)
+    loss = fn(t_ids, t_mlm, t_nsp)
+    loss.numpy()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = fn(t_ids, t_mlm, t_nsp)
+    loss.numpy()
+    dt = (time.perf_counter() - t0) / steps
+    return batch * seq / dt, float(loss.numpy())
+
+
+CONFIGS = [
+    # (batch, flash, pallas_ln, fused_adam)
+    (32, 0, 0, 0),
+    (32, 1, 0, 0),
+    (32, 0, 1, 0),
+    (32, 0, 0, 1),
+    (32, 1, 1, 1),
+    (64, 0, 0, 0),
+    (64, 1, 1, 1),
+]
+
+
+def main():
+    for batch, flash, ln, fa in CONFIGS:
+        try:
+            tps, loss = bench(batch, 128, bool(flash), bool(ln), bool(fa))
+            print(f"batch={batch} flash={flash} ln={ln} "
+                  f"adam={fa}: {tps:,.0f} tok/s loss={loss:.4f}",
+                  flush=True)
+        except Exception as e:
+            print(f"batch={batch} flash={flash} ln={ln} "
+                  f"adam={fa}: FAIL {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

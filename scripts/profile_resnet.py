@@ -1,0 +1,111 @@
+"""ResNet-50 perf triage on the real chip: where does the step time go?
+
+Times (a) conv-only microbench ceiling, (b) jitted fwd, (c) fwd+bwd,
+(d) full train step, at batch 128/256, bf16. Prints a small table.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def timeit(fn, *args, steps=10):
+    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
+        fn(*args).block_until_ready()
+    out = fn(*args)
+    (out[0] if isinstance(out, tuple) else out).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    (out[0] if isinstance(out, tuple) else out).block_until_ready()
+    return (time.perf_counter() - t0) / steps
+
+
+def conv_ceiling(batch, layout="NHWC"):
+    """Single biggest-FLOP resnet conv (layer3 3x3): measures achievable
+    conv throughput in the given layout."""
+    if layout == "NHWC":
+        x = jnp.ones((batch, 28, 28, 256), jnp.bfloat16)
+        w = jnp.ones((3, 3, 256, 256), jnp.bfloat16)
+        dn = ("NHWC", "HWIO", "NHWC")
+    else:
+        x = jnp.ones((batch, 256, 28, 28), jnp.bfloat16)
+        w = jnp.ones((256, 256, 3, 3), jnp.bfloat16)
+        dn = ("NCHW", "OIHW", "NCHW")
+
+    @jax.jit
+    def f(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=dn)
+
+    dt = timeit(f, x, w)
+    flops = 2 * batch * 28 * 28 * 256 * 256 * 9
+    return flops / dt / 1e12
+
+
+def model_stages(batch):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt, jit, amp
+    from paddle_tpu.models.resnet import resnet50
+
+    pt.seed(0)
+    model = resnet50()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 3, 224, 224).astype("f4")
+    y = rng.randint(0, 1000, (batch,)).astype("i4")
+    tx, ty = pt.to_tensor(x), pt.to_tensor(y)
+
+    def fwd(xb, yb):
+        with amp.auto_cast(dtype="bfloat16"):
+            logits = model(xb)
+        return pt.nn.functional.cross_entropy(
+            logits.astype("float32"), yb)
+
+    def step(xb, yb):
+        with amp.auto_cast(dtype="bfloat16"):
+            logits = model(xb)
+        loss = pt.nn.functional.cross_entropy(logits.astype("float32"), yb)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    ffwd = jit.to_static(fwd, models=[model])
+    fstep = jit.to_static(step, models=[model], optimizers=[o])
+
+    def t(f):
+        f(tx, ty)
+        r = f(tx, ty)
+        r.numpy()
+        t0 = time.perf_counter()
+        for _ in range(8):
+            r = f(tx, ty)
+        r.numpy()
+        return (time.perf_counter() - t0) / 8
+
+    tf = t(ffwd)
+    ts = t(fstep)
+    return tf, ts
+
+
+def main():
+    for batch in (128, 256):
+        ceil = conv_ceiling(batch, "NHWC")
+        ceil_nchw = conv_ceiling(batch, "NCHW")
+        tf, ts = model_stages(batch)
+        tr_flops = 3 * 4.1e9 * batch  # fwd+bwd ~3x fwd, 4.1 GFLOP/img
+        print(f"batch={batch}: conv_NHWC={ceil:.1f} conv_NCHW={ceil_nchw:.1f}"
+              f" TF/s  fwd={tf*1e3:.1f}ms  step={ts*1e3:.1f}ms  "
+              f"step_img/s={batch/ts:.0f}  "
+              f"step_TF/s={tr_flops/ts/1e12:.1f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -322,3 +322,165 @@ def test_flash_wrapper_dropout_no_fallback_shape():
     out = flash_attention(q, q, q, dropout_p=0.5, training=True,
                           block_q=16, block_k=16, force=True)
     assert out.shape == [b, h, s, d]
+
+
+def test_fused_adam_multiblock_grid():
+    """Tensors bigger than one (1024, 128) block must grid-stride
+    correctly (the single-block VMEM-OOM regression at BERT-embedding
+    scale: 7 refs x 4096 rows blew the 16MB scoped-VMEM limit)."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(1)
+    n = 1024 * 128 * 2 + 77  # 2 full row-blocks + ragged tail
+    p = rng.randn(n).astype("f4")
+    g = rng.randn(n).astype("f4")
+    m = rng.rand(n).astype("f4") * 0.1
+    v = rng.rand(n).astype("f4") * 0.01
+    lr, b1, b2, eps = 1e-3, 0.9, 0.999, 1e-8
+    new_p, new_m, new_v = fused_adam_update(
+        jnp.asarray(p), jnp.asarray(g), jnp.asarray(m), jnp.asarray(v),
+        lr, b1, b2, beta1=b1, beta2=b2, eps=eps)
+    m_ref = b1 * m + (1 - b1) * g
+    v_ref = b2 * v + (1 - b2) * g * g
+    p_ref = p - lr * (m_ref / (1 - b1)) / (np.sqrt(v_ref / (1 - b2)) + eps)
+    np.testing.assert_allclose(np.asarray(new_p), p_ref, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(new_m), m_ref, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_v), v_ref, atol=1e-6)
+
+
+def test_layer_norm_multiblock_rows():
+    """Row count spanning several blocks incl. a partial final block; the
+    bwd dw/db accumulation must not double-count or include padding."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.layer_norm import _layer_norm2
+    rng = np.random.RandomState(2)
+    d = 768
+    n = 683 * 2 + 11  # > 2 blocks at the 512K-element target for d=768
+    x = rng.randn(n, d).astype("f4")
+    w = rng.randn(d).astype("f4")
+    b = rng.randn(d).astype("f4")
+
+    def ref(x, w, b):
+        mu = x.mean(1, keepdims=True)
+        var = x.var(1, keepdims=True)
+        return (x - mu) / np.sqrt(var + 1e-5) * w + b
+
+    out = _layer_norm2(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b), 1e-5)
+    np.testing.assert_allclose(np.asarray(out), ref(x, w, b), atol=2e-4)
+
+    def f(x, w, b):
+        # all-ones cotangent: the analytic dw/db checks below assume it
+        return _layer_norm2(x, w, b, 1e-5).sum()
+
+    gx, gw, gb = jax.grad(f, argnums=(0, 1, 2))(
+        jnp.asarray(x), jnp.asarray(w), jnp.asarray(b))
+    # dw/db vs analytic: db = sum(g) = n per feature? g == 1 everywhere
+    xn = (x - x.mean(1, keepdims=True)) / np.sqrt(
+        x.var(1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(np.asarray(gb), np.full(d, float(n)),
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), xn.sum(0), atol=2e-2)
+
+
+def test_pallas_configure_overrides():
+    """pallas.configure() flips the auto defaults consulted at forward/
+    step time (the bench probe uses this to degrade one kernel at a
+    time)."""
+    from paddle_tpu.ops import pallas as P
+    try:
+        assert P.enabled("layer_norm") == P.on_tpu()
+        P.configure(layer_norm=True, fused_adam=False)
+        assert P.enabled("layer_norm") is True
+        assert P.enabled("fused_adam") is False
+        # a LayerNorm built BEFORE the configure() call still honors it
+        from paddle_tpu import nn
+        ln = nn.LayerNorm(16)
+        x = pt.to_tensor(np.random.RandomState(0).randn(4, 16).astype("f4"))
+        out_forced = ln(x).numpy()  # interpret-mode pallas on CPU
+        P.configure(layer_norm=False)
+        out_xla = ln(x).numpy()
+        np.testing.assert_allclose(out_forced, out_xla, atol=1e-5)
+    finally:
+        P.configure(layer_norm=None, fused_adam=None)
+        assert P.enabled("fused_adam") == P.on_tpu()
+
+
+def test_softmax_xent_gated_in_loss_op():
+    """softmax_with_cross_entropy routes through the fused kernel when
+    configure(softmax_xent=True); numerics (incl. ignore_index masking
+    and grads) must match the XLA logsumexp path."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas as P
+    from paddle_tpu.ops.loss import softmax_with_cross_entropy
+    rng = np.random.RandomState(3)
+    logits = rng.randn(6, 128, 33).astype("f4")
+    label = rng.randint(0, 33, (6, 128)).astype("i4")
+    label[0, :7] = -1  # ignored positions
+
+    def run():
+        x = pt.to_tensor(logits.copy())
+        x.stop_gradient = False
+        loss = softmax_with_cross_entropy(x, pt.to_tensor(label),
+                                          ignore_index=-1)
+        loss.sum().backward()
+        return loss.numpy(), np.asarray(x.grad)
+
+    try:
+        P.configure(softmax_xent=True)
+        l_k, g_k = run()
+    finally:
+        P.configure(softmax_xent=None)
+    P.configure(softmax_xent=False)
+    try:
+        l_x, g_x = run()
+    finally:
+        P.configure(softmax_xent=None)
+    np.testing.assert_allclose(l_k, l_x, atol=1e-4)
+    np.testing.assert_allclose(g_k, g_x, atol=1e-4)
+
+
+def test_pallas_configure_rejects_unknown():
+    from paddle_tpu.ops import pallas as P
+    import pytest
+    with pytest.raises(ValueError):
+        P.configure(flash_atention=False)  # typo must not pass silently
+
+
+def test_softmax_xent_gated_in_cross_entropy():
+    """cross_entropy (the flagship BERT loss path) routes through the
+    fused kernel too; mean-reduction over non-ignored rows, weights, and
+    grads must match the XLA path."""
+    import jax
+    from paddle_tpu.ops import pallas as P
+    from paddle_tpu.ops.loss import cross_entropy
+    rng = np.random.RandomState(4)
+    logits = rng.randn(5, 64, 17).astype("f4")
+    label = rng.randint(0, 17, (5, 64)).astype("i4")
+    label[1, :9] = -1
+
+    def run(weight=None):
+        x = pt.to_tensor(logits.copy())
+        x.stop_gradient = False
+        loss = cross_entropy(x, pt.to_tensor(label), ignore_index=-1,
+                             weight=weight)
+        loss.backward()
+        return float(loss.numpy()), np.asarray(x.grad)
+
+    w = pt.to_tensor(rng.rand(17).astype("f4") + 0.5)
+    try:
+        P.configure(softmax_xent=True)
+        l_k, g_k = run()
+        lw_k, gw_k = run(weight=w)
+    finally:
+        P.configure(softmax_xent=None)
+    P.configure(softmax_xent=False)
+    try:
+        l_x, g_x = run()
+        lw_x, gw_x = run(weight=w)
+    finally:
+        P.configure(softmax_xent=None)
+    np.testing.assert_allclose(l_k, l_x, rtol=1e-5)
+    np.testing.assert_allclose(g_k, g_x, atol=1e-5)
+    np.testing.assert_allclose(lw_k, lw_x, rtol=1e-5)
+    np.testing.assert_allclose(gw_k, gw_x, atol=1e-5)
