@@ -196,11 +196,18 @@ class Transformer(nn.Layer):
 
     def loss(self, logits, labels, pad_id=0):
         """Label-smoothed CE averaged over non-pad tokens (reference:
-        label_smooth + softmax_with_cross_entropy(soft_label=True))."""
+        label_smooth + softmax_with_cross_entropy(soft_label=True)). On
+        TPU the smoothing folds into the fused Pallas xent kernel, so the
+        (B, S, V) smoothed one-hot never materializes in HBM."""
+        from ..ops import pallas as P
         vocab = logits.shape[-1]
-        soft = F.label_smooth(ops.one_hot(labels, vocab),
-                              epsilon=self.label_smooth_eps)
-        token_loss = ops.loss.softmax_with_cross_entropy(
-            logits, soft, soft_label=True)
+        if P.enabled("softmax_xent"):
+            token_loss = P.softmax_cross_entropy(
+                logits, labels, smooth_eps=self.label_smooth_eps)
+        else:
+            soft = F.label_smooth(ops.one_hot(labels, vocab),
+                                  epsilon=self.label_smooth_eps)
+            token_loss = ops.loss.softmax_with_cross_entropy(
+                logits, soft, soft_label=True)
         mask = (labels != pad_id).astype("float32").unsqueeze(-1)
         return (token_loss * mask).sum() / mask.sum()

@@ -438,18 +438,27 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             axes = tuple(range(x.ndim - 1))
             shape = (1,) * (x.ndim - 1) + (-1,)
         if training:
-            mean = jnp.mean(x, axis=axes)
-            var = jnp.var(x, axis=axes)
-            new_rm = momentum * rm + (1 - momentum) * mean
-            new_rv = momentum * rv + (1 - momentum) * var
+            # stats in f32 (bf16 accumulation over N*H*W loses precision),
+            # running stats stay in the buffer dtype
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            new_rm = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
+            new_rv = momentum * rv + (1 - momentum) * var.astype(rv.dtype)
         else:
             mean, var = rm, rv
             new_rm, new_rv = rm, rv
-        inv = lax.rsqrt(var + epsilon)
-        out = (x - mean.reshape(shape)) * inv.reshape(shape)
+        # fold (mean, var, w, b) into one per-channel scale+shift applied in
+        # x's compute dtype: under amp the whole elementwise chain (and the
+        # residual adds downstream) stays bf16 instead of promoting to f32,
+        # halving HBM traffic on the BN→relu→add path
+        inv = lax.rsqrt(var.astype(jnp.float32) + epsilon)
+        scale, shift = inv, -mean.astype(jnp.float32) * inv
         if wb:
             w, b = wb
-            out = out * w.reshape(shape) + b.reshape(shape)
+            scale = inv * w.astype(jnp.float32)
+            shift = b.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+        out = x * scale.astype(x.dtype).reshape(shape) + \
+            shift.astype(x.dtype).reshape(shape)
         return out, new_rm, new_rv
 
     args = (x, running_mean, running_var)

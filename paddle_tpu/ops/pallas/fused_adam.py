@@ -97,3 +97,23 @@ def fused_adam_update(p, g, m, v, lr, beta1_pow, beta2_pow, beta1=0.9,
     return (unflat(new_p, p.dtype), unflat(new_m, jnp.float32),
             unflat(new_v, jnp.float32))
 
+
+def adam_step(p, g, m, v, lr, beta1_pow, beta2_pow, *, beta1=0.9,
+              beta2=0.999, eps=1e-8, use_fused=None):
+    """THE Adam update rule, shared by optimizer.Adam and the fleet/
+    megatron SPMD step: the fused Pallas kernel when pallas.enabled
+    ('fused_adam') (or use_fused forces it), else the identical plain-XLA
+    math. Returns (new_p, new_m, new_v)."""
+    if use_fused is None:
+        from . import enabled
+        use_fused = enabled("fused_adam")
+    if use_fused:
+        return fused_adam_update(p, g, m, v, lr, beta1_pow, beta2_pow,
+                                 beta1=beta1, beta2=beta2, eps=eps)
+    new_m = beta1 * m + (1 - beta1) * g
+    new_v = beta2 * v + (1 - beta2) * g * g
+    mhat = new_m / (1 - beta1_pow)
+    vhat = new_v / (1 - beta2_pow)
+    new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return new_p, new_m, new_v
+

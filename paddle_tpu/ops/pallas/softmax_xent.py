@@ -22,7 +22,11 @@ def _block_rows(v):
     return int(8 * max(1, br // 8))
 
 
-def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, v):
+def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, v, eps):
+    """eps>0 = uniform label smoothing folded into the same pass
+    (reference: label_smooth + the soft path of
+    softmax_with_cross_entropy_op, without materializing the (N, V)
+    smoothed one-hot): loss = lse − (1−eps)·picked − (eps/V)·Σx."""
     x = logits_ref[:].astype(jnp.float32)
     m = jnp.max(x, axis=1, keepdims=True)
     e = jnp.exp(x - m)
@@ -31,10 +35,14 @@ def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, v):
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     onehot = cols == labels
     picked = jnp.sum(jnp.where(onehot, x, 0.0), axis=1, keepdims=True)
-    loss_ref[:] = (lse - picked)
+    if eps:
+        loss_ref[:] = (lse - (1.0 - eps) * picked -
+                       (eps / v) * jnp.sum(x, axis=1, keepdims=True))
+    else:
+        loss_ref[:] = (lse - picked)
 
 
-def _bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref, *, v):
+def _bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref, *, v, eps):
     x = logits_ref[:].astype(jnp.float32)
     m = jnp.max(x, axis=1, keepdims=True)
     e = jnp.exp(x - m)
@@ -42,10 +50,14 @@ def _bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref, *, v):
     labels = labels_ref[:]
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     onehot = (cols == labels).astype(jnp.float32)
-    dx_ref[:] = ((p - onehot) * g_ref[:]).astype(dx_ref.dtype)
+    if eps:
+        target = (1.0 - eps) * onehot + (eps / v)
+    else:
+        target = onehot
+    dx_ref[:] = ((p - target) * g_ref[:]).astype(dx_ref.dtype)
 
 
-def _run(kernel, logits2, labels2, extra=None, out_shape=None):
+def _run(kernel, logits2, labels2, eps, extra=None, out_shape=None):
     from . import interpret_mode
     n, v = logits2.shape
     br = _block_rows(v)
@@ -61,7 +73,7 @@ def _run(kernel, logits2, labels2, extra=None, out_shape=None):
         args.append(extra)
     wide = out_shape[1] == v
     return pl.pallas_call(
-        functools.partial(kernel, v=v),
+        functools.partial(kernel, v=v, eps=eps),
         grid=grid,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((br, v) if wide else (br, 1),
@@ -72,31 +84,34 @@ def _run(kernel, logits2, labels2, extra=None, out_shape=None):
     )(*args)
 
 
-@jax.custom_vjp
-def _softmax_xent2(logits2, labels2):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_xent2(logits2, labels2, eps=0.0):
     n, v = logits2.shape
-    return _run(_fwd_kernel, logits2, labels2, out_shape=(n, 1))
+    return _run(_fwd_kernel, logits2, labels2, eps, out_shape=(n, 1))
 
 
-def _fwd(logits2, labels2):
-    loss = _softmax_xent2(logits2, labels2)
+def _fwd(logits2, labels2, eps):
+    loss = _softmax_xent2(logits2, labels2, eps)
     return loss, (logits2, labels2)
 
 
-def _bwd(res, g):
+def _bwd(eps, res, g):
     logits2, labels2 = res
     n, v = logits2.shape
-    dx = _run(_bwd_kernel, logits2, labels2, extra=g.astype(jnp.float32),
-              out_shape=(n, v))
+    dx = _run(_bwd_kernel, logits2, labels2, eps,
+              extra=g.astype(jnp.float32), out_shape=(n, v))
     return dx, None
 
 
 _softmax_xent2.defvjp(_fwd, _bwd)
 
 
-def softmax_cross_entropy(logits, label):
+def softmax_cross_entropy(logits, label, smooth_eps=0.0):
     """Framework op: fused per-position softmax cross-entropy with hard
-    labels; returns loss with shape label.shape + (1,)."""
+    labels; returns loss with shape label.shape + (1,). smooth_eps>0 folds
+    uniform label smoothing into the kernel (reference: label_smooth +
+    softmax_with_cross_entropy(soft_label=True), without the (N, V)
+    smoothed one-hot ever touching HBM)."""
     from ...dispatch import apply
 
     def impl(logits, label):
@@ -104,7 +119,7 @@ def softmax_cross_entropy(logits, label):
         lead = logits.shape[:-1]
         l2 = logits.reshape(-1, v)
         lab2 = label.reshape(-1, 1).astype(jnp.int32)
-        loss = _softmax_xent2(l2, lab2)
+        loss = _softmax_xent2(l2, lab2, float(smooth_eps))
         return loss.reshape(*lead, 1)
 
     return apply(impl, (logits, label), name="pallas_softmax_xent")

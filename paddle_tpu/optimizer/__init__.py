@@ -378,22 +378,10 @@ class Adam(Optimizer):
         b1, b2, eps = self._beta1, self._beta2, self._eps
         b1p = slots["beta1_pow"] * b1
         b2p = slots["beta2_pow"] * b2
-        use_fused = self._use_fused
-        if use_fused is None:
-            from ..ops import pallas as P
-            use_fused = P.enabled("fused_adam")
-        if use_fused:
-            from ..ops.pallas.fused_adam import fused_adam_update
-            new_p, m, v = fused_adam_update(
-                p, g, slots["moment1"], slots["moment2"], lr, b1p, b2p,
-                beta1=b1, beta2=b2, eps=eps)
-            return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
-                           "beta2_pow": b2p}
-        m = b1 * slots["moment1"] + (1 - b1) * g
-        v = b2 * slots["moment2"] + (1 - b2) * g * g
-        mhat = m / (1 - b1p)
-        vhat = v / (1 - b2p)
-        new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+        from ..ops.pallas.fused_adam import adam_step
+        new_p, m, v = adam_step(p, g, slots["moment1"], slots["moment2"],
+                                lr, b1p, b2p, beta1=b1, beta2=b2, eps=eps,
+                                use_fused=self._use_fused)
         return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
                        "beta2_pow": b2p}
 

@@ -42,6 +42,10 @@ class MegatronConfig(NamedTuple):
     n_micro: int = 2           # microbatches per step (pipeline depth)
     lr: float = 1e-3
     use_moe: bool = True
+    optimizer: str = "adam"    # "adam" (fused-kernel rule) | "sgd"
+    beta1: float = 0.9
+    beta2: float = 0.999
+    adam_eps: float = 1e-8
 
 
 def factorize_mesh(n_devices):
@@ -399,13 +403,40 @@ def _loss_fn(params_local, tokens, cfg):
 
 
 def build_train_step(cfg: MegatronConfig, mesh: Mesh):
-    """Returns (params, step_fn). step_fn(params, tokens) -> (params, loss).
-    tokens: GLOBAL [n_micro, batch, seq_len] int32."""
+    """Returns (state, step_fn). step_fn(state, tokens) -> (state, loss).
+    state = {"params", "opt", "t"}; tokens: GLOBAL [n_micro, batch,
+    seq_len] int32.
+
+    The update rule is the REAL optimizer compute path (reference: fleet
+    distributed_optimizer wrapping Adam/SGD): "adam" runs the same fused
+    Pallas adam kernel Optimizer.Adam uses (ops/pallas/fused_adam.py) on
+    each param's local shard, slot state sharded exactly like its param."""
     params, specs = init_params(cfg, mesh)
 
     pspec_tree = {k: specs[k] for k in params}
+    if cfg.optimizer == "adam":
+        opt0 = {k: {"m": jnp.zeros_like(v), "v": jnp.zeros_like(v)}
+                for k, v in params.items()}
+        opt_spec = {k: {"m": pspec_tree[k], "v": pspec_tree[k]}
+                    for k in params}
+    elif cfg.optimizer == "sgd":
+        opt0, opt_spec = {}, {}
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
+    state = {"params": params, "opt": opt0,
+             "t": jnp.zeros((), jnp.int32)}
+    state_spec = {"params": pspec_tree, "opt": opt_spec, "t": P()}
 
-    def device_fn(params_local, tokens_local):
+    def _adam_update(p, g, slots, b1p, b2p):
+        from ..ops.pallas.fused_adam import adam_step
+        new_p, m, v = adam_step(p, g, slots["m"], slots["v"], cfg.lr,
+                                b1p, b2p, beta1=cfg.beta1, beta2=cfg.beta2,
+                                eps=cfg.adam_eps)
+        return new_p, {"m": m, "v": v}
+
+    def device_fn(state, tokens_local):
+        params_local = state["params"]
+
         def lf(p):
             return _loss_fn(p, tokens_local, cfg)
         loss, grads = jax.value_and_grad(lf)(params_local)
@@ -416,9 +447,20 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
         # the forward transpose).
         grads = jax.tree_util.tree_map(
             lambda g: lax.pmean(lax.pmean(g, "dp"), "sp"), grads)
-        new_params = jax.tree_util.tree_map(
-            lambda p, g: p - cfg.lr * g, params_local, grads)
-        return new_params, loss
+        t = state["t"] + 1
+        if cfg.optimizer == "adam":
+            tf = t.astype(jnp.float32)
+            b1p = jnp.power(cfg.beta1, tf)
+            b2p = jnp.power(cfg.beta2, tf)
+            new_params, new_opt = {}, {}
+            for k in params_local:
+                new_params[k], new_opt[k] = _adam_update(
+                    params_local[k], grads[k], state["opt"][k], b1p, b2p)
+        else:
+            new_params = jax.tree_util.tree_map(
+                lambda p, g: p - cfg.lr * g, params_local, grads)
+            new_opt = state["opt"]
+        return {"params": new_params, "opt": new_opt, "t": t}, loss
 
     # tokens: [n_micro, batch, seq]: batch over dp, seq over sp
     token_spec = P(None, "dp", "sp")
@@ -426,8 +468,8 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
     step = jax.jit(
         jax.shard_map(
             device_fn, mesh=mesh,
-            in_specs=(pspec_tree, token_spec),
-            out_specs=(pspec_tree, P()),
+            in_specs=(state_spec, token_spec),
+            out_specs=(state_spec, P()),
             check_vma=False),
         donate_argnums=(0,))
-    return params, step
+    return state, step

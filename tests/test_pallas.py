@@ -484,3 +484,58 @@ def test_softmax_xent_gated_in_cross_entropy():
     np.testing.assert_allclose(g_k, g_x, atol=1e-5)
     np.testing.assert_allclose(lw_k, lw_x, rtol=1e-5)
     np.testing.assert_allclose(gw_k, gw_x, atol=1e-5)
+
+
+def test_softmax_xent_label_smoothing():
+    """Smoothed kernel path == label_smooth + soft-label XLA path (loss
+    and grads), incl. through Transformer.loss gating."""
+    import jax
+    from paddle_tpu.ops import pallas as P
+    from paddle_tpu.ops import loss as L, one_hot
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(7)
+    eps = 0.1
+    logits = rng.randn(4, 20, 29).astype("f4")
+    labels = rng.randint(0, 29, (4, 20)).astype("i4")
+
+    x1 = pt.to_tensor(logits.copy())
+    x1.stop_gradient = False
+    loss1 = P.softmax_cross_entropy(x1, pt.to_tensor(labels),
+                                    smooth_eps=eps)
+    loss1.sum().backward()
+
+    x2 = pt.to_tensor(logits.copy())
+    x2.stop_gradient = False
+    soft = F.label_smooth(one_hot(pt.to_tensor(labels), 29), epsilon=eps)
+    loss2 = L.softmax_with_cross_entropy(x2, soft, soft_label=True)
+    loss2.sum().backward()
+
+    np.testing.assert_allclose(loss1.numpy(), loss2.numpy(), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(x1.grad), np.asarray(x2.grad),
+                               atol=1e-5)
+
+
+def test_transformer_loss_pallas_gate():
+    """Force the softmax_xent gate on: Transformer.loss through the fused
+    smoothed kernel must match its own XLA fallback path."""
+    from paddle_tpu.ops import pallas as P
+    from paddle_tpu.models.transformer import Transformer
+
+    pt.seed(0)
+    model = Transformer(src_vocab_size=37, tgt_vocab_size=37, d_model=16,
+                        num_heads=2, d_ff=32, num_encoder_layers=1,
+                        num_decoder_layers=1)
+    rng = np.random.RandomState(8)
+    logits = pt.to_tensor(rng.randn(2, 9, 37).astype("f4"))
+    labels = pt.to_tensor(rng.randint(0, 37, (2, 9)).astype("i4"))
+    try:
+        P.configure(softmax_xent=True)
+        l_k = float(model.loss(logits, labels).numpy())
+    finally:
+        P.configure(softmax_xent=None)
+    P.configure(softmax_xent=False)
+    try:
+        l_x = float(model.loss(logits, labels).numpy())
+    finally:
+        P.configure(softmax_xent=None)
+    np.testing.assert_allclose(l_k, l_x, rtol=1e-5)

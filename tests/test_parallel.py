@@ -168,13 +168,13 @@ def test_megatron_loss_decreases():
     import numpy as np
     mesh, sizes = M.make_mesh(8)
     cfg = M.MegatronConfig(lr=5e-3)
-    params, step = M.build_train_step(cfg, mesh)
+    state, step = M.build_train_step(cfg, mesh)
     toks = np.random.RandomState(0).randint(
         0, cfg.vocab_size,
         (cfg.n_micro, cfg.microbatch * sizes["dp"], cfg.seq_len)).astype("i4")
     losses = []
     for _ in range(4):
-        params, loss = step(params, toks)
+        state, loss = step(state, toks)
         losses.append(float(loss))
     assert losses[-1] < losses[0]
 
@@ -200,8 +200,9 @@ def test_megatron_8dev_matches_single_device():
     assert sizes8 == {"dp": 2, "pp": 2, "tp": 2, "sp": 1, "ep": 1}
     mesh1, _ = M.make_mesh(1, devices=jax.devices()[:1])
 
-    p8, step8 = M.build_train_step(cfg8, mesh8)
-    p1, step1 = M.build_train_step(cfg1, mesh1)
+    s8, step8 = M.build_train_step(cfg8, mesh8)
+    s1, step1 = M.build_train_step(cfg1, mesh1)
+    p8, p1 = s8["params"], s1["params"]
 
     toks = np.random.RandomState(0).randint(
         0, cfg8.vocab_size, (cfg8.n_micro, cfg8.microbatch * 2,
@@ -215,8 +216,9 @@ def test_megatron_8dev_matches_single_device():
         np.testing.assert_allclose(a.reshape(b.shape), b, atol=1e-6,
                                    err_msg=f"init mismatch {k}")
 
-    p8, l8 = step8(p8, toks)
-    p1, l1 = step1(p1, toks)
+    s8, l8 = step8(s8, toks)
+    s1, l1 = step1(s1, toks)
+    p8, p1 = s8["params"], s1["params"]
     np.testing.assert_allclose(float(l8), float(l1), rtol=1e-4)
     for k in p8:
         a = np.asarray(jax.device_get(p8[k]))
@@ -224,3 +226,36 @@ def test_megatron_8dev_matches_single_device():
         np.testing.assert_allclose(
             a.reshape(b.shape), b, atol=5e-4,
             err_msg=f"param {k} diverged between 8-dev and 1-dev")
+
+
+def test_megatron_fused_adam_matches_fallback():
+    """The Pallas fused-adam kernel running on per-device shards INSIDE
+    shard_map (interpret mode here) must match the plain-XLA adam rule the
+    CPU default takes."""
+    from paddle_tpu.parallel import megatron as M
+    from paddle_tpu.ops import pallas as P
+
+    cfg = M.MegatronConfig(hidden=32, n_heads=2, vocab_size=64, seq_len=16,
+                           microbatch=1, n_micro=2, use_moe=False)
+    toks = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (cfg.n_micro, 2, cfg.seq_len)).astype("i4")
+
+    def one_step(force):
+        mesh, sizes = M.make_mesh(8)
+        P.configure(fused_adam=force)
+        try:
+            state, step = M.build_train_step(cfg, mesh)
+            state, loss = step(state, toks)
+        finally:
+            P.configure(fused_adam=None)
+        return state, float(loss)
+
+    s_fused, l_fused = one_step(True)
+    s_plain, l_plain = one_step(False)
+    np.testing.assert_allclose(l_fused, l_plain, rtol=1e-5)
+    import jax
+    for k in s_fused["params"]:
+        np.testing.assert_allclose(
+            np.asarray(jax.device_get(s_fused["params"][k])),
+            np.asarray(jax.device_get(s_plain["params"][k])),
+            atol=2e-5, err_msg=f"param {k}")
