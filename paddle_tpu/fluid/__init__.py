@@ -17,16 +17,19 @@ from ..static import (Program, Executor, program_guard, data,
                       append_backward)
 from ..device import CPUPlace, CUDAPlace, TPUPlace
 from ..param_attr import ParamAttr, WeightNormParamAttr
-from .. import initializer
-from .. import regularizer
-# fluid.clip must be the MODULE; `from .. import clip` would resolve the
-# package attribute, which paddle_tpu/__init__ rebinds to the clip
-# FUNCTION (paddle.clip parity) after importing the module.
-from importlib import import_module as _import_module
-clip = _import_module(".clip", __package__.rsplit(".", 1)[0])
-from .. import optimizer
-from .. import metric as metrics
-from .. import io
+# importable-module facades (so `import paddle_tpu.fluid.initializer` and
+# friends work like `import paddle.fluid.initializer` in the reference)
+from . import initializer
+from . import regularizer
+from . import clip
+from . import optimizer
+from . import metrics
+from . import io
+from . import framework
+from . import executor
+from . import backward
+from . import unique_name
+from . import profiler as profiler  # noqa: F401
 from ..tensor import Tensor
 from ..static import enable_static, disable_static
 from . import layers

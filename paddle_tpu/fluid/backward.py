@@ -1,0 +1,28 @@
+"""fluid.backward facade (reference: fluid/backward.py)."""
+from ..static import append_backward, gradients  # noqa: F401
+
+
+from ..static import gradients as calc_gradient  # noqa: E402
+
+
+class ProgramStats:
+    """reference backward.py:ProgramStats — recompute-segment bookkeeping.
+    The rebuild gets recomputation from jax.checkpoint (optimizer.Recompute),
+    so this only records the op list for ported introspection code."""
+
+    def __init__(self, block=None, ops=None):
+        self.block = block
+        self.ops = ops or []
+        self.var_op_deps = {}
+
+    def get_reserved_vars(self):
+        return []
+
+    def get_out_of_subgraph_vars(self, begin_idx, end_idx):
+        return []
+
+
+def serialize_op_decs(op_desc=None):
+    """reference backward.py:serialize_op_decs — no protobuf op descs
+    exist; returns the op's repr."""
+    return repr(op_desc)

@@ -1,0 +1,43 @@
+"""fluid.clip facade (reference: fluid/clip.py)."""
+from ..clip import *  # noqa: F401,F403
+
+
+# --- reference clip.py internals --------------------------------------------
+from ..clip import (GradientClipByValue, GradientClipByNorm,  # noqa: F401
+                    GradientClipByGlobalNorm)
+from ..clip import ClipGradBase as GradientClipBase  # noqa: F401
+
+
+class BaseErrorClipAttr:
+    """reference clip.py:BaseErrorClipAttr."""
+
+    def append_clip_op(self, block, grad_name):
+        raise NotImplementedError
+
+
+# ErrorClipByValue comes from ..clip via the star import above (the tape
+# applies it to a var's incoming gradient); BaseErrorClipAttr is its
+# reference-parity base.
+
+def error_clip_callback(block=None, context=None):
+    """reference clip.py:error_clip_callback — grad-op callback hook; the
+    jax.grad engine has no per-op callback, clipping applies via
+    optimizer grad_clip instead."""
+
+
+def append_gradient_clip_ops(param_grads):
+    """reference clip.py:append_gradient_clip_ops — functional redesign:
+    params sharing one .gradient_clip_attr are clipped as a GROUP (one
+    joint call), preserving GradientClipByGlobalNorm's combined-norm
+    semantics; returns the new (param, grad) list in input order."""
+    groups = {}          # id(attr) -> (attr, [index])
+    out = [(p, g) for p, g in param_grads]
+    for i, (p, g) in enumerate(param_grads):
+        attr = getattr(p, "gradient_clip_attr", None)
+        if attr is not None and g is not None:
+            groups.setdefault(id(attr), (attr, []))[1].append(i)
+    for attr, idxs in groups.values():
+        clipped = attr([param_grads[i] for i in idxs])
+        for i, pg in zip(idxs, clipped):
+            out[i] = pg
+    return out

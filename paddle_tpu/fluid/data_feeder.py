@@ -135,3 +135,93 @@ def double_buffer(reader, place=None, name=None):
     """reference: layers/io.py:831. Device-side prefetch is handled by the
     DataLoader's native prefetching core; identity here."""
     return reader
+
+
+# --- reference data_feeder.py validator surface (commonly imported by
+# ported user code: `from paddle.fluid.data_feeder import check_dtype`) ---
+
+from ..tensor import convert_dtype  # noqa: F401,E402
+
+
+def check_type(input, input_name, expected_type, op_name,
+               extra_message=""):
+    """reference data_feeder.py:check_type."""
+    from ..tensor import Tensor
+    if isinstance(expected_type, tuple):
+        expected = expected_type
+    else:
+        expected = (expected_type,)
+    # a Tensor satisfies any Variable-ish expectation
+    if isinstance(input, Tensor):
+        return
+    if not isinstance(input, expected):
+        raise TypeError(
+            f"The type of '{input_name}' in {op_name} must be "
+            f"{expected_type}, but received {type(input)}. {extra_message}")
+
+
+def check_dtype(input_dtype, input_name, expected_dtype, op_name,
+                extra_message=""):
+    """reference data_feeder.py:check_dtype."""
+    dt = str(input_dtype)
+    if dt not in tuple(str(d) for d in expected_dtype):
+        raise TypeError(
+            f"The data type of '{input_name}' in {op_name} must be one of "
+            f"{expected_dtype}, but received {dt}. {extra_message}")
+
+
+def check_variable_and_dtype(input, input_name, expected_dtype, op_name,
+                             extra_message=""):
+    """reference data_feeder.py:check_variable_and_dtype."""
+    from ..tensor import Tensor
+    check_type(input, input_name, Tensor, op_name, extra_message)
+    dtype = getattr(input, "dtype", None)
+    if dtype is not None:
+        import numpy as _np
+        check_dtype(_np.dtype(dtype).name if not isinstance(dtype, str)
+                    else dtype, input_name, expected_dtype, op_name,
+                    extra_message)
+
+
+class DataToLoDTensorConverter:
+    """reference data_feeder.py:DataToLoDTensorConverter — padded-batch
+    redesign: accumulates rows and converts to one array."""
+
+    def __init__(self, place=None, lod_level=0, shape=None, dtype="float32"):
+        self.shape = shape
+        self.dtype = dtype
+        self.data = []
+
+    def feed(self, data):
+        self.data.append(data)
+
+    def done(self):
+        import numpy as _np
+        from ..tensor import Tensor
+        return Tensor(_np.asarray(self.data, dtype=self.dtype))
+
+
+class BatchedTensorProvider:
+    """reference data_feeder.py:BatchedTensorProvider — generator-side
+    batcher over feed_list shapes."""
+
+    def __init__(self, feed_list, place=None, batch_size=1, generator=None,
+                 drop_last=True):
+        self.feed_list = feed_list
+        self.batch_size = batch_size
+        self.generator = generator
+        self.drop_last = drop_last
+
+    def __call__(self):
+        import numpy as _np
+        batch = []
+        for item in self.generator():
+            batch.append(item)
+            if len(batch) == self.batch_size:
+                yield [
+                    _np.asarray([row[i] for row in batch])
+                    for i in range(len(batch[0]))]
+                batch = []
+        if batch and not self.drop_last:
+            yield [_np.asarray([row[i] for row in batch])
+                   for i in range(len(batch[0]))]

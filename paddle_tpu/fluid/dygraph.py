@@ -44,3 +44,129 @@ def to_variable(value, name=None, zero_copy=None):
 def enabled():
     from .. import static as _static
     return not _static.in_static_mode()
+
+
+# --- remaining dygraph/nn.py + dygraph/base.py parity -----------------------
+
+from ..nn.layers import Conv3DTranspose, TreeConv, NCE  # noqa: F401,E402
+InstanceNorm = InstanceNorm2D  # fluid-era name
+
+
+def enable_dygraph(place=None):
+    """reference dygraph/base.py:enable_dygraph."""
+    from .. import static as _static
+    if _static.in_static_mode():
+        _static.disable_static()
+
+
+def disable_dygraph():
+    from .. import static as _static
+    if not _static.in_static_mode():
+        _static.enable_static()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """reference dygraph/base.py:grad → tape autograd.grad."""
+    from ..autograd import grad as _grad
+    return _grad(outputs, inputs, grad_outputs=grad_outputs,
+                 retain_graph=bool(retain_graph))
+
+
+@contextlib.contextmanager
+def param_guard(parameters=None):
+    """reference dygraph/base.py:param_guard — the dygraph/static param
+    bridge is automatic here (Parameters are concrete either way)."""
+    yield
+
+
+@contextlib.contextmanager
+def program_desc_tracing_guard(enable):
+    """reference dygraph/base.py — no ProgramDesc tracer exists in the
+    jit.to_static redesign; parity no-op."""
+    yield
+
+
+class RowConv(Layer):
+    """Lookahead (row) convolution for streaming models (reference:
+    dygraph/nn.py:2731 RowConv / row_conv_op): out[t] = sum_{j=0..C}
+    x[t+j] * W[j], per feature. Padded [B, T, D] redesign of the LoD op;
+    one gather-free implementation via shifted adds (C+1 terms unrolled —
+    C is small in DeepSpeech-style models)."""
+
+    def __init__(self, name_scope=None, future_context_size=2,
+                 param_attr=None, act=None, input_dim=None):
+        super().__init__()
+        self._ctx = int(future_context_size)
+        self._act = act
+        self._param_attr = param_attr
+        self._dim = input_dim
+        self.weight = None
+        if input_dim is not None:
+            self._build(input_dim)
+
+    def _build(self, d):
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            (self._ctx + 1, d), attr=self._param_attr,
+            default_initializer=I.XavierUniform())
+        self._dim = d
+
+    def forward(self, x):
+        if self.weight is None:
+            self._build(int(x.shape[-1]))
+        from ..dispatch import apply
+        import jax.numpy as jnp
+
+        def impl(x, w):
+            T = x.shape[1]
+            out = x * w[0]
+            for j in range(1, w.shape[0]):
+                shifted = jnp.pad(x[:, j:], ((0, 0), (0, j), (0, 0)))
+                out = out + shifted * w[j]
+            return out
+
+        out = apply(impl, (x, self.weight), name="row_conv")
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class SequenceConv(Layer):
+    """Dygraph wrapper over the padded sequence_conv op (reference:
+    dygraph/nn.py SequenceConv over sequence_conv_op)."""
+
+    def __init__(self, name_scope=None, num_filters=1, filter_size=3,
+                 padding_start=None, param_attr=None, bias_attr=None,
+                 act=None, input_dim=None):
+        super().__init__()
+        self._nf = num_filters
+        self._fs = filter_size
+        self._pad = padding_start
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._act = act
+        self.weight = None
+        if input_dim is not None:
+            self._build(input_dim)
+
+    def _build(self, d):
+        from .. import initializer as I
+        self.weight = self.create_parameter(
+            (self._fs * d, self._nf), attr=self._param_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter((self._nf,), attr=self._bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, length=None):
+        if self.weight is None:
+            self._build(int(x.shape[-1]))
+        from ..ops.sequence import sequence_conv as _op
+        out = _op(x, self.weight, self.bias, filter_size=self._fs,
+                  padding_start=self._pad, length=length)
+        if self._act:
+            from ..nn import functional as F
+            out = getattr(F, self._act)(out)
+        return out

@@ -1,0 +1,80 @@
+"""fluid.executor facade (reference: fluid/executor.py)."""
+from ..static import Executor, global_scope, Scope  # noqa: F401
+
+
+import contextlib
+import numpy as _np
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """reference executor.py:scope_guard — scopes are plain dicts here;
+    the guard exists for ported code shape."""
+    yield scope
+
+
+def as_numpy(tensor):
+    """reference executor.py:as_numpy."""
+    if isinstance(tensor, (list, tuple)):
+        return [as_numpy(t) for t in tensor]
+    return tensor.numpy() if hasattr(tensor, "numpy") else _np.asarray(
+        tensor)
+
+
+class FetchHandler:
+    """reference executor.py:FetchHandler — subclass and override
+    handler(fetch_dict) for periodic fetches."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        self.var_dict = var_dict or {}
+        self.period_secs = period_secs
+
+    def handler(self, fetch_dict):
+        raise NotImplementedError
+
+    @staticmethod
+    def help():
+        return FetchHandler.__doc__
+
+
+def dimension_is_compatible_with(first, second):
+    """reference executor.py:dimension_is_compatible_with."""
+    dim_len = len(first)
+    if dim_len != len(second):
+        return False
+    for a, b in zip(first, second):
+        if a is None or b is None or a < 0 or b < 0:
+            continue
+        if a != b:
+            return False
+    return True
+
+
+def check_feed_shape_type(var, feed, num_places=1):
+    """reference executor.py:check_feed_shape_type."""
+    shape = getattr(var, "shape", None)
+    if shape is not None and not dimension_is_compatible_with(
+            tuple(feed.shape), tuple(shape)):
+        raise ValueError(
+            f"feed shape {tuple(feed.shape)} is not compatible with "
+            f"declared shape {tuple(shape)}")
+    return True
+
+
+def dtype_is_compatible_with(first, second):
+    """reference executor.py:dtype_is_compatible_with."""
+    import numpy as _np
+    try:
+        return _np.dtype(str(first)) == _np.dtype(str(second))
+    except TypeError:
+        return str(first) == str(second)
+
+
+def has_feed_operators(block=None, feed_targets=None, feed_holder_name=None):
+    """reference executor.py — the jitted program feeds args directly."""
+    return False
+
+
+def has_fetch_operators(block=None, fetch_targets=None,
+                        fetch_holder_name=None):
+    return False

@@ -1,0 +1,155 @@
+"""fluid.framework — importable-module facade (reference:
+fluid/framework.py: Program/Variable and mode switches)."""
+from ..static import (Program, program_guard, default_main_program,  # noqa
+                      default_startup_program, enable_static,
+                      disable_static)
+from ..static import StaticVar as Variable  # noqa: F401
+from ..static import in_static_mode as _in_static_mode
+
+
+def in_dygraph_mode():
+    """reference framework.py:in_dygraph_mode."""
+    return not _in_static_mode()
+
+
+from ..tensor import Tensor, Parameter, convert_dtype  # noqa: F401,E402
+from ..device import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401,E402
+from ..param_attr import ParamAttr  # noqa: F401,E402
+
+
+# --- remaining framework.py parity ------------------------------------------
+from ..static import Scope, global_scope, name_scope  # noqa: F401,E402
+from ..static import append_backward, gradients  # noqa: F401,E402
+from ..tensor import convert_dtype as convert_np_dtype_to_dtype_  # noqa
+
+
+def cpu_places(device_count=None):
+    """reference framework.py:cpu_places."""
+    from ..device import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """reference framework.py:cuda_places — maps to accelerator devices."""
+    from ..device import CUDAPlace
+    ids = device_ids if device_ids is not None else [0]
+    return [CUDAPlace(i) for i in ids]
+
+
+def _current_expected_place():
+    from ..device import get_device
+    return get_device()
+
+
+def require_version(min_version, max_version=None):
+    """reference framework.py:require_version — always satisfied (this
+    framework replaces the versioned C++ core)."""
+
+
+# structural aliases: the Program redesign keeps Block/Operator as the
+# graph-node classes inside static/__init__.py
+from ..static import Block  # noqa: F401,E402
+from ..static import OpNode as Operator  # noqa: F401,E402
+
+
+# --- remaining internals parity ---------------------------------------------
+import contextlib as _ctx
+
+ParamBase = Parameter            # dygraph-era parameter class name
+ComplexVariable = Tensor         # complex support rides jnp complex dtypes
+VariableMetaClass = type
+ParameterMetaClass = type
+
+
+class NameScope:
+    """reference framework.py:NameScope tree (name_scope() is the user
+    API; this mirrors the node type)."""
+
+    def __init__(self, name="", parent=None):
+        self._name = name
+        self._parent = parent
+        self._children = {}
+
+    def child(self, prefix):
+        node = NameScope(prefix, self)
+        self._children.setdefault(prefix, []).append(node)
+        return node
+
+    def parent(self):
+        return self._parent
+
+    def name(self):
+        return self._name
+
+
+class OpProtoHolder:
+    """reference framework.py:OpProtoHolder — op registry facade over the
+    dispatch table (no protobuf protos in the rebuild)."""
+
+    _instance = None
+
+    @classmethod
+    def instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def get_op_proto(self, type_name):
+        raise KeyError(
+            f"no protobuf proto for '{type_name}': ops lower straight to "
+            "XLA here (see paddle_tpu.dispatch)")
+
+
+def cuda_pinned_places(device_count=None):
+    """reference framework.py:cuda_pinned_places — host staging memory is
+    the csrc arena; returns CPU places for parity."""
+    return cpu_places(device_count)
+
+
+@_ctx.contextmanager
+def device_guard(device=None):
+    """reference framework.py:device_guard — pins ops to a device inside
+    the block. Maps to jax.default_device."""
+    import jax as _jax
+    if device is None:
+        yield
+        return
+    try:
+        plat = {"cpu": "cpu", "gpu": "tpu", "tpu": "tpu",
+                "cuda": "tpu"}.get(str(device).split(":")[0], None)
+        dev = _jax.devices(plat)[0] if plat else None
+    except Exception:
+        dev = None
+    if dev is None:
+        yield
+    else:
+        with _jax.default_device(dev):
+            yield
+
+
+class _IrStub:
+    """reference framework.py IrGraph/IrNode family — the SSA graph-pass
+    API has no analogue (XLA owns graph optimization); constructing one is
+    an explicit error rather than a silent shim."""
+
+    def __init__(self, *a, **kw):
+        raise RuntimeError(
+            f"{type(self).__name__} is the C++ IR graph-pass API; XLA "
+            "performs graph optimization in this framework (jit/to_static)")
+
+
+class IrGraph(_IrStub):
+    pass
+
+
+class IrNode(_IrStub):
+    pass
+
+
+class IrOpNode(_IrStub):
+    pass
+
+
+class IrVarNode(_IrStub):
+    pass

@@ -1,0 +1,7 @@
+"""fluid.initializer — importable-module facade over paddle_tpu.initializer
+(reference: python/paddle/fluid/initializer.py)."""
+from ..initializer import *  # noqa: F401,F403
+from ..initializer import (Initializer, Constant, Uniform, Normal,  # noqa
+                           TruncatedNormal, Xavier, XavierUniform,
+                           XavierNormal, MSRA, KaimingUniform,
+                           KaimingNormal, Bilinear, NumpyArrayInitializer)

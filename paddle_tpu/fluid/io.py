@@ -1,0 +1,2 @@
+"""fluid.io facade (reference: fluid/io.py save/load surface)."""
+from ..io import *  # noqa: F401,F403

@@ -38,8 +38,13 @@ from ..ops.sequence import (sequence_pool, sequence_softmax,  # noqa: F401
                             sequence_first_step, sequence_last_step)
 from ..ops.crf import linear_chain_crf, crf_decoding  # noqa: F401
 from ..ops.ctc import warpctc, ctc_greedy_decoder  # noqa: F401
-from ..distribution import (Uniform, Normal, Categorical,  # noqa: F401
-                            MultivariateNormalDiag)
+from ..distribution import (Distribution, Uniform, Normal,  # noqa: F401
+                            Categorical, MultivariateNormalDiag)
+from .layers_rnn import (RNNCell, LSTMCell, GRUCell, Decoder,  # noqa: F401
+                         DecodeHelper, SampleEmbeddingHelper,
+                         dynamic_lstm, dynamic_lstmp, dynamic_gru,
+                         gru_unit, lstm_unit, lstm, rnn, beam_search,
+                         beam_search_decode)
 from .data_feeder import py_reader, read_file, double_buffer  # noqa: F401
 from ..ops.detection import (iou_similarity, box_coder,  # noqa: F401
                              box_clip, prior_box, density_prior_box,
@@ -202,6 +207,15 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
                            reduction="none")
 
 
+def cross_entropy2(input, label, ignore_index=-100):
+    """reference: layers/loss.py:263 cross_entropy2 — same hard-label CE
+    over probabilities as cross_entropy, the op variant that also matched
+    x's shape (the extra outputs were an implementation detail)."""
+    return L.cross_entropy(input, label, soft_label=False,
+                           ignore_index=ignore_index, use_softmax=False,
+                           reduction="none")
+
+
 def mean(x, name=None):
     return ops.mean(x)
 
@@ -284,9 +298,220 @@ def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
 
 
 # ---------------------------------------------------------------------------
+# parameter-server-era ops (reference: layers/io.py Send/Recv/ListenAndServ)
+# — the PS architecture is redesigned away on TPU (SURVEY §2 row 22:
+# sharded embeddings + collectives), so these raise with a pointer instead
+# of silently doing nothing.
+
+def _ps_stub(name):
+    def f(*a, **kw):
+        raise RuntimeError(
+            f"fluid.layers.{name} is the parameter-server-mode op "
+            "(reference layers/io.py); the TPU redesign replaces the PS "
+            "architecture with sharded embeddings + ICI collectives — "
+            "see paddle_tpu.parallel.embedding and parallel.fleet")
+    f.__name__ = name
+    return f
+
+
+Send = _ps_stub("Send")
+Recv = _ps_stub("Recv")
+ListenAndServ = _ps_stub("ListenAndServ")
+BlockGuardServ = _ps_stub("BlockGuardServ")
+
+
+def monkey_patch_reader_methods(reader):
+    """reference layers/io.py:monkey_patch_reader_methods — the reader
+    variable already exposes its methods here; identity for parity."""
+    return reader
+
+
+# ---------------------------------------------------------------------------
 # parity tail: the remaining reference layer surface
 from .layers_extra import *  # noqa: F401,F403,E402
 from .layers_extra2 import *  # noqa: F401,F403,E402
 from ..utils.debug import Print, Assert  # noqa: F401,E402
 from ..nn.rnn import StaticRNN  # noqa: F401,E402
 from ..ops.imperative_flow import While  # noqa: F401,E402
+
+
+# ---------------------------------------------------------------------------
+# py_func (reference: layers/nn.py py_func + PyFuncRegistry) — TPU-native
+# redesign over jax.pure_callback: the python callable runs on the host at
+# execution time, inside jit, with results shipped back to the device.
+
+class PyFuncRegistry:
+    """reference layers/nn.py:PyFuncRegistry."""
+
+    _registry = []
+
+    def __init__(self, func):
+        self.func = func
+        self.id = len(PyFuncRegistry._registry)
+        PyFuncRegistry._registry.append(self)
+
+    @classmethod
+    def registered_func(cls, i):
+        return cls._registry[i].func
+
+    @classmethod
+    def registered_func_num(cls):
+        return len(cls._registry)
+
+
+# py_func itself lives in layers_extra.py (pure_callback with custom-VJP
+# backward support); PyFuncRegistry here completes the reference pair.
+
+
+def save(x, file_path, overwrite=True):
+    """reference layers/tensor.py:save — single-var save op."""
+    import os as _os
+    import numpy as _np
+    target = file_path if file_path.endswith(".npy") else file_path + ".npy"
+    if not overwrite and _os.path.exists(target):
+        raise RuntimeError(f"{target} exists and overwrite=False")
+    _np.save(target, x.numpy())
+
+
+def save_combine(x, file_path, overwrite=True):
+    """reference layers/tensor.py:save_combine — many vars, one file."""
+    from .. import io as _io
+    _io.save({getattr(v, "name", f"var_{i}") or f"var_{i}": v
+              for i, v in enumerate(x)}, file_path)
+
+
+def load_combine(out, file_path):
+    """reference layers/tensor.py:load_combine."""
+    from .. import io as _io
+    state = _io.load(file_path)
+    vals = list(state.values())
+    for v, val in zip(out, vals):
+        v.set_value(val)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LoD machinery internals (reference: layers/control_flow.py) — the padded
+# redesign has no LoD rank tables; block guards exist as working no-op
+# context managers for ported `with` blocks, converters raise with the
+# padded-equivalent pointer.
+
+class BlockGuard:
+    """reference control_flow.py:BlockGuard — with-block scoping is
+    python-native here."""
+
+    def __init__(self, main_program=None):
+        self.main_program = main_program
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class BlockGuardWithCompletion(BlockGuard):
+    def __init__(self, rnn=None):
+        super().__init__()
+        self.rnn = rnn
+
+
+class WhileGuard(BlockGuard):
+    def __init__(self, while_op=None):
+        super().__init__()
+        self.while_op = while_op
+
+
+class ConditionalBlockGuard(BlockGuard):
+    def __init__(self, block=None):
+        super().__init__()
+        self.block = block
+
+
+class IfElseBlockGuard(BlockGuard):
+    def __init__(self, is_true=True, ifelse=None):
+        super().__init__()
+        self.is_true = is_true
+
+
+class ConditionalBlock:
+    """reference control_flow.py:ConditionalBlock — use layers.cond /
+    layers.IfElse; kept for construction parity of ported graph builders."""
+
+    def __init__(self, inputs=None, is_scalar_condition=False, name=None):
+        self.inputs = inputs or []
+        self.is_scalar_condition = is_scalar_condition
+
+    def block(self):
+        return ConditionalBlockGuard(self)
+
+    def complete(self):
+        pass
+
+
+class StaticRNNMemoryLink:
+    """reference control_flow.py:StaticRNNMemoryLink record."""
+
+    def __init__(self, init, pre_mem, mem=None):
+        self.init = init
+        self.pre_mem = pre_mem
+        self.mem = mem
+
+
+def _lod_stub(name):
+    def f(*a, **kw):
+        raise RuntimeError(
+            f"fluid.layers.{name} is LoD-rank-table machinery (reference "
+            "layers/control_flow.py); the padded redesign replaces LoD "
+            "with dense [B, T, ...] + sequence_length — see "
+            "paddle_tpu.ops.sequence (sequence_pad/sequence_unpad)")
+    f.__name__ = name
+    return f
+
+
+lod_rank_table = _lod_stub("lod_rank_table")
+lod_tensor_to_array = _lod_stub("lod_tensor_to_array")
+array_to_lod_tensor = _lod_stub("array_to_lod_tensor")
+max_sequence_len = _lod_stub("max_sequence_len")
+merge_lod_tensor = _lod_stub("merge_lod_tensor")
+split_lod_tensor = _lod_stub("split_lod_tensor")
+
+
+def assign_skip_lod_tensor_array(input, output):
+    """reference control_flow.py:assign_skip_lod_tensor_array — plain
+    assign in the padded redesign."""
+    output.set_value(input.numpy() if hasattr(input, "numpy") else input)
+    return output
+
+
+def copy_var_to_parent_block(var, layer_helper=None):
+    """reference control_flow.py:copy_var_to_parent_block — single-block
+    Program: identity."""
+    return var
+
+
+def select_input(inputs, mask):
+    """reference control_flow.py:select_input — pick inputs[mask] (the
+    merge node of a conditional block): lax.switch-style gather."""
+    from ..dispatch import apply as _apply
+    import jax.numpy as _jnp
+
+    def impl(mask, *xs):
+        idx = _jnp.clip(mask.reshape(()).astype(_jnp.int32), 0, len(xs) - 1)
+        stacked = _jnp.stack(xs)
+        return stacked[idx]
+
+    return _apply(impl, (mask,) + tuple(inputs), name="select_input")
+
+
+def select_output(input, outputs, mask):
+    """reference control_flow.py:select_output — route input to
+    outputs[mask]; functional redesign returns the outputs tuple with the
+    selected slot replaced."""
+    outs = list(outputs)
+    i = int(mask.numpy()) if hasattr(mask, "numpy") else int(mask)
+    outs[i] = input
+    return tuple(outs)
+
+
+shrink_memory = _lod_stub("shrink_memory")

@@ -1,0 +1,373 @@
+"""fluid.layers RNN-family functional/param-creating ops.
+
+TPU-native rebuild of reference python/paddle/fluid/layers/rnn.py's
+op-style surface: dynamic_lstm (:1964), lstm (:2121), dynamic_lstmp,
+dynamic_gru (:2504), gru_unit (:2657), lstm_unit (:3034), beam_search,
+beam_search_decode.
+
+LoD redesign: the reference ops consume LoDTensors; here sequences are
+padded [B, T, ...] plus an optional integer `sequence_length` (the same
+padded+length convention as ops/sequence.py). Recurrence runs under
+`lax.scan` (one compiled loop, TPU-friendly) instead of the reference's
+per-timestep C++ ArrayRef walk. Gate order is (i, f, c, o) for LSTM and
+(u, r, c) for GRU — weights are owned by this framework, so the layout is
+documented rather than inherited.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+from ..dispatch import apply
+from .. import ops
+from .. import initializer as I
+
+# class re-exports (reference rnn.py defines these beside the ops)
+from ..nn.rnn import RNNCellBase as RNNCell  # noqa: F401
+from ..nn.rnn import LSTMCell, GRUCell  # noqa: F401
+from ..nn.decode import (Decoder, DecodeHelper, TrainingHelper,  # noqa
+                         GreedyEmbeddingHelper, SamplingEmbeddingHelper,
+                         BasicDecoder, gather_tree)
+
+SampleEmbeddingHelper = SamplingEmbeddingHelper  # reference spelling
+
+
+def _acts(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": jax.nn.relu, "identity": lambda x: x}[name]
+
+
+def _mask_scan(step, x_seq, carries, length, is_reverse):
+    """scan `step` over time axis 1 of x_seq with carried state frozen
+    past each row's length; outputs zeroed there (padded-LoD semantics)."""
+    B, T = x_seq.shape[0], x_seq.shape[1]
+    xs = jnp.moveaxis(x_seq, 1, 0)  # [T, B, ...]
+    ts = jnp.arange(T)
+    if is_reverse:
+        xs = xs[::-1]
+        ts = ts[::-1]
+
+    def body(carry, xt):
+        x_t, t = xt
+        new_carry, out = step(carry, x_t)
+        if length is not None:
+            alive = (t < length).reshape(-1, *([1] * (out.ndim - 1)))
+            new_carry = tuple(jnp.where(alive, n, c)
+                              for n, c in zip(new_carry, carry))
+            out = jnp.where(alive, out, 0.0)
+        return new_carry, out
+
+    carry, outs = lax.scan(body, carries, (xs, ts))
+    if is_reverse:
+        outs = outs[::-1]
+    return carry, jnp.moveaxis(outs, 0, 1)
+
+
+def _lstm_step_fn(w_r, b, peep, gate_act, cell_act, cand_act, proj=None,
+                  proj_act=None):
+    gact, cact, dact = _acts(gate_act), _acts(cell_act), _acts(cand_act)
+
+    def step(carry, x_t):
+        h, c = carry
+        g = x_t + h @ w_r + b
+        i, f, cand, o = jnp.split(g, 4, axis=-1)
+        if peep is not None:
+            w_ic, w_fc, w_oc = jnp.split(peep, 3, axis=-1)
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = gact(i), gact(f)
+        c_new = f * c + i * dact(cand)
+        if peep is not None:
+            o = o + c_new * w_oc
+        o = gact(o)
+        h_new = o * cact(c_new)
+        if proj is not None:
+            h_new = h_new @ proj
+            if proj_act is not None:
+                h_new = _acts(proj_act)(h_new)
+        return (h_new, c_new), jnp.concatenate([h_new, c_new], axis=-1)
+
+    return step
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 sequence_length=None):
+    """reference layers/rnn.py:1964 — input is pre-projected [B, T, 4H];
+    returns (hidden [B, T, H], cell [B, T, H])."""
+    if h_0 is not None or c_0 is not None:
+        raise NotImplementedError(
+            "dynamic_lstm h_0/c_0: pass initial state via dynamic_lstmp or "
+            "nn.LSTM; the padded redesign defaults to zeros")
+    from .layers import _param
+    H = size // 4
+    w = _param(param_attr, (H, 4 * H), dtype, I.XavierUniform())
+    nb = 7 * H if use_peepholes else 4 * H
+    b = _param(bias_attr, (nb,), dtype, I.Constant(0.0), is_bias=True)
+
+    def impl(x, w, b, length=None):
+        b4, peep = (b[:4 * H], b[4 * H:]) if use_peepholes else (b, None)
+        B = x.shape[0]
+        h0 = jnp.zeros((B, H), x.dtype)
+        c0 = jnp.zeros((B, H), x.dtype)
+        step = _lstm_step_fn(w, b4, peep, gate_activation, cell_activation,
+                             candidate_activation)
+        _, hc = _mask_scan(step, x, (h0, c0), length, is_reverse)
+        return hc[..., :H], hc[..., H:]
+
+    if sequence_length is not None:
+        return apply(impl, (input, w, b, sequence_length), n_out=2,
+                     name="dynamic_lstm")
+    return apply(impl, (input, w, b), n_out=2, name="dynamic_lstm")
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  cell_clip=None, proj_clip=None, sequence_length=None):
+    """reference layers/rnn.py dynamic_lstmp — LSTM with a recurrent
+    projection (h_t = act(W_p · lstm_h)); recurrence runs on the projected
+    state [B, P]. Returns (projection [B, T, P], cell [B, T, H])."""
+    from .layers import _param
+    H = size // 4
+    P = proj_size
+    w = _param(param_attr, (P, 4 * H), dtype, I.XavierUniform())
+    w_proj = _param(param_attr, (H, P), dtype, I.XavierUniform())
+    nb = 7 * H if use_peepholes else 4 * H
+    b = _param(bias_attr, (nb,), dtype, I.Constant(0.0), is_bias=True)
+
+    def impl(x, w, w_proj, b, length=None):
+        b4, peep = (b[:4 * H], b[4 * H:]) if use_peepholes else (b, None)
+        B = x.shape[0]
+        r0 = jnp.zeros((B, P), x.dtype)
+        c0 = jnp.zeros((B, H), x.dtype)
+        step = _lstm_step_fn(w, b4, peep, gate_activation, cell_activation,
+                             candidate_activation, proj=w_proj,
+                             proj_act=proj_activation)
+        _, rc = _mask_scan(step, x, (r0, c0), length, is_reverse)
+        return rc[..., :P], rc[..., P:]
+
+    if sequence_length is not None:
+        return apply(lambda x, a, p, b, ln: impl(x, a, p, b, ln),
+                     (input, w, w_proj, b, sequence_length), n_out=2,
+                     name="dynamic_lstmp")
+    return apply(impl, (input, w, w_proj, b), n_out=2, name="dynamic_lstmp")
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, origin_mode=False,
+                name=None, sequence_length=None):
+    """reference layers/rnn.py:2504 — input pre-projected [B, T, 3H];
+    returns hidden [B, T, H]. origin_mode picks between the two GRU
+    update conventions (paddle supports both)."""
+    from .layers import _param
+    H = size
+    w = _param(param_attr, (H, 3 * H), "float32", I.XavierUniform())
+    b = _param(bias_attr, (3 * H,), "float32", I.Constant(0.0),
+               is_bias=True)
+    gact, cact = _acts(gate_activation), _acts(candidate_activation)
+
+    def impl(x, w, b, *rest):
+        h_init = None
+        length = None
+        ri = 0
+        if h_0 is not None:
+            h_init = rest[ri]
+            ri += 1
+        if sequence_length is not None:
+            length = rest[ri]
+        w_ur, w_c = w[:, :2 * H], w[:, 2 * H:]
+        b_ur, b_c = b[:2 * H], b[2 * H:]
+        B = x.shape[0]
+        h0 = h_init if h_init is not None else jnp.zeros((B, H), x.dtype)
+
+        def step(carry, x_t):
+            (h,) = carry
+            x_ur, x_c = x_t[..., :2 * H], x_t[..., 2 * H:]
+            ur = gact(x_ur + h @ w_ur + b_ur)
+            u, r = ur[..., :H], ur[..., H:]
+            c = cact(x_c + (r * h) @ w_c + b_c)
+            if origin_mode:
+                h_new = (1.0 - u) * h + u * c
+            else:
+                h_new = u * h + (1.0 - u) * c
+            return (h_new,), h_new
+
+        _, hs = _mask_scan(step, x, (h0,), length, is_reverse)
+        return hs
+
+    args = [input, w, b]
+    if h_0 is not None:
+        args.append(h_0)
+    if sequence_length is not None:
+        args.append(sequence_length)
+    return apply(impl, tuple(args), name="dynamic_gru")
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """reference layers/rnn.py:2657 — ONE GRU step. input [B, 3H] (pre-
+    projected), hidden [B, H]. Returns (new_hidden, reset_hidden_prev,
+    gate_concat) like the reference op's three outputs."""
+    from .layers import _param
+    H = size // 3
+    w = _param(param_attr, (H, 3 * H), "float32", I.XavierUniform())
+    b = _param(bias_attr, (3 * H,), "float32", I.Constant(0.0),
+               is_bias=True)
+    gact, cact = _acts(gate_activation), _acts(activation)
+
+    def impl(x, h, w, b):
+        w_ur, w_c = w[:, :2 * H], w[:, 2 * H:]
+        ur = gact(x[..., :2 * H] + h @ w_ur + b[:2 * H])
+        u, r = ur[..., :H], ur[..., H:]
+        rh = r * h
+        c = cact(x[..., 2 * H:] + rh @ w_c + b[2 * H:])
+        if origin_mode:
+            h_new = (1.0 - u) * h + u * c
+        else:
+            h_new = u * h + (1.0 - u) * c
+        return h_new, rh, jnp.concatenate([u, r, c], axis=-1)
+
+    return apply(impl, (input, hidden, w, b), n_out=3, name="gru_unit")
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference layers/rnn.py:3034 — ONE LSTM step with the input
+    projection folded in (fc over [x, h]). Returns (hidden, cell)."""
+    from .layers import _param
+    H = hidden_t_prev.shape[-1]
+    D = x_t.shape[-1]
+    w = _param(param_attr, (D + H, 4 * H), "float32", I.XavierUniform())
+    b = _param(bias_attr, (4 * H,), "float32", I.Constant(0.0),
+               is_bias=True)
+
+    def impl(x, h, c, w, b):
+        g = jnp.concatenate([x, h], axis=-1) @ w + b
+        i, f, cand, o = jnp.split(g, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f + forget_bias) * c + \
+            jax.nn.sigmoid(i) * jnp.tanh(cand)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, c_new
+
+    return apply(impl, (x_t, hidden_t_prev, cell_t_prev, w, b), n_out=2,
+                 name="lstm_unit")
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference layers/rnn.py:2121 (the cudnn LSTM op) — stacked
+    (bi)LSTM over padded [B, T, D]. init_h/init_c: [L*dirs, B, H].
+    Returns (out [B, T, H*dirs], last_h, last_c) like the cudnn op."""
+    from .layers import _param
+    D = input.shape[-1]
+    dirs = 2 if is_bidirec else 1
+    ws = []
+    for layer in range(num_layers):
+        for d in range(dirs):
+            in_d = D if layer == 0 else hidden_size * dirs
+            ws.append(_param(None, (in_d, 4 * hidden_size), "float32",
+                             default_initializer or I.XavierUniform()))
+            ws.append(_param(None, (hidden_size, 4 * hidden_size),
+                             "float32",
+                             default_initializer or I.XavierUniform()))
+            ws.append(_param(None, (4 * hidden_size,), "float32",
+                             I.Constant(0.0), is_bias=True))
+
+    def impl(x, h0, c0, *flat_w):
+        outs = x
+        last_h, last_c = [], []
+        wi = 0
+        for layer in range(num_layers):
+            layer_outs = []
+            for d in range(dirs):
+                w_in, w_r, b = flat_w[wi], flat_w[wi + 1], flat_w[wi + 2]
+                wi += 3
+                idx = layer * dirs + d
+                step = _lstm_step_fn(w_r, b, None, "sigmoid", "tanh",
+                                     "tanh")
+                x_proj = outs @ w_in
+                (h_f, c_f), hc = _mask_scan(step, x_proj,
+                                            (h0[idx], c0[idx]), None,
+                                            is_reverse=(d == 1))
+                layer_outs.append(hc[..., :hidden_size])
+                last_h.append(h_f)
+                last_c.append(c_f)
+            outs = layer_outs[0] if dirs == 1 else jnp.concatenate(
+                layer_outs, axis=-1)
+        return outs, jnp.stack(last_h), jnp.stack(last_c)
+
+    return apply(impl, (input, init_h, init_c) + tuple(ws), n_out=3,
+                 name="lstm")
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, name=None,
+                return_parent_idx=False):
+    """reference layers/rnn.py beam_search — one expansion step over a
+    flattened [batch*beam, K] candidate table. LoD redesign: fixed
+    [batch, beam] layout (the BeamSearchDecoder class is the primary API;
+    this op-form mirrors the reference signature for ported loops).
+    Returns (selected_ids, selected_scores[, parent_idx])."""
+    def impl(pre_ids, pre_scores, ids, scores):
+        nb_k = scores.shape[-1]
+        B = scores.shape[0] // beam_size
+        sc = scores.reshape(B, beam_size, nb_k)
+        if not is_accumulated:
+            sc = jnp.log(jnp.clip(sc, 1e-20, 1.0)) + \
+                pre_scores.reshape(B, beam_size, 1)
+        # a finished beam (pre_id == end_id) proposes exactly ONE
+        # candidate — end_id at its own score (reference pruning rule):
+        # keep its column 0 at pre_score, kill the rest, and force the
+        # gathered token to end_id for candidates drawn from it below
+        fin = (pre_ids.reshape(B, beam_size, 1) == end_id)
+        only_first = jnp.full_like(sc, -1e9).at[..., 0].set(
+            pre_scores.reshape(B, beam_size))
+        sc = jnp.where(fin, only_first, sc)
+        flat = sc.reshape(B, beam_size * nb_k)
+        top_sc, top_ix = lax.top_k(flat, beam_size)
+        parent = top_ix // nb_k                     # beam index
+        cand_ids = ids.reshape(B, beam_size, nb_k)
+        sel = jnp.take_along_axis(
+            cand_ids.reshape(B, beam_size * nb_k), top_ix, axis=1)
+        parent_fin = jnp.take_along_axis(fin[..., 0], parent, axis=1)
+        sel = jnp.where(parent_fin, jnp.asarray(end_id, sel.dtype), sel)
+        return (sel.reshape(B * beam_size, 1),
+                top_sc.reshape(B * beam_size, 1),
+                parent.reshape(B * beam_size).astype(jnp.int32))
+
+    out = apply(impl, (pre_ids, pre_scores, ids, scores), n_out=3,
+                name="beam_search")
+    if return_parent_idx:
+        return out
+    return out[0], out[1]
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """reference layers/rnn.py beam_search_decode — backtrack the beam
+    lattice. Redesign: `ids`/`scores` are stacked [T, batch*beam] step
+    outputs with matching [T, batch*beam] parent indices embedded via
+    gather_tree (use nn.decode.dynamic_decode for the full pipeline)."""
+    ids_t, parents = ids
+    full = gather_tree(ids_t, parents, end_token=end_id)
+    return full, scores
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """reference layers/rnn.py:rnn — drive any RNNCell over a padded
+    sequence with lax.scan (the nn.RNN layer is the class form)."""
+    from ..nn.rnn import RNN as _RNN
+    driver = _RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return driver(inputs, initial_states=initial_states,
+                  sequence_length=sequence_length)

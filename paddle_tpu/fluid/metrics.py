@@ -1,0 +1,2 @@
+"""fluid.metrics facade (reference: fluid/metrics.py)."""
+from ..metric import *  # noqa: F401,F403

@@ -1,0 +1,2 @@
+"""fluid.param_attr facade (reference: fluid/param_attr.py)."""
+from ..param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401

@@ -1,0 +1,3 @@
+"""fluid.profiler facade (reference: fluid/profiler.py)."""
+from ..utils.profiler import (profiler, start_profiler,  # noqa: F401
+                              stop_profiler, reset_profiler, print_stats)

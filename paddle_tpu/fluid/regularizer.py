@@ -1,0 +1,18 @@
+"""fluid.regularizer facade (reference: fluid/regularizer.py)."""
+from ..regularizer import *  # noqa: F401,F403
+from ..regularizer import L1Decay, L2Decay, L1DecayRegularizer, \
+    L2DecayRegularizer  # noqa: F401
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    """reference regularizer.py:append_regularization_ops — functional
+    redesign: g += reg.grad_term(p) for each param (per-param regularizer
+    wins over the global one, like the reference)."""
+    out = []
+    for p, g in parameters_and_grads:
+        reg = getattr(p, "regularizer", None) or regularization
+        if reg is not None and g is not None and not getattr(
+                p, "stop_gradient", False):
+            g = g + reg.grad_term(p)
+        out.append((p, g))
+    return out

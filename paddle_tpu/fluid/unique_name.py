@@ -1,0 +1,42 @@
+"""fluid.unique_name (reference: fluid/unique_name.py) — process-wide
+unique name generator with guard() scoping."""
+import contextlib
+
+_counters = {}
+_prefix = [""]
+
+
+def generate(key):
+    k = _prefix[0] + key
+    _counters[k] = _counters.get(k, -1) + 1
+    return f"{k}_{_counters[k]}"
+
+
+def generate_with_ignorable_key(key):
+    return generate(key)
+
+
+def switch(new_generator=None):
+    """Swap the counter state out, returning the old snapshot; pass a
+    previously returned snapshot back in to restore it (the reference's
+    switch-out/switch-back idiom)."""
+    old = dict(_counters)
+    _counters.clear()
+    if isinstance(new_generator, dict):
+        _counters.update(new_generator)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old_c = dict(_counters)
+    old_p = _prefix[0]
+    _counters.clear()
+    if isinstance(new_generator, str):
+        _prefix[0] = new_generator
+    try:
+        yield
+    finally:
+        _counters.clear()
+        _counters.update(old_c)
+        _prefix[0] = old_p

@@ -379,3 +379,166 @@ def shuffle_reader(reader, buf_size, seed=None):
         rng.shuffle(buf)
         yield from buf
     return _reader
+
+
+# ---------------------------------------------------------------------------
+# static-graph persistable/parameter save+load family (reference:
+# fluid/io.py save_vars/save_params/save_persistables and the load side;
+# vars live in Program.param_vars + the optimizer slot state, stored one
+# .npy per var, or one pickle when `filename` is given)
+
+def is_parameter(var):
+    """reference io.py:is_parameter."""
+    from ..tensor import Parameter as _P
+    return isinstance(var, _P)
+
+
+def is_persistable(var):
+    """reference io.py:is_persistable — parameters and anything flagged
+    .persistable survive across Executor runs."""
+    return is_parameter(var) or bool(getattr(var, "persistable", False))
+
+
+def is_belong_to_optimizer(var):
+    """reference io.py:is_belong_to_optimizer — optimizer slot naming uses
+    'param@slot' here."""
+    name = getattr(var, "name", "") or ""
+    return "@" in name
+
+
+def get_program_parameter(program):
+    """reference io.py:get_program_parameter."""
+    return list(program.param_vars.values())
+
+
+def get_program_persistable_vars(program):
+    """reference io.py:get_program_persistable_vars."""
+    return [v for v in program.param_vars.values() if is_persistable(v)]
+
+
+def _default_program(main_program):
+    if main_program is not None:
+        return main_program
+    from ..static import default_main_program
+    return default_main_program()
+
+
+def _named_vars(program, vars=None, predicate=None):
+    if vars is not None:
+        return {getattr(v, "name", f"var_{i}"): v
+                for i, v in enumerate(vars)}
+    out = {}
+    for name, v in program.param_vars.items():
+        if predicate is None or predicate(v):
+            out[name] = v
+    return out
+
+
+def save_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference io.py:save_vars (executor is unused — no C++ scope to
+    drain; values are device-resident jax arrays)."""
+    program = _default_program(main_program)
+    named = _named_vars(program, vars, predicate)
+    os.makedirs(dirname, exist_ok=True)
+    if filename is not None:
+        save({k: v for k, v in named.items()},
+             os.path.join(dirname, filename))
+        return
+    for name, v in named.items():
+        arr = v.numpy() if hasattr(v, "numpy") else np.asarray(v)
+        np.save(os.path.join(dirname, name.replace("/", "_") + ".npy"),
+                arr)
+
+
+def save_params(executor=None, dirname=None, main_program=None,
+                filename=None):
+    """reference io.py:save_params."""
+    save_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    """reference io.py:save_persistables."""
+    save_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def load_vars(executor=None, dirname=None, main_program=None, vars=None,
+              predicate=None, filename=None):
+    """reference io.py:load_vars — writes values back into the program's
+    parameters in place."""
+    program = _default_program(main_program)
+    named = _named_vars(program, vars, predicate)
+    if filename is not None:
+        state = load(os.path.join(dirname, filename))
+    else:
+        state = {}
+        for name in named:
+            p = os.path.join(dirname, name.replace("/", "_") + ".npy")
+            if os.path.exists(p):
+                state[name] = np.load(p)
+    set_program_state(program, state, _named=named)
+
+
+def load_params(executor=None, dirname=None, main_program=None,
+                filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_parameter,
+              filename=filename)
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    load_vars(executor, dirname, main_program, predicate=is_persistable,
+              filename=filename)
+
+
+def load_program_state(model_path, var_list=None):
+    """reference io.py:load_program_state — returns {name: ndarray} from a
+    save_params/save_persistables directory (or its single-file form)."""
+    state = {}
+    if os.path.isfile(model_path):
+        return {k: np.asarray(v) for k, v in load(model_path).items()}
+    for fn in sorted(os.listdir(model_path)):
+        if fn.endswith(".npy"):
+            state[fn[:-4]] = np.load(os.path.join(model_path, fn))
+    if var_list is not None:
+        # keys on disk are '/'-mangled (save_vars name.replace('/', '_'))
+        want = {str(getattr(v, "name", v)).replace("/", "_")
+                for v in var_list}
+        state = {k: v for k, v in state.items() if k in want}
+    return state
+
+
+def set_program_state(program, state_dict, _named=None):
+    """reference io.py:set_program_state — in-place assignment into the
+    program's parameters."""
+    named = _named if _named is not None else dict(program.param_vars)
+    for name, v in named.items():
+        key = name.replace("/", "_")
+        val = state_dict.get(name, state_dict.get(key))
+        if val is None:
+            continue
+        arr = val.numpy() if hasattr(val, "numpy") else np.asarray(val)
+        v.set_value(arr)
+
+
+def get_parameter_value(para, executor=None):
+    """reference io.py:get_parameter_value."""
+    return para.numpy()
+
+
+def get_parameter_value_by_name(name, executor=None, program=None):
+    """reference io.py:get_parameter_value_by_name."""
+    program = _default_program(program)
+    return program.param_vars[name].numpy()
+
+
+def prepend_feed_ops(*a, **kw):
+    """reference io.py:prepend_feed_ops — the jitted executor feeds
+    arguments directly; nothing to prepend."""
+
+
+def append_fetch_ops(*a, **kw):
+    """reference io.py:append_fetch_ops — fetches are jit outputs here."""

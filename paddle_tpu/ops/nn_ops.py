@@ -115,6 +115,13 @@ def softshrink(x, threshold=0.5, name=None):
                  (x,), dict(t=threshold), name="softshrink")
 
 
+def hard_shrink(x, threshold=0.5, name=None):
+    """reference: layers/ops.py:113 hard_shrink."""
+    t = 0.5 if threshold is None else threshold
+    return apply(lambda x, t: jnp.where(jnp.abs(x) > t, x, 0.0), (x,),
+                 dict(t=t), name="hard_shrink")
+
+
 def hardtanh(x, min=-1.0, max=1.0, name=None):
     return apply(lambda x, lo, hi: jnp.clip(x, lo, hi), (x,),
                  dict(lo=min, hi=max), name="hardtanh")
