@@ -77,3 +77,104 @@ mixed_precision = types.SimpleNamespace(
 
 slim = types.SimpleNamespace(quantization=_quantization)
 quantize = _quantization
+
+
+# --- contrib.layers + utility submodules (reference: contrib/__init__.py
+# star-exports every submodule) ---------------------------------------------
+
+from . import contrib_layers as layers  # noqa: E402
+from .contrib_layers import (  # noqa: F401,E402
+    fused_elemwise_activation, shuffle_batch, partial_concat, partial_sum,
+    batch_fc, match_matrix_tensor, sequence_topk_avg_pooling, var_conv_2d,
+    fused_embedding_seq_pool, multiclass_nms2, tree_conv,
+    search_pyramid_hash, rank_attention, tdm_child, tdm_sampler,
+    basic_gru, basic_lstm, BasicGRUUnit, BasicLSTMUnit, ctr_metric_bundle)
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """reference contrib/extend_optimizer: returns a subclass of
+    base_optimizer whose minimize applies DECOUPLED weight decay
+    (p -= lr*coeff*p after the base update) — the AdamW construction."""
+    class DecoupledWeightDecay(base_optimizer):
+        def __init__(self, weight_decay=0.0, *args, **kw):
+            self._decoupled_wd = float(weight_decay) if not hasattr(
+                weight_decay, "coeff") else weight_decay.coeff
+            super().__init__(*args, **kw)
+
+        def _rule(self, p, g, slots, lr):
+            new_p, new_slots = super()._rule(p, g, slots, lr)
+            new_p = new_p - lr * self._decoupled_wd * p
+            return new_p, new_slots
+
+    DecoupledWeightDecay.__name__ = (
+        base_optimizer.__name__ + "DecoupledWeightDecay")
+    return DecoupledWeightDecay
+
+
+def op_freq_statistic(program):
+    """reference contrib/op_frequence.py:op_freq_statistic — (uni, pair)
+    op-type frequency counters over the recorded graph."""
+    from collections import Counter, OrderedDict
+    uni = Counter()
+    adj = Counter()
+    for block in program.blocks:
+        prev = None
+        for op in block.ops:
+            uni[op.type or "unknown"] += 1
+            if prev is not None:
+                adj[f"{prev}->{op.type}"] += 1
+            prev = op.type
+    return (OrderedDict(uni.most_common()), OrderedDict(adj.most_common()))
+
+
+def memory_usage(program, batch_size=1):
+    """reference contrib/memory_usage_calc.py:memory_usage — lower/upper
+    estimate (MB) from the program's var shapes with None/-1 dims filled
+    by batch_size."""
+    import numpy as _np
+    total = 0.0
+    for block in program.blocks:
+        for var in block.vars.values():
+            shape = getattr(var, "shape", None)
+            if not shape:
+                continue
+            n = 1
+            for d in shape:
+                n *= batch_size if (d is None or d < 0) else d
+            dt = str(getattr(var, "dtype", "float32"))
+            total += n * _np.dtype(dt if dt != "bfloat16" else "u2"
+                                   ).itemsize
+    for name, p in program.param_vars.items():
+        total += p.data.nbytes  # metadata only — no device-to-host copy
+    mb = total / (1 << 20)
+    return mb * 0.9, mb * 1.1
+
+
+def summary(main_prog):
+    """reference contrib/model_stat.py:summary — PARAMs/FLOPs table over
+    the recorded static program; returns the table string (and prints)."""
+    rows = []
+    total_params = 0
+    for name, p in main_prog.param_vars.items():
+        n = int(p.data.size)  # metadata only — no device-to-host copy
+        total_params += n
+        rows.append((name, tuple(p.data.shape), n))
+    lines = ["%-40s %-20s %12s" % ("param", "shape", "count"),
+             "-" * 74]
+    for r in rows:
+        lines.append("%-40s %-20s %12d" % (r[0], str(r[1]), r[2]))
+    lines.append("-" * 74)
+    op_counts, _ = op_freq_statistic(main_prog)
+    lines.append(f"total params: {total_params:,}")
+    lines.append("ops: " + ", ".join(f"{k}x{v}"
+                                     for k, v in list(op_counts.items())[:12]))
+    table = "\n".join(lines)
+    print(table)
+    return table
+
+
+model_stat = types.SimpleNamespace(summary=summary)
+memory_usage_calc = types.SimpleNamespace(memory_usage=memory_usage)
+op_frequence = types.SimpleNamespace(op_freq_statistic=op_freq_statistic)
+extend_optimizer = types.SimpleNamespace(
+    extend_with_decoupled_weight_decay=extend_with_decoupled_weight_decay)

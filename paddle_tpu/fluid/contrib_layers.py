@@ -1,0 +1,436 @@
+"""fluid.contrib.layers — the contrib op surface.
+
+TPU-native rebuild of reference python/paddle/fluid/contrib/layers/
+{nn.py, rnn_impl.py, metric_op.py}. LoD inputs become padded [B, T, ...]
+(+ optional lengths); everything lowers to plain jax, fusable under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..dispatch import apply
+from .. import ops
+from .. import initializer as I
+from ..tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# reference contrib/layers/nn.py
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """reference contrib/layers/nn.py:fused_elemwise_activation — composes
+    a binary elementwise op with a unary activation (the reference needed
+    a fused CUDA kernel; XLA fuses the jnp chain for free)."""
+    uns = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "tanh": jnp.tanh, "scale": lambda v: v * scale,
+           "identity": lambda v: v}
+    bins = {"elementwise_add": jnp.add, "elementwise_mul": jnp.multiply,
+            "elementwise_sub": jnp.subtract}
+
+    def impl(x, y):
+        f0, f1 = functor_list
+        if f0 in bins:
+            return uns[f1](bins[f0](x, y))
+        return bins[f1](uns[f0](x), y)
+
+    return apply(impl, (x, y), name="fused_elemwise_activation")
+
+
+def shuffle_batch(x, seed=None):
+    """reference contrib/layers/nn.py:shuffle_batch — random row permute."""
+    from .. import random as prandom
+
+    def impl(x, key):
+        perm = jax.random.permutation(
+            jax.random.wrap_key_data(key) if key.dtype == jnp.uint32
+            else key, x.shape[0])
+        return x[perm]
+
+    return apply(impl, (x, prandom.next_key_graph()), name="shuffle_batch")
+
+
+def partial_concat(input, start_index=0, length=-1):
+    """reference: partial_concat — concat a column slice of each input."""
+    def impl(*xs):
+        outs = []
+        for x in xs:
+            stop = x.shape[1] if length < 0 else start_index + length
+            outs.append(x[:, start_index:stop])
+        return jnp.concatenate(outs, axis=1)
+
+    return apply(impl, tuple(input), name="partial_concat")
+
+
+def partial_sum(input, start_index=0, length=-1):
+    """reference: partial_sum."""
+    def impl(*xs):
+        acc = None
+        for x in xs:
+            stop = x.shape[1] if length < 0 else start_index + length
+            s = x[:, start_index:stop]
+            acc = s if acc is None else acc + s
+        return acc
+
+    return apply(impl, tuple(input), name="partial_sum")
+
+
+def batch_fc(input, param_size, param_attr=None, bias_size=None,
+             bias_attr=None, act=None):
+    """reference: batch_fc — per-slot fc: input [S, B, D] × w [S, D, O]."""
+    from .layers import _param, _act
+    w = _param(param_attr, tuple(param_size), "float32", I.XavierUniform())
+    b = _param(bias_attr, tuple(bias_size), "float32", I.Constant(0.0),
+               is_bias=True) if bias_size else None
+
+    def impl(x, w, *mb):
+        out = jnp.einsum("sbd,sdo->sbo", x, w)
+        if mb:
+            out = out + mb[0]
+        return out
+
+    args = (input, w) if b is None else (input, w, b)
+    return _act(apply(impl, args, name="batch_fc"), act)
+
+
+def match_matrix_tensor(x, y, channel_num, act=None, param_attr=None,
+                        dtype="float32", x_len=None, y_len=None):
+    """reference: match_matrix_tensor — interaction tensor for text
+    matching: out[b,t,i,j] = x[b,i]·W_t·y[b,j]. Padded redesign of the
+    LoD op; returns (out [B, C, Lx, Ly], tmp)."""
+    from .layers import _param, _act
+    D1 = x.shape[-1]
+    D2 = y.shape[-1]
+    w = _param(param_attr, (D1, channel_num, D2), dtype, I.XavierUniform())
+
+    def impl(x, y, w):
+        tmp = jnp.einsum("bid,dce->bice", x, w)
+        out = jnp.einsum("bice,bje->bcij", tmp, y)
+        return out, tmp.reshape(x.shape[0], x.shape[1], -1)
+
+    out, tmp = apply(impl, (x, y, w), n_out=2, name="match_matrix_tensor")
+    return _act(out, act), tmp
+
+
+def sequence_topk_avg_pooling(input, row, col, topks, channel_num):
+    """reference: sequence_topk_avg_pooling — for each channel of a
+    [B, C, Lx, Ly] interaction map, average the top-k values per row.
+    Returns [B, Lx, C*len(topks)] (padded redesign)."""
+    def impl(x):
+        k_max = max(topks)
+        kk = min(k_max, x.shape[-1])
+        top = jax.lax.top_k(x, kk)[0]          # [B, C, Lx, kk]
+        feats = []
+        for k in topks:
+            k_eff = min(k, kk)
+            feats.append(jnp.mean(top[..., :k_eff], axis=-1))  # [B, C, Lx]
+        out = jnp.stack(feats, axis=-1)         # [B, C, Lx, K]
+        return jnp.transpose(out, (0, 2, 1, 3)).reshape(
+            x.shape[0], x.shape[2], -1)
+
+    return apply(impl, (input,), name="sequence_topk_avg_pooling")
+
+
+def var_conv_2d(input, row, col, input_channel, output_channel, filter_size,
+                stride=1, param_attr=None, act=None, dtype="float32"):
+    """reference: var_conv_2d — conv over variable-size feature maps; the
+    padded redesign runs one dense conv and relies on masked inputs (zero
+    padding) like every other padded op here."""
+    from .layers import _param, _act
+    from ..ops.nn_ops import conv2d as _conv
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else (
+        filter_size, filter_size)
+    w = _param(param_attr, (output_channel, input_channel, ks[0], ks[1]),
+               dtype, I.XavierUniform())
+    out = _conv(input, w, stride=stride, padding=(ks[0] // 2, ks[1] // 2))
+    return _act(out, act)
+
+
+def fused_embedding_seq_pool(input, size, is_sparse=False,
+                             padding_idx=None, combiner="sum",
+                             param_attr=None, dtype="float32"):
+    """reference: fused_embedding_seq_pool — embedding lookup + sequence
+    pool in one op (one gather + one segment reduction under XLA)."""
+    from .layers import _param
+    w = _param(param_attr, tuple(size), dtype,
+               I.Normal(0.0, 1.0 / np.sqrt(size[1])))
+
+    def impl(ids, w):
+        ids2 = ids.reshape(ids.shape[0], -1)
+        emb = w[jnp.clip(ids2, 0, w.shape[0] - 1)]
+        if padding_idx is not None:
+            emb = jnp.where((ids2 == padding_idx)[..., None], 0.0, emb)
+        if combiner == "mean":
+            return jnp.mean(emb, axis=1)
+        return jnp.sum(emb, axis=1)
+
+    return apply(impl, (input, w), name="fused_embedding_seq_pool")
+
+
+def multiclass_nms2(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                    background_label=0, return_index=False, name=None):
+    """reference contrib:multiclass_nms2 — multiclass_nms that also
+    returns the selected indices."""
+    from ..ops.detection import multiclass_nms
+    return multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold=nms_threshold,
+                          normalized=normalized, nms_eta=nms_eta,
+                          background_label=background_label,
+                          return_index=return_index)
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference contrib:tree_conv — functional form over nn.TreeConv."""
+    from ..nn.layers import TreeConv as _TC
+    layer = _TC(feature_size=nodes_vector.shape[-1],
+                output_size=output_size, num_filters=num_filters,
+                max_depth=max_depth, act=act)
+    return layer(nodes_vector, edge_set)
+
+
+def search_pyramid_hash(input, num_emb, space_len, pyramid_layer, rand_len,
+                        drop_out_percent, is_training, use_filter,
+                        white_list_len, black_list_len, seed, lr,
+                        param_attr=None, param_attr_wl=None,
+                        param_attr_bl=None, name=None,
+                        distribute_update_vars=None, dtype="float32"):
+    """reference contrib:search_pyramid_hash — pyramid n-gram hash
+    embedding: each n-gram (n = 2..pyramid_layer+1) hashes into a shared
+    1-D parameter space and the pieces average. Redesign: fixed FNV-style
+    integer hashing on device (no murmur C++ dep), dense [B, T] ids."""
+    from .layers import _param
+    table = _param(param_attr, (space_len,), dtype, I.XavierUniform())
+
+    def impl(ids, table):
+        ids2 = ids.reshape(ids.shape[0], -1).astype(jnp.uint32)
+        B, T = ids2.shape
+        pooled = jnp.zeros((B, num_emb), table.dtype)
+        count = 0
+        for n in range(2, pyramid_layer + 2):
+            if T < n:
+                break
+            # rolling n-gram hash
+            h = jnp.zeros((B, T - n + 1), jnp.uint32)
+            for k in range(n):
+                h = (h * jnp.uint32(16777619)) ^ ids2[:, k:T - n + 1 + k]
+            # each hash addresses a num_emb-length slice of the table
+            base = (h % jnp.uint32(max(space_len - num_emb, 1))
+                    ).astype(jnp.int32)
+            idx = base[:, :, None] + jnp.arange(num_emb)[None, None]
+            pooled = pooled + jnp.sum(table[idx], axis=1)
+            count += h.shape[1]
+        return pooled / jnp.maximum(count, 1)
+
+    return apply(impl, (input, table), name="search_pyramid_hash")
+
+
+def rank_attention(input, rank_offset, rank_param_shape, rank_param_attr,
+                   max_rank=3, max_size=0):
+    """reference contrib:rank_attention (CTR): each sample has a rank id;
+    its feature goes through the weight block selected by (its rank, other
+    rank) pairs encoded in rank_offset [B, 1+2*max_rank]. Redesign keeps
+    the published semantics: out = x @ W[sel] summed over valid pairs."""
+    from .layers import _param
+    w = _param(rank_param_attr, tuple(rank_param_shape), "float32",
+               I.XavierUniform())
+
+    def impl(x, ro, w):
+        D = x.shape[1]
+        nblk = w.shape[0] // D
+        wb = w.reshape(nblk, D, -1)
+        out = jnp.zeros((x.shape[0], wb.shape[-1]), x.dtype)
+        valid_total = jnp.zeros((x.shape[0], 1), x.dtype)
+        for k in range(max_rank):
+            idx = ro[:, 1 + 2 * k]
+            valid = (idx >= 0)
+            blk = jnp.clip(idx, 0, nblk - 1)
+            contrib = jnp.einsum("bd,bdo->bo", x, wb[blk])
+            out = out + jnp.where(valid[:, None], contrib, 0.0)
+            valid_total = valid_total + valid[:, None].astype(x.dtype)
+        return out / jnp.maximum(valid_total, 1.0)
+
+    return apply(impl, (input, rank_offset, w), name="rank_attention")
+
+
+def tdm_child(x, node_nums, child_nums, param_attr=None, dtype="int32"):
+    """reference contrib:tdm_child — gather each node's children from the
+    tree-info table: info[node] = [item_id, layer, parent, child...]."""
+    from .layers import _param
+    info = _param(param_attr, (node_nums, 3 + child_nums), "int32",
+                  I.Constant(0))
+
+    def impl(x, info):
+        ids = x.reshape(-1).astype(jnp.int32)
+        rows = info[jnp.clip(ids, 0, info.shape[0] - 1)]
+        child = rows[:, 3:3 + child_nums]
+        # leaf = a real child (id != 0) whose own layer field is 0
+        child_layer = info[jnp.clip(child, 0, info.shape[0] - 1), 1]
+        leaf_mask = ((child_layer == 0) & (child != 0)).astype(jnp.int32)
+        shape = x.shape + (child_nums,)
+        return child.reshape(shape), leaf_mask.reshape(shape)
+
+    return apply(impl, (x, info), n_out=2, name="tdm_child")
+
+
+def tdm_sampler(x, neg_samples_num_list, layer_node_num_list, leaf_node_num,
+                tree_travel_attr=None, tree_layer_attr=None,
+                output_positive=True, output_list=True, seed=0,
+                tree_dtype="int32", dtype="int32"):
+    """reference contrib:tdm_sampler — per tree layer, emit the positive
+    travel node plus N uniform negative samples from that layer."""
+    from .layers import _param
+    from .. import random as prandom
+    n_layer = len(layer_node_num_list)
+    travel = _param(tree_travel_attr, (leaf_node_num, n_layer), "int32",
+                    I.Constant(0))
+    layer_sizes = list(layer_node_num_list)
+    total_layer_nodes = sum(layer_sizes)
+    layer_tab = _param(tree_layer_attr, (total_layer_nodes,), "int32",
+                       I.Constant(0))
+
+    def impl(x, travel, layer_tab, key):
+        ids = x.reshape(-1).astype(jnp.int32)
+        B = ids.shape[0]
+        outs, labels, masks = [], [], []
+        off = 0
+        k = jax.random.wrap_key_data(key) if key.dtype == jnp.uint32 \
+            else key
+        for li, ln in enumerate(layer_sizes):
+            pos = travel[jnp.clip(ids, 0, travel.shape[0] - 1), li]
+            neg_n = neg_samples_num_list[li]
+            k, sub = jax.random.split(k)
+            neg_ix = jax.random.randint(sub, (B, neg_n), 0, ln)
+            neg = layer_tab[off + neg_ix]
+            off += ln
+            if output_positive:
+                o = jnp.concatenate([pos[:, None], neg], axis=1)
+                lab = jnp.concatenate(
+                    [jnp.ones((B, 1), jnp.int32),
+                     jnp.zeros((B, neg_n), jnp.int32)], axis=1)
+            else:
+                o, lab = neg, jnp.zeros((B, neg_n), jnp.int32)
+            outs.append(o)
+            labels.append(lab)
+            masks.append(jnp.ones_like(lab))
+        if output_list:
+            return tuple(outs) + tuple(labels) + tuple(masks)
+        return (jnp.concatenate(outs, 1), jnp.concatenate(labels, 1),
+                jnp.concatenate(masks, 1))
+
+    n_out = 3 * n_layer if output_list else 3
+    return apply(impl, (x, travel, layer_tab, prandom.next_key_graph()),
+                 n_out=n_out, name="tdm_sampler")
+
+
+# ---------------------------------------------------------------------------
+# reference contrib/layers/rnn_impl.py
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """reference contrib/layers/rnn_impl.py:164 basic_gru — stacked
+    (bi)GRU over the nn.GRU driver; returns (rnn_out, last_hidden)."""
+    from ..nn.rnn import GRU as _GRU
+    x = input if batch_first else ops.transpose(input, [1, 0, 2])
+    g = _GRU(int(x.shape[-1]), hidden_size, num_layers=num_layers,
+             direction="bidirect" if bidirectional else "forward")
+    out, finals = g(x, initial_states=init_hidden,
+                    sequence_length=sequence_length)
+    # finals: per-layer h (or (h_fw, h_bw)); stack to [L*dirs, B, H]
+    hs = []
+    for f in finals:
+        hs.extend(list(f) if isinstance(f, (tuple, list)) else [f])
+    last_hidden = ops.stack(hs, axis=0)
+    if not batch_first:
+        out = ops.transpose(out, [1, 0, 2])
+    return out, last_hidden
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, forget_bias=1.0,
+               dtype="float32", name="basic_lstm"):
+    """reference contrib/layers/rnn_impl.py:405 basic_lstm."""
+    from ..nn.rnn import LSTM as _LSTM
+    x = input if batch_first else ops.transpose(input, [1, 0, 2])
+    m = _LSTM(int(x.shape[-1]), hidden_size, num_layers=num_layers,
+              direction="bidirect" if bidirectional else "forward")
+    states = None
+    if init_hidden is not None and init_cell is not None:
+        states = (init_hidden, init_cell)
+    out, finals = m(x, initial_states=states,
+                    sequence_length=sequence_length)
+    # finals: per-layer (h, c) (or ((h,c)_fw, (h,c)_bw))
+    hs, cs = [], []
+    for f in finals:
+        if isinstance(f[0], (tuple, list)):   # bidirectional
+            for d in f:
+                hs.append(d[0])
+                cs.append(d[1])
+        else:
+            hs.append(f[0])
+            cs.append(f[1])
+    if not batch_first:
+        out = ops.transpose(out, [1, 0, 2])
+    return out, ops.stack(hs, axis=0), ops.stack(cs, axis=0)
+
+
+class BasicGRUUnit:
+    """reference contrib/layers/rnn_impl.py:25 — one GRU step (class
+    form); thin over nn.GRUCell."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        self._hidden = hidden_size
+        self._cell = None
+
+    def __call__(self, input, pre_hidden):
+        from ..nn.rnn import GRUCell
+        if self._cell is None:
+            self._cell = GRUCell(int(input.shape[-1]), self._hidden)
+        out, _ = self._cell(input, pre_hidden)
+        return out
+
+
+class BasicLSTMUnit:
+    """reference contrib/layers/rnn_impl.py:699 — one LSTM step."""
+
+    def __init__(self, name_scope=None, hidden_size=None, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        self._hidden = hidden_size
+        self._cell = None
+
+    def __call__(self, input, pre_hidden, pre_cell):
+        from ..nn.rnn import LSTMCell
+        if self._cell is None:
+            self._cell = LSTMCell(int(input.shape[-1]), self._hidden)
+        out, (h, c) = self._cell(input, (pre_hidden, pre_cell))
+        return h, c
+
+
+# ---------------------------------------------------------------------------
+# reference contrib/layers/metric_op.py
+
+def ctr_metric_bundle(input, label):
+    """reference contrib/layers/metric_op.py:30 — returns (local_sqrerr,
+    local_abserr, local_prob, local_q) accumulators for distributed CTR
+    eval."""
+    def impl(p, y):
+        y = y.astype(p.dtype)
+        sq = jnp.sum(jnp.square(p - y))
+        ab = jnp.sum(jnp.abs(p - y))
+        prob = jnp.sum(p)
+        q = jnp.sum(y)
+        return sq, ab, prob, q
+
+    return apply(impl, (input, label), n_out=4, name="ctr_metric_bundle")

@@ -264,17 +264,39 @@ class _MultiLayerRNN(Layer):
         self.cells_fw = LayerList(cells_fw)
         self.cells_bw = LayerList(cells_bw) if self.bidirectional else None
 
+    def _layer_init(self, initial_states, li, d):
+        """Slice the [num_layers*dirs, B, ...] initial-state convention
+        (paddle.nn.LSTM/GRU) down to one direction of one layer."""
+        if initial_states is None:
+            return None
+        dirs = 2 if self.bidirectional else 1
+        idx = li * dirs + d
+        lead = initial_states[0].shape[0] if self.mode == "LSTM" else \
+            initial_states.shape[0]
+        if lead != self.num_layers * dirs:
+            # jax indexing would CLAMP an OOB layer index and silently
+            # reuse layer 0's state — fail loudly like the reference
+            raise ValueError(
+                f"initial_states leading dim {lead} != num_layers*dirs "
+                f"({self.num_layers}*{dirs})")
+        if self.mode == "LSTM":
+            h0, c0 = initial_states
+            return (h0[idx], c0[idx])
+        return initial_states[idx]
+
     def forward(self, inputs, initial_states=None, sequence_length=None):
         from ..ops import manip as M
         x = inputs
         finals = []
         for li in range(self.num_layers):
             fw = RNN(self.cells_fw[li], time_major=self.time_major)
-            y_fw, s_fw = fw(x, sequence_length=sequence_length)
+            y_fw, s_fw = fw(x, initial_states=self._layer_init(
+                initial_states, li, 0), sequence_length=sequence_length)
             if self.bidirectional:
                 bw = RNN(self.cells_bw[li], is_reverse=True,
                          time_major=self.time_major)
-                y_bw, s_bw = bw(x, sequence_length=sequence_length)
+                y_bw, s_bw = bw(x, initial_states=self._layer_init(
+                    initial_states, li, 1), sequence_length=sequence_length)
                 x = M.concat([y_fw, y_bw], axis=-1)
                 finals.append((s_fw, s_bw))
             else:
