@@ -69,3 +69,57 @@ def disable_static():
 
 def in_dynamic_mode():
     return not in_static_mode()
+
+
+# reference python/paddle/__init__.py top-level name parity tail
+def _reduce_alias(fn):
+    # reference reduce_* signature uses dim/keep_dim keywords
+    def f(input, dim=None, keep_dim=False, name=None):
+        return fn(input, axis=dim, keepdim=keep_dim)
+    f.__name__ = "reduce_" + fn.__name__
+    return f
+
+
+reduce_sum = _reduce_alias(ops.sum)
+reduce_mean = _reduce_alias(ops.mean)
+reduce_max = _reduce_alias(ops.max)
+reduce_min = _reduce_alias(ops.min)
+reduce_prod = _reduce_alias(ops.prod)
+reduce_all = _reduce_alias(ops.all)
+reduce_any = _reduce_alias(ops.any)
+manual_seed = seed
+shuffle = reader.shuffle
+
+
+def in_dygraph_mode():
+    """reference fluid framework.py:in_dygraph_mode."""
+    return not in_static_mode()
+
+
+def enable_dygraph(place=None):
+    if in_static_mode():
+        disable_static()
+
+
+def disable_dygraph():
+    if not in_static_mode():
+        enable_static()
+
+
+def save(obj, path, protocol=4):
+    """paddle.save → io.save."""
+    from . import io as _io
+    return _io.save(obj, path, protocol=protocol)
+
+
+def load(path, **kw):
+    """paddle.load → io.load. Unsupported options raise rather than
+    silently changing semantics."""
+    if kw:
+        raise ValueError(f"paddle_tpu.load: unsupported options {set(kw)}")
+    from . import io as _io
+    return _io.load(path)
+
+
+from . import hapi  # noqa: E402  (high-level Model API)
+from . import incubate  # noqa: E402

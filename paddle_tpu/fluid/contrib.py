@@ -178,3 +178,151 @@ memory_usage_calc = types.SimpleNamespace(memory_usage=memory_usage)
 op_frequence = types.SimpleNamespace(op_freq_statistic=op_freq_statistic)
 extend_optimizer = types.SimpleNamespace(
     extend_with_decoupled_weight_decay=extend_with_decoupled_weight_decay)
+
+
+# --- contrib.decoder / contrib.reader / contrib.utils -----------------------
+
+def distributed_batch_reader(batch_reader):
+    """reference contrib/reader/distributed_reader.py — shard a batch
+    reader across trainers (env PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM,
+    same contract as the reference)."""
+    import os as _os
+
+    def _impl():
+        rank = int(_os.environ.get("PADDLE_TRAINER_ID", 0))
+        world = int(_os.environ.get("PADDLE_TRAINERS_NUM", 1))
+        for i, batch in enumerate(batch_reader()):
+            if i % world == rank:
+                yield batch
+
+    return _impl
+
+
+class InitState:
+    """reference contrib/decoder/beam_search_decoder.py:InitState."""
+
+    def __init__(self, init=None, shape=None, value=0.0, init_boot=None,
+                 need_reorder=False, dtype="float32"):
+        self.init = init if init is not None else init_boot
+        self.shape = shape
+        self.value = value
+        self.dtype = dtype
+
+
+class StateCell:
+    """reference contrib/decoder:StateCell — named-state step cell. The
+    redesign keeps the dict-of-states + compute_state/update_states
+    protocol; the heavy lifting (beam bookkeeping) lives in
+    nn.decode.BeamSearchDecoder."""
+
+    def __init__(self, inputs, states, out_state, name=None):
+        self._inputs = dict(inputs or {})
+        self._states = {}
+        for k, v in (states or {}).items():
+            init = getattr(v, "init", v)
+            if init is None and getattr(v, "shape", None) is not None:
+                import numpy as _np
+                from ..tensor import Tensor as _T
+                init = _T(_np.full(tuple(v.shape), v.value,
+                                   dtype=v.dtype))
+            self._states[k] = init
+        self._out_state = out_state
+        self._updater = None
+
+    def state_updater(self, fn):
+        self._updater = fn
+        return fn
+
+    def get_state(self, name):
+        return self._states[name]
+
+    def set_state(self, name, value):
+        self._states[name] = value
+
+    def get_input(self, name):
+        return self._inputs[name]
+
+    def set_input(self, name, value):
+        self._inputs[name] = value
+
+    def compute_state(self, inputs):
+        self._inputs.update(inputs)
+        if self._updater is not None:
+            self._updater(self)
+
+    def update_states(self):
+        pass  # states already updated in-place by the updater
+
+    def out_state(self):
+        return self._states[self._out_state]
+
+
+class TrainingDecoder:
+    """reference contrib/decoder:TrainingDecoder — teacher-forced decode
+    loop over a StateCell (padded redesign: python loop over T under
+    trace, one fused computation under to_static)."""
+
+    def __init__(self, state_cell, name=None):
+        self.state_cell = state_cell
+        self._outputs = []   # list of per-step tuples
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def g():
+            yield self
+        return g()
+
+    def step_input(self, x):
+        return x
+
+    def static_input(self, x):
+        return x
+
+    def output(self, *outputs):
+        self._outputs.append(tuple(outputs))
+
+    def __call__(self):
+        from .. import ops as _ops
+        n_streams = len(self._outputs[0])
+        stacked = tuple(
+            _ops.stack([step[i] for step in self._outputs], axis=1)
+            if len(self._outputs) > 1 else self._outputs[0][i]
+            for i in range(n_streams))
+        return stacked[0] if n_streams == 1 else stacked
+
+
+from ..nn.decode import BeamSearchDecoder as _NNBeam  # noqa: E402
+
+
+class ContribBeamSearchDecoder(_NNBeam):
+    """reference contrib/decoder:BeamSearchDecoder — same algorithm as
+    nn.decode.BeamSearchDecoder (gather/top-k over a [batch, beam]
+    lattice); alias with the contrib name."""
+
+
+decoder = types.SimpleNamespace(
+    InitState=InitState, StateCell=StateCell,
+    TrainingDecoder=TrainingDecoder,
+    BeamSearchDecoder=ContribBeamSearchDecoder)
+reader = types.SimpleNamespace(
+    distributed_batch_reader=distributed_batch_reader)
+
+
+def _hdfs_stub(name):
+    def f(*a, **kw):
+        raise RuntimeError(
+            f"contrib.utils.{name}: HDFS access is environment-specific "
+            "(reference contrib/utils/hdfs_utils.py shells out to the "
+            "hadoop CLI); wire your storage into io.DataLoader/dataset "
+            "readers instead")
+    f.__name__ = name
+    return f
+
+
+utils = types.SimpleNamespace(
+    HDFSClient=_hdfs_stub("HDFSClient"),
+    multi_download=_hdfs_stub("multi_download"),
+    multi_upload=_hdfs_stub("multi_upload"),
+)

@@ -207,6 +207,14 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100):
                            reduction="none")
 
 
+def expand(x, expand_times, name=None):
+    """reference layers/nn.py:expand — fluid-era semantics: TILE each dim
+    by expand_times (the 2.x `paddle.expand` broadcast-to-shape op is
+    ops.manip.expand; this facade shadows the star-import with the
+    fluid behavior ported code expects)."""
+    return ops.tile(x, expand_times)
+
+
 def cross_entropy2(input, label, ignore_index=-100):
     """reference: layers/loss.py:263 cross_entropy2 — same hard-label CE
     over probabilities as cross_entropy, the op variant that also matched

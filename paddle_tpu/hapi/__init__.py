@@ -1,0 +1,16 @@
+"""paddle_tpu.hapi — the high-level Model API.
+
+TPU-native rebuild of reference python/paddle/incubate/hapi: Model
+(prepare/fit/evaluate/predict/save/load), callbacks, hapi losses and
+metrics. The train/eval steps compile to single donated XLA executables
+via jit.to_static, so `fit` runs one fused computation per batch.
+"""
+from .model import Model, Input, set_device  # noqa: F401
+from .callbacks import (Callback, ProgBarLogger, ModelCheckpoint,  # noqa
+                        EarlyStopping)
+from .loss import Loss, CrossEntropy, SoftmaxWithCrossEntropy  # noqa: F401
+from .metrics import Metric, Accuracy  # noqa: F401
+from . import model  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import loss  # noqa: F401
+from . import metrics  # noqa: F401

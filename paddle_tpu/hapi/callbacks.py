@@ -1,0 +1,168 @@
+"""hapi callbacks (reference: incubate/hapi/callbacks.py — Callback,
+ProgBarLogger, ModelCheckpoint; EarlyStopping is the 2.x-era addition the
+API grew into)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class Callback:
+    """reference hapi/callbacks.py:Callback — hook points around fit."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = dict(params or {})
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks, model, params):
+        self.callbacks = list(callbacks or [])
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+
+    def call(self, name, *args, **kw):
+        for c in self.callbacks:
+            getattr(c, name)(*args, **kw)
+
+
+class ProgBarLogger(Callback):
+    """reference hapi/callbacks.py:ProgBarLogger — per-epoch line logger
+    (plain-line redesign of the carriage-return progressbar: friendlier
+    to captured logs)."""
+
+    def __init__(self, log_freq=10, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+        self._seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        logs = logs or {}
+        self._seen += logs.get("batch_size", 1)
+        if self.verbose and self.log_freq and \
+                (step + 1) % self.log_freq == 0:
+            items = ", ".join(f"{k}={self._fmt(v)}"
+                              for k, v in logs.items()
+                              if k != "batch_size")
+            print(f"epoch {self._epoch} step {step + 1}: {items}",
+                  flush=True)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not self.verbose:
+            return
+        dt = time.time() - self._t0
+        items = ", ".join(f"{k}={self._fmt(v)}"
+                          for k, v in (logs or {}).items()
+                          if k != "batch_size")
+        print(f"epoch {epoch} done in {dt:.1f}s: {items}", flush=True)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            items = ", ".join(f"{k}={self._fmt(v)}"
+                              for k, v in (logs or {}).items()
+                              if k != "batch_size")
+            print(f"eval: {items}", flush=True)
+
+    @staticmethod
+    def _fmt(v):
+        if isinstance(v, (list, tuple, np.ndarray)):
+            return "[" + ", ".join(f"{float(x):.4f}" for x in
+                                   np.ravel(v)) + "]"
+        try:
+            return f"{float(v):.4f}"
+        except (TypeError, ValueError):
+            return str(v)
+
+
+class ModelCheckpoint(Callback):
+    """reference hapi/callbacks.py:ModelCheckpoint — save every
+    save_freq epochs into save_dir/{epoch} and save_dir/final."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and (epoch + 1) % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/{epoch}")
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(f"{self.save_dir}/final")
+
+
+class EarlyStopping(Callback):
+    """Stop fit() when a monitored metric stops improving."""
+
+    def __init__(self, monitor="loss", patience=0, min_delta=0.0,
+                 mode="min"):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stopped = False
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(np.ravel(cur)[0])
+        better = (self.best is None or
+                  (cur < self.best - self.min_delta
+                   if self.mode == "min"
+                   else cur > self.best + self.min_delta))
+        if better:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stopped = True
+                self.model.stop_training = True

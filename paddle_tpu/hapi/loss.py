@@ -1,0 +1,45 @@
+"""hapi losses (reference: incubate/hapi/loss.py:Loss/CrossEntropy/
+SoftmaxWithCrossEntropy)."""
+from __future__ import annotations
+
+from ..ops import loss as L
+
+
+class Loss:
+    """reference hapi/loss.py:Loss — maps (outputs, labels) -> scalar."""
+
+    def __init__(self, average=True):
+        self.average = average
+
+    def forward(self, outputs, labels):
+        raise NotImplementedError
+
+    def __call__(self, outputs, labels):
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        outputs = outputs if isinstance(outputs, (list, tuple)) else \
+            [outputs]
+        losses = self.forward(list(outputs), list(labels))
+        if not isinstance(losses, (list, tuple)):
+            losses = [losses]
+        if self.average:
+            losses = [lo.mean() for lo in losses]
+        else:
+            losses = [lo.sum() for lo in losses]
+        return losses
+
+
+class CrossEntropy(Loss):
+    """reference hapi/loss.py:CrossEntropy — softmax CE on logits."""
+
+    def forward(self, outputs, labels):
+        return [L.cross_entropy(o, lb, reduction="none")
+                for o, lb in zip(outputs, labels)]
+
+
+class SoftmaxWithCrossEntropy(Loss):
+    """reference hapi/loss.py:SoftmaxWithCrossEntropy (fused kernel on
+    the TPU path via ops.loss's pallas gate)."""
+
+    def forward(self, outputs, labels):
+        return [L.softmax_with_cross_entropy(o, lb)
+                for o, lb in zip(outputs, labels)]

@@ -1,0 +1,69 @@
+"""hapi metrics (reference: incubate/hapi/metrics.py:Metric/Accuracy)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+class Metric:
+    """reference hapi/metrics.py:Metric — reset/update/accumulate/name."""
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return getattr(self, "_name", type(self).__name__.lower())
+
+    def add_metric_op(self, *args):
+        """Pre-process (pred, label) inside the compiled step; default
+        passthrough."""
+        return args
+
+
+class Accuracy(Metric):
+    """reference hapi/metrics.py:Accuracy — top-k accuracy."""
+
+    def __init__(self, topk=(1,), name=None):
+        self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def add_metric_op(self, pred, label):
+        import jax.numpy as jnp
+        from ..tensor import Tensor
+        p = pred.data if hasattr(pred, "data") else pred
+        lb = label.data if hasattr(label, "data") else label
+        if lb.ndim == p.ndim and lb.shape[-1] == 1:
+            lb = lb[..., 0]
+        kk = min(self.maxk, p.shape[-1])
+        top = jnp.argsort(p, axis=-1)[..., ::-1][..., :kk]
+        correct = (top == lb[..., None]).astype(jnp.float32)
+        return (Tensor(correct),)
+
+    def update(self, correct):
+        c = np.asarray(correct.numpy() if hasattr(correct, "numpy")
+                       else correct)
+        n = c.shape[0]
+        for i, k in enumerate(self.topk):
+            self.total[i] += float(c[..., :k].sum())
+            self.count[i] += n
+        return self.total[0] / max(self.count[0], 1)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]

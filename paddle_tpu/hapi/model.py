@@ -1,0 +1,303 @@
+"""hapi Model (reference: incubate/hapi/model.py:652 Model —
+prepare/fit/evaluate/predict/train_batch/eval_batch/save/load).
+
+TPU redesign: the reference keeps separate dygraph/static adapter classes
+(DynamicGraphAdapter / StaticGraphAdapter, model.py:137/586); here there
+is ONE path — the train and eval steps are ordinary dygraph functions that
+jit.to_static compiles into single donated XLA executables, so `fit` runs
+one fused computation per batch on the MXU.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .. import io as pio
+from .. import jit
+from ..nn import Layer
+from .callbacks import CallbackList, ProgBarLogger, ModelCheckpoint
+from .metrics import Metric
+
+
+class Input:
+    """reference hapi/model.py:Input — an input spec (shape/dtype/name)."""
+
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = tuple(shape or ())
+        self.dtype = dtype
+        self.name = name
+
+
+def set_device(device):
+    """reference hapi/model.py:set_device."""
+    from ..device import set_device as _sd
+    return _sd(device)
+
+
+class Model(Layer):
+    """High-level trainable container. Use either style:
+
+    - wrap: ``Model(network)`` with any nn.Layer
+    - subclass: ``class MyModel(hapi.Model)`` defining forward()
+    """
+
+    def __init__(self, network=None, inputs=None, labels=None):
+        super().__init__()
+        if network is not None:
+            self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_fn = None
+        self._pred_fn = None
+        self.stop_training = False
+
+    # -- wiring ------------------------------------------------------------
+
+    def forward(self, *args):
+        if hasattr(self, "network"):
+            return self.network(*args)
+        raise NotImplementedError(
+            "subclass hapi.Model and define forward(), or pass a network")
+
+    def prepare(self, optimizer=None, loss_function=None, metrics=None,
+                inputs=None, labels=None, device=None):
+        """reference hapi/model.py:1030 prepare."""
+        self._optimizer = optimizer
+        self._loss = loss_function
+        ms = metrics or []
+        ms = ms if isinstance(ms, (list, tuple)) else [ms]
+        for m in ms:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metrics must be hapi.Metric, got {m}")
+        self._metrics = list(ms)
+        self._train_step = None  # recompile on next batch
+        self._eval_fn = None
+
+    # -- single-batch ops --------------------------------------------------
+
+    def _compute_loss(self, outputs, labels):
+        losses = self._loss(outputs, labels)
+        total = losses[0]
+        for lo in losses[1:]:
+            total = total + lo
+        return total
+
+    def train_batch(self, inputs, labels=None):
+        """reference hapi/model.py:train_batch — one optimizer step;
+        compiled on first call."""
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else \
+            ([] if labels is None else [labels])
+        if self._train_step is None:
+            def step(*args):
+                n_in = len(inputs)
+                ins, labs = args[:n_in], args[n_in:]
+                outs = self(*ins)
+                loss = self._compute_loss(outs, list(labs))
+                loss.backward()
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                return loss
+            self._train_step = jit.to_static(
+                step, models=[self], optimizers=[self._optimizer])
+        from ..tensor import to_tensor
+        args = [to_tensor(a) for a in list(inputs) + list(labels)]
+        loss = self._train_step(*args)
+        return [float(np.asarray(loss.numpy()))]
+
+    def eval_batch(self, inputs, labels=None):
+        """reference hapi/model.py:eval_batch — loss + metric updates."""
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else \
+            ([] if labels is None else [labels])
+        if self._eval_fn is None:
+            def ev(*args):
+                n_in = len(inputs)
+                ins, labs = args[:n_in], args[n_in:]
+                was = self.training
+                self.eval()
+                try:
+                    outs = self(*ins)
+                finally:
+                    if was:
+                        self.train()
+                outs_l = outs if isinstance(outs, (list, tuple)) else \
+                    [outs]
+                loss = self._compute_loss(outs, list(labs)) \
+                    if self._loss else None
+                return outs_l[0], loss
+            self._eval_fn = jit.to_static(ev, models=[self])
+        from ..tensor import to_tensor
+        args = [to_tensor(a) for a in list(inputs) + list(labels)]
+        out0, loss = self._eval_fn(*args)
+        if self._metrics and len(args) > len(inputs):
+            for m in self._metrics:
+                extra = m.add_metric_op(out0, args[len(inputs)])
+                m.update(*extra)
+        return [0.0 if loss is None else float(np.asarray(loss.numpy()))]
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self._pred_fn is None:
+            def pr(*ins):
+                was = self.training
+                self.eval()
+                try:
+                    return self(*ins)
+                finally:
+                    if was:
+                        self.train()
+            self._pred_fn = jit.to_static(pr, models=[self])
+        from ..tensor import to_tensor
+        outs = self._pred_fn(*[to_tensor(a) for a in inputs])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return [np.asarray(o.numpy()) for o in outs]
+
+    # -- loops -------------------------------------------------------------
+
+    def _loader(self, data, batch_size, shuffle, num_workers,
+                drop_last=False):
+        from ..io import DataLoader
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            return data  # already an iterable of batches
+        if isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return [batch], []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        """reference hapi/model.py:1128 fit."""
+        assert self._optimizer is not None, "call prepare() first"
+        loader = self._loader(train_data, batch_size, shuffle, num_workers,
+                              drop_last=drop_last)
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        cblist = CallbackList(cbs, self, {
+            "epochs": epochs, "verbose": verbose, "metrics":
+            ["loss"] + [m.name() for m in self._metrics]})
+        self.stop_training = False
+        cblist.call("on_train_begin")
+        history = {"loss": []}
+        for epoch in range(epochs):
+            cblist.call("on_epoch_begin", epoch)
+            self.train()
+            losses = []
+            for step, batch in enumerate(loader):
+                cblist.call("on_train_batch_begin", step)
+                ins, labs = self._split_batch(batch)
+                (loss,) = self.train_batch(ins, labs)
+                losses.append(loss)
+                cblist.call("on_train_batch_end", step, {
+                    "loss": loss,
+                    "batch_size": ins[0].shape[0] if hasattr(
+                        ins[0], "shape") else 1})
+            logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eres = self.evaluate(eval_data, batch_size=batch_size,
+                                     verbose=0)
+                # eval metrics get an eval_ prefix so the train loss is
+                # not silently overwritten in logs/history
+                logs.update({f"eval_{k}": v for k, v in eres.items()})
+            history["loss"].append(logs["loss"])
+            cblist.call("on_epoch_end", epoch, logs)
+            if self.stop_training:
+                break
+        cblist.call("on_train_end", {"loss": history["loss"]})
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        """reference hapi/model.py:1337 evaluate."""
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        cblist = CallbackList(list(callbacks or []) + (
+            [ProgBarLogger(log_freq, verbose)] if verbose else []),
+            self, {})
+        cblist.call("on_eval_begin")
+        losses = []
+        for step, batch in enumerate(loader):
+            cblist.call("on_eval_batch_begin", step)
+            ins, labs = self._split_batch(batch)
+            (loss,) = self.eval_batch(ins, labs)
+            losses.append(loss)
+            cblist.call("on_eval_batch_end", step, {"loss": loss})
+        res = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, list):
+                res.update(dict(zip(name, acc)))
+            else:
+                res[name] = acc
+        cblist.call("on_eval_end", res)
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False):
+        """reference hapi/model.py predict."""
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        outs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outs.append(self.predict_batch(ins))
+        if stack_outputs:
+            n = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n)]
+        return outs
+
+    # -- persistence -------------------------------------------------------
+
+    def save(self, path):
+        """reference hapi/model.py:862 save — .pdparams + .pdopt."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        pio.save(self.state_dict(), path + ".pdparams")
+        if self._optimizer is not None and hasattr(self._optimizer,
+                                                   "state_dict"):
+            pio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        """reference hapi/model.py:907 load."""
+        state = pio.load(path + ".pdparams")
+        self.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)
+                and hasattr(self._optimizer, "set_state_dict")):
+            self._optimizer.set_state_dict(pio.load(opt_path))
+        self._train_step = None  # recompile against restored state
+
+    def parameters(self, *a, **kw):
+        return super().parameters(*a, **kw)
+
+    def summary(self, input_size=None, dtype=None):
+        """Param-count summary (reference hapi model_summary)."""
+        rows = []
+        total = 0
+        for name, p in self.named_parameters():
+            n = int(p.data.size)
+            total += n
+            rows.append(f"{name:<44s} {str(tuple(p.data.shape)):<18s} {n:>12,d}")
+        table = "\n".join(rows + ["-" * 76,
+                                  f"total trainable params: {total:,}"])
+        print(table)
+        return {"total_params": total}

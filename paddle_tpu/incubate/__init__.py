@@ -1,0 +1,6 @@
+"""paddle_tpu.incubate (reference: python/paddle/incubate — hapi +
+complex). Complex arithmetic rides jnp's native complex dtypes, so the
+reference's separate ComplexVariable kernel set collapses into the
+ordinary ops."""
+from .. import hapi  # noqa: F401
+from . import complex  # noqa: F401

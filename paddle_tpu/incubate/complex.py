@@ -1,0 +1,22 @@
+"""incubate.complex (reference: python/paddle/incubate/complex — a
+parallel op set for ComplexVariable). jnp handles complex64/128 natively:
+these wrappers exist for API parity and simply call the regular ops,
+which accept complex inputs."""
+from ..ops.math import matmul, kron, trace, sum, multiply, divide  # noqa
+from ..ops.manip import reshape, transpose  # noqa: F401
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return x + y
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return x - y
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return x * y
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return x / y

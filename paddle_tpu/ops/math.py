@@ -355,3 +355,172 @@ def accuracy_top1(pred, label):
         p = jnp.argmax(pred, axis=-1)
         return jnp.mean((p == label.reshape(p.shape)).astype(jnp.float32))
     return apply(impl, (pred, label), nondiff=True, name="accuracy")
+
+
+# ---------------------------------------------------------------------------
+# paddle 2.0-alpha top-level tensor API (reference: python/paddle/tensor/
+# {math,linalg,logic,search,creation}.py — the names python/paddle/__init__
+# exported in the v1.7 tree)
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    """reference: allclose_op.cc"""
+    return apply(lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan),
+                 (x, y), name="allclose")
+
+
+def addcmul(input, tensor1, tensor2, value=1.0, name=None):
+    """reference: paddle/tensor/math.py:addcmul — input + value*t1*t2."""
+    return apply(lambda a, b, c: a + value * b * c,
+                 (input, tensor1, tensor2), name="addcmul")
+
+
+def cholesky(x, upper=False, name=None):
+    """reference: cholesky_op.cc (cuSOLVER there; XLA's blocked Cholesky
+    here — MXU-shaped panels on TPU)."""
+    def impl(x):
+        L = jnp.linalg.cholesky(x)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply(impl, (x,), name="cholesky")
+
+
+def inverse(x, name=None):
+    """reference: inverse_op.cc"""
+    return apply(lambda x: jnp.linalg.inv(x), (x,), name="inverse")
+
+
+def cross(x, y, axis=None, name=None):
+    """reference: cross_op.cc — axis=None means the FIRST axis whose
+    length is 3 (paddle contract), not the last."""
+    def impl(x, y):
+        ax = axis
+        if ax is None:
+            ax = next((i for i, d in enumerate(x.shape) if d == 3), None)
+            if ax is None:
+                raise ValueError("cross: no axis of length 3 found")
+        return jnp.cross(x, y, axis=ax)
+    return apply(impl, (x, y), name="cross")
+
+
+def dist(x, y, p=2, name=None):
+    """reference: dist_op.cc — p-norm of (x - y)."""
+    def impl(x, y):
+        d = (x - y).ravel()
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        if p == 0:
+            return jnp.sum(d != 0).astype(x.dtype)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+    return apply(impl, (x, y), name="dist")
+
+
+def kron(x, y, name=None):
+    """reference: kron_op.cc"""
+    return apply(lambda x, y: jnp.kron(x, y), (x, y), name="kron")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """reference: trace_op.cc"""
+    return apply(lambda x: jnp.trace(x, offset=offset, axis1=axis1,
+                                     axis2=axis2), (x,), name="trace")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    """reference: paddle/tensor/stat.py:std"""
+    return apply(lambda x: jnp.std(x, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), (x,), name="std")
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    """reference: paddle/tensor/stat.py:var"""
+    return apply(lambda x: jnp.var(x, axis=axis, ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), (x,), name="var")
+
+
+def index_sample(x, index, name=None):
+    """reference: index_sample_op.cc — per-row gather x[i, index[i, j]]."""
+    return apply(lambda x, ix: jnp.take_along_axis(
+        x, ix.astype(jnp.int32), axis=1), (x, index), name="index_sample")
+
+
+def nonzero(x, as_tuple=False, name=None):
+    """reference: where_index_op (nonzero). Dynamic-shaped output → host
+    sync (documented; use masks inside jit)."""
+    import numpy as _np
+    arr = _np.asarray(jax.device_get(
+        x.data if hasattr(x, "data") else x))
+    idx = _np.nonzero(arr)
+    from ..tensor import Tensor
+    if as_tuple:
+        return tuple(Tensor(_np.asarray(i)[:, None]) for i in idx)
+    return Tensor(_np.stack(idx, axis=1).astype("int64"))
+
+
+def is_empty(x, name=None):
+    """reference: is_empty_op.cc"""
+    n = 1
+    for d in x.shape:
+        n *= d
+    from ..tensor import Tensor
+    return Tensor(jnp.asarray(n == 0))
+
+
+def rank(input, name=None):
+    """reference: rank of the tensor (ndim)."""
+    from ..tensor import Tensor
+    return Tensor(jnp.asarray(len(input.shape), jnp.int32))
+
+
+def shape(input, name=None):
+    """reference: shape_op.cc"""
+    from ..tensor import Tensor
+    return Tensor(jnp.asarray(input.shape, jnp.int32))
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """reference: stanh_op.cc — b * tanh(a * x)."""
+    return apply(lambda x: scale_b * jnp.tanh(scale_a * x), (x,),
+                 name="stanh")
+
+
+def elementwise_sum(inputs, name=None):
+    """reference: sum_op.cc over a list."""
+    def impl(*xs):
+        acc = xs[0]
+        for x in xs[1:]:
+            acc = acc + x
+        return acc
+    return apply(impl, tuple(inputs), name="elementwise_sum")
+
+
+def elementwise_equal(x, y, name=None):
+    """reference: equal op (elementwise)."""
+    return apply(lambda x, y: x == y, (x, y), name="elementwise_equal")
+
+
+def has_inf(x, name=None):
+    """reference: isinf_op"""
+    return apply(lambda x: jnp.any(jnp.isinf(x)), (x,), name="has_inf")
+
+
+def has_nan(x, name=None):
+    """reference: isnan_op"""
+    return apply(lambda x: jnp.any(jnp.isnan(x)), (x,), name="has_nan")
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """reference: crop_tensor_op.cc — static slice."""
+    def impl(x):
+        offs = offsets or [0] * x.ndim
+        shp = shape or list(x.shape)
+        idx = tuple(slice(o, o + (x.shape[i] - o if s in (None, -1) else s))
+                    for i, (o, s) in enumerate(zip(offs, shp)))
+        return x[idx]
+    return apply(impl, (x,), name="crop_tensor")
+
+
+clamp = clip
+mul = multiply
+div = divide

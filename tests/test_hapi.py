@@ -1,0 +1,121 @@
+"""hapi Model API end-to-end (reference: incubate/hapi/model.py +
+callbacks + metrics + loss)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as opt, hapi
+
+
+def _toy_data(n=64, d=8, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(d, classes)
+    x = rng.randn(n, d).astype("f4")
+    y = (x @ w).argmax(-1).astype("i8")
+    return x, y
+
+
+def _dataset(x, y):
+    from paddle_tpu.io import TensorDataset
+    return TensorDataset(x, y.astype("i4"))
+
+
+def test_fit_reduces_loss_and_evaluates():
+    pt.seed(0)
+    x, y = _toy_data()
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    m = hapi.Model(net)
+    m.prepare(optimizer=opt.Adam(learning_rate=0.05,
+                                 parameters=m.parameters()),
+              loss_function=hapi.CrossEntropy(),
+              metrics=hapi.Accuracy())
+    hist = m.fit(_dataset(x, y), batch_size=16, epochs=8, verbose=0,
+                 shuffle=True)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.5
+    res = m.evaluate(_dataset(x, y), batch_size=16, verbose=0)
+    assert res["acc"] > 0.8
+    preds = m.predict(_dataset(x, y), batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 3)
+
+
+def test_save_load_roundtrip(tmp_path):
+    pt.seed(0)
+    x, y = _toy_data(32)
+    net = nn.Sequential(nn.Linear(8, 3))
+    m = hapi.Model(net)
+    m.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                parameters=m.parameters()),
+              loss_function=hapi.CrossEntropy())
+    m.fit(_dataset(x, y), batch_size=16, epochs=1, verbose=0)
+    p = str(tmp_path / "ckpt")
+    m.save(p)
+    before = m.predict([[x[:4]]])[0][0]
+
+    pt.seed(1)
+    net2 = nn.Sequential(nn.Linear(8, 3))
+    m2 = hapi.Model(net2)
+    m2.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                 parameters=m2.parameters()),
+               loss_function=hapi.CrossEntropy())
+    m2.load(p)
+    after = m2.predict([[x[:4]]])[0][0]
+    np.testing.assert_allclose(before, after, atol=1e-6)
+
+
+def test_callbacks_and_early_stopping():
+    pt.seed(0)
+    x, y = _toy_data(32)
+    events = []
+
+    class Spy(hapi.Callback):
+        def on_epoch_begin(self, epoch, logs=None):
+            events.append(("begin", epoch))
+
+        def on_epoch_end(self, epoch, logs=None):
+            events.append(("end", epoch, logs["loss"]))
+
+    net = nn.Sequential(nn.Linear(8, 3))
+    m = hapi.Model(net)
+    m.prepare(optimizer=opt.SGD(learning_rate=0.0,
+                                parameters=m.parameters()),
+              loss_function=hapi.CrossEntropy())
+    # lr=0 → loss never improves → early stopping fires after patience
+    es = hapi.EarlyStopping(monitor="loss", patience=1)
+    m.fit(_dataset(x, y), batch_size=16, epochs=10, verbose=0,
+          callbacks=[es])
+    epochs_run = len([e for e in events if e[0] == "end"])
+    assert es.stopped and epochs_run < 10
+
+
+def test_accuracy_metric_topk():
+    m = hapi.Accuracy(topk=(1, 2))
+    pred = pt.to_tensor(np.asarray([[0.1, 0.7, 0.2],
+                                    [0.8, 0.1, 0.1]], "f4"))
+    label = pt.to_tensor(np.asarray([[2], [0]], "i4"))
+    (correct,) = m.add_metric_op(pred, label)
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 0.5) < 1e-6   # second row right, first wrong
+    assert abs(top2 - 1.0) < 1e-6   # label 2 is in top-2 of first row
+    assert m.name() == ["acc_top1", "acc_top2"]
+
+
+def test_model_subclass_style():
+    pt.seed(0)
+
+    class MyModel(hapi.Model):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 3)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    x, y = _toy_data(32)
+    m = MyModel()
+    m.prepare(optimizer=opt.SGD(learning_rate=0.1,
+                                parameters=m.parameters()),
+              loss_function=hapi.CrossEntropy())
+    hist = m.fit(_dataset(x, y), batch_size=16, epochs=3, verbose=0)
+    assert hist["loss"][-1] <= hist["loss"][0]
+    m.summary()
