@@ -128,3 +128,53 @@ def test_recompute_matches_plain():
     loss2.backward()
     np.testing.assert_allclose(np.asarray(g_remat), np.asarray(x2.grad),
                                atol=1e-5)
+
+
+def test_to_static_multi_step_unrolled_matches_sequential():
+    """bench.py runs `inner` REAL optimizer steps inside ONE compiled
+    call (dispatch amortization); the unrolled trace must produce
+    bit-comparable params to running the steps one compiled call each."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as opt, jit
+
+    rng = np.random.RandomState(0)
+    xs = rng.randn(3, 8, 4).astype("f4")
+    ys = rng.randn(3, 8, 1).astype("f4")
+
+    def make():
+        pt.seed(0)
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 1))
+        o = opt.Adam(learning_rate=0.05, parameters=m.parameters())
+        return m, o
+
+    def body(m, o, xb, yb):
+        loss = ((m(xb) - yb) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    # A: one call per step
+    m1, o1 = make()
+    f1 = jit.to_static(lambda xb, yb: body(m1, o1, xb, yb),
+                       models=[m1], optimizers=[o1])
+    for i in range(3):
+        l1 = f1(pt.to_tensor(xs[i]), pt.to_tensor(ys[i]))
+
+    # B: all three steps unrolled in one call
+    m2, o2 = make()
+
+    def step3(x_k, y_k):
+        loss = None
+        for i in range(3):
+            loss = body(m2, o2, x_k[i], y_k[i])
+        return loss
+
+    f3 = jit.to_static(step3, models=[m2], optimizers=[o2])
+    l3 = f3(pt.to_tensor(xs), pt.to_tensor(ys))
+
+    np.testing.assert_allclose(float(l1.numpy()), float(l3.numpy()),
+                               rtol=1e-5)
+    for p1, p2 in zip(m1.parameters(), m2.parameters()):
+        np.testing.assert_allclose(p1.numpy(), p2.numpy(), atol=1e-6)
