@@ -123,3 +123,7 @@ def load(path, **kw):
 
 from . import hapi  # noqa: E402  (high-level Model API)
 from . import incubate  # noqa: E402
+
+
+from . import framework  # noqa: E402
+from . import imperative  # noqa: E402

@@ -60,3 +60,5 @@ class launch:
     """Placeholder namespace mirroring `python -m paddle.distributed.launch`;
     on TPU pods each host starts the same script (GKE/tpu-vm convention)."""
     pass
+from . import utils  # noqa: F401,E402
+from . import cloud_utils  # noqa: F401,E402

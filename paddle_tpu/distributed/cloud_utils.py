@@ -1,0 +1,23 @@
+"""paddle.distributed.cloud_utils parity (reference:
+python/paddle/distributed/cloud_utils.py) — cluster description from
+PADDLE_* cloud environment variables."""
+import os
+
+from .utils import get_cluster, get_logger, get_trainers_num  # noqa: F401
+
+logger = get_logger(20, "root")
+
+
+def get_cloud_cluster(args_node_ips=None, args_node_ip=None,
+                      args_port=None, selected_accelerators=None):
+    """reference cloud_utils.py:20 — derive the cluster from the cloud
+    env (PADDLE_TRAINERS / POD_IP / PADDLE_PORT), falling back to the
+    passed args."""
+    node_ips = os.getenv("PADDLE_TRAINERS", args_node_ips or "127.0.0.1")
+    if isinstance(node_ips, str):
+        node_ips = node_ips.split(",")
+    node_ip = os.getenv("POD_IP", args_node_ip or node_ips[0])
+    port = int(os.getenv("PADDLE_PORT", args_port or 8071))
+    accs = selected_accelerators or [0]
+    ports = [port + i for i in range(len(accs))]
+    return get_cluster(node_ips, node_ip, ports, accs)

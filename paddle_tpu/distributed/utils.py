@@ -1,0 +1,166 @@
+"""paddle.distributed.utils parity (reference:
+python/paddle/distributed/utils.py — Cluster/Pod/Trainer descriptors and
+the launch helpers). The TPU launch path delegates process management to
+`jax.distributed` (one process per host); these classes describe the
+topology for ported tooling."""
+from __future__ import annotations
+
+import logging
+import os
+import socket
+from contextlib import closing
+
+
+class Trainer:
+    """reference distributed/utils.py:131."""
+
+    def __init__(self):
+        self.accelerators = []
+        self.endpoint = None
+        self.rank = None
+
+    def __str__(self):
+        return (f"accelerators:{self.accelerators} endpoint:{self.endpoint}"
+                f" rank:{self.rank}")
+
+
+class Pod:
+    """reference distributed/utils.py:162 — one host's process group."""
+
+    def __init__(self):
+        self.rank = None
+        self.id = None
+        self.addr = None
+        self.port = None
+        self.trainers = []
+
+    def __str__(self):
+        return (f"rank:{self.rank} id:{self.id} addr:{self.addr} "
+                f"port:{self.port} visible_accelerators:"
+                f"{[str(t) for t in self.trainers]}")
+
+
+class Cluster:
+    """reference distributed/utils.py:55."""
+
+    def __init__(self, hdfs=None):
+        self.job_server = None
+        self.pods = []
+        self.hdfs = hdfs
+
+    def trainers_nranks(self):
+        return len(self.trainers_endpoints())
+
+    def trainers_endpoints(self):
+        eps = []
+        for pod in self.pods:
+            for t in pod.trainers:
+                eps.append(t.endpoint)
+        return eps
+
+    def pods_endpoints(self):
+        return [f"{p.addr}:{p.port}" for p in self.pods]
+
+
+class JobServer:
+    def __init__(self):
+        self.endpoint = None
+
+
+class Hdfs:
+    def __init__(self):
+        self.hdfs_ugi = None
+        self.hdfs_name = None
+        self.hdfs_path = None
+
+    def is_valid(self):
+        return (self.hdfs_ugi is not None and self.hdfs_name is not None
+                and self.hdfs_path is not None)
+
+
+def get_logger(log_level=20, name="root"):
+    """reference distributed/utils.py:217."""
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s-%(levelname)s: %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+def get_cluster(node_ips, node_ip, paddle_ports, selected_accelerators):
+    """reference distributed/utils.py:230 — build the Cluster/Pod/Trainer
+    description from host lists."""
+    cluster = Cluster()
+    rank = 0
+    for pod_id, ip in enumerate(node_ips):
+        pod = Pod()
+        pod.rank = pod_id
+        pod.id = pod_id
+        pod.addr = ip
+        pod.port = paddle_ports[0] if paddle_ports else 8071
+        for i, acc in enumerate(selected_accelerators):
+            t = Trainer()
+            t.accelerators = [acc]
+            port = paddle_ports[i] if i < len(paddle_ports) else \
+                pod.port + i
+            t.endpoint = f"{ip}:{port}"
+            t.rank = rank
+            rank += 1
+            pod.trainers.append(t)
+        cluster.pods.append(pod)
+    return cluster, cluster.pods[node_ips.index(node_ip)]
+
+
+def get_host_name_ip():
+    """reference distributed/utils.py:281."""
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(socket.getfqdn(host))
+    except OSError:
+        return None
+
+
+def find_free_ports(num):
+    """reference distributed/utils.py:307."""
+    ports = set()
+    step = 0
+    while len(ports) < num:
+        with closing(socket.socket(socket.AF_INET,
+                                   socket.SOCK_STREAM)) as s:
+            s.bind(("", 0))
+            ports.add(s.getsockname()[1])
+        step += 1
+        if step > 400:
+            return None
+    return ports
+
+
+def add_arguments(argname, type, default, help, argparser, **kwargs):
+    """reference distributed/utils.py:290 — argparse helper."""
+    argparser.add_argument(
+        "--" + argname, default=default, type=type,
+        help=help + f" Default: {default}.", **kwargs)
+
+
+def terminate_local_procs(procs):
+    """reference distributed/utils.py:252."""
+    for p in procs:
+        proc = getattr(p, "proc", p)
+        if proc is not None and proc.poll() is None:
+            proc.terminate()
+
+
+class TrainerProc:
+    def __init__(self):
+        self.proc = None
+        self.log_fn = None
+        self.rank = None
+        self.cmd = None
+
+
+def get_trainers_num():
+    """reference distributed/cloud_utils.py:79."""
+    return int(os.getenv("PADDLE_TRAINERS_NUM", "1"))

@@ -1,0 +1,9 @@
+"""paddle.framework parity package (reference:
+python/paddle/framework/__init__.py — random seeding + framework core
+re-exports for the 2.0-alpha surface)."""
+from .random import seed as manual_seed  # noqa: F401
+from .random import get_seed  # noqa: F401
+from .tensor import Tensor, Parameter  # noqa: F401
+from .device import CPUPlace, CUDAPlace, TPUPlace  # noqa: F401
+from .static import (Program, program_guard, default_main_program,  # noqa
+                     default_startup_program)
