@@ -30,6 +30,15 @@ class Config:
         self.precision = "bfloat16"
         return self
 
+    def enable_int8(self, calibration_data=None):
+        """int8 post-training quantization (the reference's TensorRT-int8
+        analogue): Linear/Conv2D weights stored int8, dequantized into
+        the matmul; `calibration_data` (iterable of input batches)
+        additionally calibrates activation scales."""
+        self.precision = "int8"
+        self.calibration_data = calibration_data
+        return self
+
 
 class Predictor:
     """reference: AnalysisPredictor. Wraps an eval-mode Layer; each input
@@ -43,6 +52,13 @@ class Predictor:
         else:
             model = model_or_config
         self.config = config or Config()
+        if self.config.precision == "int8":
+            from .quantization import convert, quant_post_static
+            cal = getattr(self.config, "calibration_data", None)
+            if cal is not None:
+                model = quant_post_static(model, cal)
+            else:
+                model = convert(model)
         self.model = model.eval()
         self.state = state_pytree(model)
         if self.config.precision == "bfloat16":

@@ -122,3 +122,24 @@ def test_quanted_conv2d():
     qm = Q.convert(m)
     out2 = qm(x)
     assert out2.shape == [2, 8, 8, 8]
+
+
+def test_predictor_int8_path():
+    """Config.enable_int8 routes the Predictor through PTQ conversion;
+    outputs stay close to fp32 on a small net."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Predictor, Config
+
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    net.eval()
+    x = np.random.RandomState(0).randn(4, 8).astype("f4")
+    ref = Predictor(net).run(x)
+    ref = ref[0] if isinstance(ref, list) else ref
+
+    q = Predictor(net, Config().enable_int8(calibration_data=[x]))
+    out = q.run(x)
+    out = out[0] if isinstance(out, list) else out
+    assert np.mean(np.abs(out - ref)) < 0.15 * np.mean(np.abs(ref)) + 1e-3
