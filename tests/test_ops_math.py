@@ -165,3 +165,78 @@ def test_sequence_ops():
     assert padded.shape == [2, 5, 3] and lens.numpy().tolist() == [2, 5]
     unp = S.sequence_unpad(padded, lens)
     assert unp[0].shape == (2, 3) and unp[1].shape == (5, 3)
+
+
+def test_paddle20_tensor_api_tail():
+    """Top-level parity ops vs numpy (reference: python/paddle/tensor)."""
+    import numpy as np
+    import paddle_tpu as pt
+    rng = np.random.RandomState(0)
+
+    a = rng.randn(3, 3).astype("f4")
+    spd = (a @ a.T + 3 * np.eye(3)).astype("f4")
+    L = pt.cholesky(pt.to_tensor(spd)).numpy()
+    np.testing.assert_allclose(L @ L.T, spd, atol=1e-4)
+    U = pt.cholesky(pt.to_tensor(spd), upper=True).numpy()
+    np.testing.assert_allclose(U.T @ U, spd, atol=1e-4)
+
+    inv = pt.inverse(pt.to_tensor(spd)).numpy()
+    np.testing.assert_allclose(inv @ spd, np.eye(3), atol=1e-4)
+
+    x = rng.randn(3, 5).astype("f4")
+    y = rng.randn(3, 5).astype("f4")
+    # cross with axis=None finds the first length-3 axis (paddle rule)
+    np.testing.assert_allclose(
+        pt.cross(pt.to_tensor(x), pt.to_tensor(y)).numpy(),
+        np.cross(x, y, axis=0), atol=1e-5)
+
+    np.testing.assert_allclose(
+        pt.kron(pt.to_tensor(x[:2, :2]), pt.to_tensor(y[:2, :2])).numpy(),
+        np.kron(x[:2, :2], y[:2, :2]), atol=1e-5)
+
+    np.testing.assert_allclose(
+        float(pt.dist(pt.to_tensor(x), pt.to_tensor(y), p=2).numpy()),
+        np.linalg.norm((x - y).ravel()), rtol=1e-5)
+
+    np.testing.assert_allclose(
+        float(pt.trace(pt.to_tensor(a)).numpy()), np.trace(a), rtol=1e-5)
+
+    np.testing.assert_allclose(
+        pt.std(pt.to_tensor(x), axis=1).numpy(), x.std(1, ddof=1),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        pt.var(pt.to_tensor(x), axis=0, unbiased=False).numpy(),
+        x.var(0), rtol=1e-4)
+
+    idx = rng.randint(0, 5, (3, 2)).astype("i4")
+    np.testing.assert_allclose(
+        pt.index_sample(pt.to_tensor(x), pt.to_tensor(idx)).numpy(),
+        np.take_along_axis(x, idx, axis=1), atol=1e-6)
+
+    z = np.asarray([[1, 0], [0, 2]], "f4")
+    nz = pt.nonzero(pt.to_tensor(z)).numpy()
+    np.testing.assert_array_equal(nz, [[0, 0], [1, 1]])
+
+    assert bool(pt.allclose(pt.to_tensor(x), pt.to_tensor(x + 1e-9)).numpy())
+    assert not bool(pt.has_nan(pt.to_tensor(x)).numpy())
+    assert bool(pt.has_inf(pt.to_tensor(
+        np.asarray([np.inf], "f4"))).numpy())
+
+    np.testing.assert_allclose(
+        pt.addcmul(pt.to_tensor(x), pt.to_tensor(y), pt.to_tensor(y),
+                   value=0.5).numpy(), x + 0.5 * y * y, atol=1e-5)
+
+    np.testing.assert_allclose(
+        pt.stanh(pt.to_tensor(x)).numpy(),
+        1.7159 * np.tanh(0.67 * x), atol=1e-5)
+
+    # reduce_* reference dim/keep_dim signature
+    np.testing.assert_allclose(
+        pt.reduce_sum(pt.to_tensor(x), dim=1, keep_dim=True).numpy(),
+        x.sum(1, keepdims=True), rtol=1e-5)
+
+    assert int(pt.rank(pt.to_tensor(x)).numpy()) == 2
+    np.testing.assert_array_equal(pt.shape(pt.to_tensor(x)).numpy(),
+                                  [3, 5])
+    ct = pt.crop_tensor(pt.to_tensor(x), shape=[2, 3], offsets=[1, 1])
+    np.testing.assert_allclose(ct.numpy(), x[1:3, 1:4], atol=1e-6)
