@@ -26,3 +26,14 @@ from . import functional
 from . import functional as F
 from .layers import NCE
 from .layers import Conv3DTranspose, InstanceNorm, TreeConv
+
+# paddle.nn 2.0-alpha alias tail (reference: python/paddle/nn/__init__.py)
+from ..clip import (ClipGradByGlobalNorm as GradientClipByGlobalNorm,  # noqa
+                    ClipGradByNorm as GradientClipByNorm,
+                    ClipGradByValue as GradientClipByValue)
+from ..fluid.layers_rnn import beam_search, beam_search_decode  # noqa: F401
+from ..static import data  # noqa: F401
+from ..ops import nn_ops as conv  # reference exports its conv module
+from .layers import Upsample as UpSample  # noqa: F401 (2.0-alpha name)
+from .layers import HSigmoid  # noqa: F401
+from ..fluid.dygraph import RowConv  # noqa: F401

@@ -696,3 +696,53 @@ class TreeConv(Layer):
             out = getattr(F, act)(out) if hasattr(F, act) else \
                 getattr(ops, act)(out)
         return out
+
+
+class HSigmoid(Layer):
+    """Hierarchical sigmoid (reference: dygraph/nn.py HSigmoid over
+    hierarchical_sigmoid_op.cc). Default complete-binary-tree code book:
+    class c's path is the ancestor chain of leaf c in a complete binary
+    tree over num_classes leaves — path nodes and left/right codes come
+    straight from the bits of (c + num_classes), so no Huffman tables are
+    materialized. loss[i] = -Σ_d log σ((1-2·code_d)·(x_i·w_{node_d}+b))."""
+
+    def __init__(self, feature_size, num_classes, param_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 dtype="float32"):
+        super().__init__(dtype=dtype)
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._C = int(num_classes)
+        self._depth = max(1, int(np.ceil(np.log2(self._C))))
+        self.weight = self.create_parameter(
+            (self._C, feature_size),
+            default_initializer=I.Normal(0.0, 1.0 / np.sqrt(feature_size)))
+        self.bias = self.create_parameter((self._C,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label):
+        from ..dispatch import apply
+        import jax
+        import jax.numpy as jnp
+        C, D = self._C, self._depth
+
+        def impl(x, w, b, lab):
+            lab = lab.reshape(-1).astype(jnp.int32)
+            # heap index of leaf `c` in a complete binary tree is c + C;
+            # its ancestors c>>1 ... are the internal nodes (1..C-1)
+            node = lab + C
+            loss = jnp.zeros((x.shape[0],), jnp.float32)
+            for _ in range(D):
+                code = node & 1          # 1 = right child
+                parent = node >> 1
+                idx = jnp.clip(parent, 1, C - 1) % C
+                logit = jnp.einsum("bd,bd->b", x, w[idx]) + b[idx]
+                sign = 1.0 - 2.0 * code.astype(jnp.float32)
+                valid = parent >= 1
+                term = jax.nn.softplus(-sign * logit)
+                loss = loss + jnp.where(valid, term, 0.0)
+                node = parent
+            return loss[:, None]
+
+        return apply(impl, (input, self.weight, self.bias, label),
+                     name="hsigmoid")

@@ -198,3 +198,30 @@ def test_upsample_and_pad():
     assert up(x).shape == [1, 2, 8, 8]
     pad = nn.Pad2D([1, 1, 2, 2])
     assert pad(x).shape == [1, 2, 8, 6]
+
+
+def test_hsigmoid_trains_class_apart():
+    """HSigmoid loss drops when training to separate two classes, and the
+    complete-binary-tree codes give a proper probability: loss for the
+    true class < loss for a wrong class after training."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as opt
+
+    pt.seed(0)
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype("f4")
+    lab = (x[:, 0] > 0).astype("i8").reshape(-1, 1) * 3  # classes {0, 3}
+    hs = nn.HSigmoid(8, 6)
+    o = opt.Adam(learning_rate=0.1, parameters=hs.parameters())
+    losses = []
+    for _ in range(25):
+        loss = hs(pt.to_tensor(x), pt.to_tensor(lab)).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.5
+    true_l = hs(pt.to_tensor(x), pt.to_tensor(lab)).numpy().mean()
+    wrong = hs(pt.to_tensor(x), pt.to_tensor(3 - lab)).numpy().mean()
+    assert true_l < wrong
