@@ -250,7 +250,27 @@ def bench_bert_long(batch=4, seq=2048, steps=8):
                       max_position_embeddings=2048)
 
 
+def _arm_watchdog(seconds=3300):
+    """If the device tunnel is wedged (first jax op blocks forever), bail
+    with a diagnostic JSON line instead of hanging past the driver's
+    patience."""
+    import os
+    import signal
+
+    def on_alarm(signum, frame):
+        print(json.dumps({
+            "metric": "bert_base_tokens/sec/chip", "value": 0.0,
+            "unit": "tokens/s", "vs_baseline": 0.0,
+            "error": f"watchdog: no result within {seconds}s "
+                     "(device/tunnel unresponsive)"}), flush=True)
+        os._exit(2)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(seconds)
+
+
 def main():
+    _arm_watchdog()
     _probe_pallas_kernels()
     bert_tps, bert_loss = bench_bert()
     rn_ips, rn_loss = bench_resnet()
