@@ -1,0 +1,62 @@
+"""paddle.utils.image_util parity (reference:
+python/paddle/utils/image_util.py) — thin numpy helpers over the
+dataset/image.py toolkit the rebuild already ships."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..dataset import image as _img
+
+
+def resize_image(img, target_size):
+    """reference image_util.py:20 — resize so the SHORT side equals
+    target_size (PIL image or HWC array in)."""
+    arr = np.asarray(img)
+    return _img.resize_short(arr, target_size)
+
+
+def flip(im):
+    """reference image_util.py:33 — horizontal flip of a CHW or HWC
+    image."""
+    im = np.asarray(im)
+    if im.ndim == 3 and im.shape[0] in (1, 3):   # CHW
+        return im[:, :, ::-1]
+    return im[:, ::-1]
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """reference image_util.py:45 — center crop at test time, random crop
+    (+ random flip) at train time."""
+    im = np.asarray(im)
+    if test:
+        return _img.center_crop(im, inner_size, is_color=color)
+    out = _img.random_crop(im, inner_size, is_color=color)
+    if np.random.rand() < 0.5:
+        out = _img.left_right_flip(out, is_color=color)
+    return out
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """reference image_util.py:96."""
+    im = crop_img(im, crop_size, color=color, test=not is_train)
+    im = _img.to_chw(im).astype("float32")
+    mean = np.asarray(img_mean, "float32").reshape(im.shape)
+    return im - mean
+
+
+def load_image(img_path, is_color=True):
+    """reference image_util.py:133."""
+    return _img.load_image(img_path, is_color=is_color)
+
+
+def oversample(img, crop_dims):
+    """reference image_util.py:144 — 4 corners + center, plus mirrors
+    (10 crops), the classic eval-time oversampling."""
+    img = np.asarray(img)
+    h, w = img.shape[:2]
+    ch, cw = (crop_dims, crop_dims) if np.isscalar(crop_dims) else crop_dims
+    starts = [(0, 0), (0, w - cw), (h - ch, 0), (h - ch, w - cw),
+              ((h - ch) // 2, (w - cw) // 2)]
+    crops = [img[r:r + ch, c:c + cw] for r, c in starts]
+    crops += [c[:, ::-1] for c in crops]
+    return np.stack(crops)
