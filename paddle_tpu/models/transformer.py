@@ -48,6 +48,56 @@ class CrossAttention(nn.Layer):
             dropout_p=self.dropout_p, training=self.training)
         return self.out(ctx.transpose([0, 2, 1, 3]).reshape([b, sq, d]))
 
+    # -- incremental (KV-cached) decode path --------------------------------
+
+    def precompute_kv(self, kv_in):
+        """Cross-attention K/V from the encoder memory, computed ONCE per
+        decode: returns raw [N, H, Sk, dk] arrays."""
+        import jax.numpy as jnp
+        b, sk = kv_in.shape[0], kv_in.shape[1]
+        kv = self.kv_proj(kv_in).data.reshape(b, sk, 2, self.h, self.dk)
+        kv = jnp.transpose(kv, (2, 0, 3, 1, 4))
+        return kv[0], kv[1]
+
+    def step_self(self, x1, ck, cv, pos):
+        """One cached self-attention step. x1: Tensor [N, 1, D]; ck/cv:
+        raw [N, H, T_max, dk] caches; pos: traced scalar. Returns
+        (Tensor [N, 1, D], new_ck, new_cv)."""
+        import jax
+        import jax.numpy as jnp
+        from ..tensor import Tensor as _T
+        ck = getattr(ck, "data", ck)   # beam search re-wraps cache leaves
+        cv = getattr(cv, "data", cv)
+        n = x1.shape[0]
+        q = self.q_proj(x1).data.reshape(n, 1, self.h, self.dk)
+        q = jnp.transpose(q, (0, 2, 1, 3))                    # [N,H,1,dk]
+        kv = self.kv_proj(x1).data.reshape(n, 1, 2, self.h, self.dk)
+        k1 = jnp.transpose(kv[:, :, 0], (0, 2, 1, 3))         # [N,H,1,dk]
+        v1 = jnp.transpose(kv[:, :, 1], (0, 2, 1, 3))
+        ck = jax.lax.dynamic_update_slice(ck, k1, (0, 0, pos, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v1, (0, 0, pos, 0))
+        s = jnp.einsum("nhqd,nhtd->nhqt", q, ck) / np.sqrt(self.dk)
+        t_max = ck.shape[2]
+        valid = jnp.arange(t_max) <= pos
+        s = jnp.where(valid[None, None, None, :], s, -1e9)
+        p = jax.nn.softmax(s, axis=-1)
+        ctx = jnp.einsum("nhqt,nhtd->nhqd", p, cv)
+        ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(n, 1, -1)
+        return self.out(_T(ctx)), ck, cv
+
+    def step_cross(self, x1, mem_k, mem_v):
+        """One cross-attention step against precomputed memory K/V —
+        the shared sdpa op, so the cached path cannot drift numerically
+        from forward()."""
+        import jax.numpy as jnp
+        from ..tensor import Tensor as _T
+        n = x1.shape[0]
+        q = self.q_proj(x1).reshape([n, 1, self.h, self.dk]).transpose(
+            [0, 2, 1, 3])
+        ctx = F.scaled_dot_product_attention(
+            q, _T(mem_k), _T(mem_v), dropout_p=0.0, training=False)
+        return self.out(ctx.transpose([0, 2, 1, 3]).reshape([n, 1, -1]))
+
 
 class EncoderLayer(nn.Layer):
     def __init__(self, d_model, num_heads, d_ff, dropout=0.1):
@@ -86,6 +136,17 @@ class DecoderLayer(nn.Layer):
         x = x + self.dropout(self.cross_attn(h, memory, cross_mask))
         h = self.norm3(x)
         return x + self.dropout(self.ffn2(F.relu(self.ffn1(h))))
+
+    def forward_step(self, x1, mem_k, mem_v, ck, cv, pos):
+        """Incremental decode step (eval mode, dropout off): same residual
+        structure as forward over ONE new position with cached K/V."""
+        h = self.norm1(x1)
+        sa, ck, cv = self.self_attn.step_self(h, ck, cv, pos)
+        x1 = x1 + sa
+        h = self.norm2(x1)
+        x1 = x1 + self.cross_attn.step_cross(h, mem_k, mem_v)
+        h = self.norm3(x1)
+        return x1 + self.ffn2(F.relu(self.ffn1(h))), ck, cv
 
 
 class Transformer(nn.Layer):
@@ -136,6 +197,34 @@ class Transformer(nn.Layer):
             x = layer(x, memory, cross_mask=cross_mask)
         return self.out_proj(self.dec_norm(x))
 
+    def init_cache(self, n, t_max):
+        """Per-decoder-layer raw [N, H, T_max, dk] self-attention K/V
+        caches (the beam search reorders these by parent beam each
+        step)."""
+        import jax.numpy as jnp
+        h = self.decoder[0].self_attn.h
+        dk = self.decoder[0].self_attn.dk
+        return tuple(
+            (jnp.zeros((n, h, t_max, dk), jnp.float32),
+             jnp.zeros((n, h, t_max, dk), jnp.float32))
+            for _ in range(len(self.decoder)))
+
+    def decode_step(self, tokens, pos, caches, mem_kv):
+        """One incremental decode position: tokens [N, 1] -> logits
+        [N, V], with all self-attention K/V cached (O(T) per step instead
+        of the O(T^2) full-prefix re-decode). pos: traced scalar."""
+        import jax
+        from ..tensor import Tensor as _T
+        emb = self.tgt_embed(tokens) * self.scale
+        pe = jax.lax.dynamic_index_in_dim(self.pos_enc.data, pos, axis=0,
+                                          keepdims=True)
+        x = _T(emb.data + pe[None])
+        new_caches = []
+        for layer, (ck, cv), (mk, mv) in zip(self.decoder, caches, mem_kv):
+            x, ck, cv = layer.forward_step(x, mk, mv, ck, cv, pos)
+            new_caches.append((ck, cv))
+        return self.out_proj(self.dec_norm(x)), tuple(new_caches)
+
     def forward(self, src_ids, tgt_ids, src_mask=None):
         cross_mask = None
         if src_mask is not None:
@@ -145,15 +234,16 @@ class Transformer(nn.Layer):
         return self.decode(tgt_ids, memory, cross_mask)
 
     def generate(self, src_ids, beam_size=4, max_len=32, bos_id=1,
-                 eos_id=2):
+                 eos_id=2, use_cache=True):
         """Beam-search translation (reference: the WMT book config decodes
         with fluid BeamSearchDecoder/dynamic_decode, layers/rnn.py:687).
 
-        TPU formulation: the 'cell state' is the fixed-width token prefix
-        buffer + a step counter; every step re-decodes the causal prefix
-        (static [B*K, T_max] shapes; a KV-cache incremental decoder is a
-        later optimization) and beam bookkeeping runs in
-        nn.decode.dynamic_decode's lax.while_loop.
+        TPU formulation: beam bookkeeping runs in nn.decode's
+        lax.while_loop over static shapes. With use_cache (default) each
+        step runs the O(T) incremental decoder over per-layer K/V caches
+        (the beam search gathers the caches by parent beam); the
+        use_cache=False path re-decodes the full prefix per step and
+        exists as the parity oracle.
 
         Returns (ids [B, T, K], scores [B, K])."""
         import jax
@@ -164,29 +254,63 @@ class Transformer(nn.Layer):
         was_training = self.training
         self.eval()
         try:
+            if int(max_len) > int(self.pos_enc.shape[0]):
+                raise ValueError(
+                    f"max_len={max_len} exceeds the model's max_length="
+                    f"{self.pos_enc.shape[0]} positional table")
             memory = self.encode(src_ids)
             mem = BeamSearchDecoder.tile_beam_merge_with_batch(memory,
                                                                beam_size)
             b = src_ids.shape[0]
             t_max = int(max_len)
+            n = b * beam_size
             model = self
 
-            class _PrefixCell:
-                def __call__(self, tokens, states):
-                    buf, t = states
-                    tcur = t.data.reshape(-1)[0]
-                    buf_arr = buf.data.at[:, tcur].set(
-                        tokens.data.reshape(-1).astype(jnp.int32))
-                    logits = model.decode(Tensor(buf_arr), mem)
-                    out = jax.lax.dynamic_index_in_dim(
-                        logits.data, tcur, axis=1, keepdims=False)
-                    return Tensor(out), (Tensor(buf_arr),
-                                         Tensor(t.data + 1))
+            if use_cache:
+                # project cross K/V from the UNTILED memory (one matmul
+                # per source row), then tile per beam
+                def _tile(a):
+                    return jnp.repeat(a, beam_size, axis=0)
+                mem_kv = tuple(
+                    tuple(_tile(a) for a in
+                          layer.cross_attn.precompute_kv(memory))
+                    for layer in self.decoder)
 
-            decoder = BeamSearchDecoder(_PrefixCell(), bos_id, eos_id,
-                                        beam_size)
-            init = (Tensor(jnp.full((b, t_max), eos_id, jnp.int32)),
-                    Tensor(jnp.zeros((b, 1), jnp.int32)))
+                class _CachedCell:
+                    def __call__(self, tokens, states):
+                        caches, t = states
+                        pos = t.data.reshape(-1)[0]
+                        logits, new_caches = model.decode_step(
+                            Tensor(tokens.data.reshape(-1, 1)
+                                   .astype(jnp.int32)),
+                            pos, caches, mem_kv)
+                        out = logits.data[:, 0]
+                        return Tensor(out), (new_caches,
+                                             Tensor(t.data + 1))
+
+                cell = _CachedCell()
+                # [B, ...] here — BeamSearchDecoder.initialize tiles every
+                # state leaf to [B*beam, ...]
+                init = (model.init_cache(b, t_max),
+                        Tensor(jnp.zeros((b, 1), jnp.int32)))
+            else:
+                class _PrefixCell:
+                    def __call__(self, tokens, states):
+                        buf, t = states
+                        tcur = t.data.reshape(-1)[0]
+                        buf_arr = buf.data.at[:, tcur].set(
+                            tokens.data.reshape(-1).astype(jnp.int32))
+                        logits = model.decode(Tensor(buf_arr), mem)
+                        out = jax.lax.dynamic_index_in_dim(
+                            logits.data, tcur, axis=1, keepdims=False)
+                        return Tensor(out), (Tensor(buf_arr),
+                                             Tensor(t.data + 1))
+
+                cell = _PrefixCell()
+                init = (Tensor(jnp.full((b, t_max), eos_id, jnp.int32)),
+                        Tensor(jnp.zeros((b, 1), jnp.int32)))
+
+            decoder = BeamSearchDecoder(cell, bos_id, eos_id, beam_size)
             ids, scores = dynamic_decode(decoder, init,
                                          max_step_num=t_max)
             return ids, scores
