@@ -15,15 +15,19 @@ from .. import nn
 class BottleneckBlock(nn.Layer):
     expansion = 4
 
-    def __init__(self, in_channels, channels, stride=1, downsample=None):
+    def __init__(self, in_channels, channels, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
-        self.conv0 = nn.Conv2D(in_channels, channels, 1, bias_attr=False)
-        self.bn0 = nn.BatchNorm2D(channels)
+        df = dict(data_format=data_format)
+        self.conv0 = nn.Conv2D(in_channels, channels, 1, bias_attr=False,
+                               **df)
+        self.bn0 = nn.BatchNorm2D(channels, **df)
         self.conv1 = nn.Conv2D(channels, channels, 3, stride=stride,
-                               padding=1, bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(channels)
-        self.conv2 = nn.Conv2D(channels, channels * 4, 1, bias_attr=False)
-        self.bn2 = nn.BatchNorm2D(channels * 4)
+                               padding=1, bias_attr=False, **df)
+        self.bn1 = nn.BatchNorm2D(channels, **df)
+        self.conv2 = nn.Conv2D(channels, channels * 4, 1, bias_attr=False,
+                               **df)
+        self.bn2 = nn.BatchNorm2D(channels * 4, **df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -40,14 +44,16 @@ class BottleneckBlock(nn.Layer):
 class BasicBlock(nn.Layer):
     expansion = 1
 
-    def __init__(self, in_channels, channels, stride=1, downsample=None):
+    def __init__(self, in_channels, channels, stride=1, downsample=None,
+                 data_format="NCHW"):
         super().__init__()
+        df = dict(data_format=data_format)
         self.conv0 = nn.Conv2D(in_channels, channels, 3, stride=stride,
-                               padding=1, bias_attr=False)
-        self.bn0 = nn.BatchNorm2D(channels)
+                               padding=1, bias_attr=False, **df)
+        self.bn0 = nn.BatchNorm2D(channels, **df)
         self.conv1 = nn.Conv2D(channels, channels, 3, padding=1,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(channels)
+                               bias_attr=False, **df)
+        self.bn1 = nn.BatchNorm2D(channels, **df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -61,14 +67,17 @@ class BasicBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    def __init__(self, block, depths, num_classes=1000, in_channels=3):
+    def __init__(self, block, depths, num_classes=1000, in_channels=3,
+                 data_format="NCHW"):
         super().__init__()
+        self._df = data_format
+        df = dict(data_format=data_format)
         self.stem = nn.Sequential(
             nn.Conv2D(in_channels, 64, 7, stride=2, padding=3,
-                      bias_attr=False),
-            nn.BatchNorm2D(64),
+                      bias_attr=False, **df),
+            nn.BatchNorm2D(64, **df),
             nn.ReLU(),
-            nn.MaxPool2D(3, 2, padding=1),
+            nn.MaxPool2D(3, 2, padding=1, **df),
         )
         self.in_ch = 64
         layers = []
@@ -76,22 +85,23 @@ class ResNet(nn.Layer):
             stride = 1 if i == 0 else 2
             layers.append(self._make_layer(block, channels, n, stride))
         self.layers = nn.Sequential(*layers)
-        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.avgpool = nn.AdaptiveAvgPool2D(1, **df)
         self.flatten = nn.Flatten(1)
         self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, channels, blocks, stride):
+        df = dict(data_format=self._df)
         downsample = None
         if stride != 1 or self.in_ch != channels * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.in_ch, channels * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(channels * block.expansion),
+                          stride=stride, bias_attr=False, **df),
+                nn.BatchNorm2D(channels * block.expansion, **df),
             )
-        layers = [block(self.in_ch, channels, stride, downsample)]
+        layers = [block(self.in_ch, channels, stride, downsample, **df)]
         self.in_ch = channels * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.in_ch, channels))
+            layers.append(block(self.in_ch, channels, **df))
         return nn.Sequential(*layers)
 
     def forward(self, x):

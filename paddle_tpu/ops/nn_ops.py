@@ -198,11 +198,14 @@ def _pair(v, n=2):
 
 
 def _conv_dimension_numbers(ndim, data_format):
+    # weights are ALWAYS OIHW/OIDHW (reference parity — state dicts stay
+    # layout-independent); only the activation layout varies, which lax
+    # supports via mixed dimension numbers
     if ndim == 4:
         return ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else (
-            "NHWC", "HWIO", "NHWC")
+            "NHWC", "OIHW", "NHWC")
     return ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW" else (
-        "NDHWC", "DHWIO", "NDHWC")
+        "NDHWC", "OIDHW", "NDHWC")
 
 
 def _norm_padding(padding, nsp):

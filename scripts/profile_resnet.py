@@ -48,17 +48,19 @@ def conv_ceiling(batch, layout="NHWC"):
     return flops / dt / 1e12
 
 
-def model_stages(batch):
+def model_stages(batch, data_format="NCHW"):
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt, jit, amp
     from paddle_tpu.models.resnet import resnet50
 
     pt.seed(0)
-    model = resnet50()
+    model = resnet50(data_format=data_format)
     o = opt.Momentum(learning_rate=0.1, momentum=0.9,
                      parameters=model.parameters())
     rng = np.random.RandomState(0)
-    x = rng.rand(batch, 3, 224, 224).astype("f4")
+    shape = (batch, 3, 224, 224) if data_format == "NCHW" else \
+        (batch, 224, 224, 3)
+    x = rng.rand(*shape).astype("f4")
     y = rng.randint(0, 1000, (batch,)).astype("i4")
     tx, ty = pt.to_tensor(x), pt.to_tensor(y)
 
@@ -100,10 +102,11 @@ def main():
         ceil = conv_ceiling(batch, "NHWC")
         ceil_nchw = conv_ceiling(batch, "NCHW")
         tf, ts = model_stages(batch)
+        tfh, tsh = model_stages(batch, data_format="NHWC")
         tr_flops = 3 * 4.1e9 * batch  # fwd+bwd ~3x fwd, 4.1 GFLOP/img
         print(f"batch={batch}: conv_NHWC={ceil:.1f} conv_NCHW={ceil_nchw:.1f}"
-              f" TF/s  fwd={tf*1e3:.1f}ms  step={ts*1e3:.1f}ms  "
-              f"step_img/s={batch/ts:.0f}  "
+              f" TF/s  nchw_step={ts*1e3:.1f}ms ({batch/ts:.0f} img/s)  "
+              f"nhwc_step={tsh*1e3:.1f}ms ({batch/tsh:.0f} img/s)  "
               f"step_TF/s={tr_flops/ts/1e12:.1f}", flush=True)
 
 

@@ -128,3 +128,29 @@ def test_vgg_mobilenet_smoke():
     assert vgg16(num_classes=5, image_size=64)(x).shape == [1, 5]
     assert MobileNetV1(num_classes=5)(x).shape == [1, 5]
     assert MobileNetV2(num_classes=5)(x).shape == [1, 5]
+
+
+def test_resnet_nhwc_matches_nchw():
+    """data_format='NHWC' plumbs through stem/blocks/pools and matches
+    the NCHW model in eval mode (weights stay OIHW — layout-independent
+    state dicts)."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu.models.resnet import resnet18
+
+    np.random.seed(0)
+    x = np.random.rand(2, 3, 32, 32).astype("f4")
+    xh = np.transpose(x, (0, 2, 3, 1)).copy()
+    pt.seed(0)
+    m_nchw = resnet18(num_classes=10)
+    pt.seed(0)
+    m_nhwc = resnet18(num_classes=10, data_format="NHWC")
+    m_nchw.eval()
+    m_nhwc.eval()
+    np.testing.assert_allclose(
+        m_nhwc(pt.to_tensor(xh)).numpy(),
+        m_nchw(pt.to_tensor(x)).numpy(), atol=1e-4)
+    # identical state dicts regardless of layout
+    for (k1, v1), (k2, v2) in zip(sorted(m_nchw.state_dict().items()),
+                                  sorted(m_nhwc.state_dict().items())):
+        assert k1 == k2 and v1.shape == v2.shape
