@@ -1,0 +1,94 @@
+"""Coverage for aux modules: logger, profiler, image_util, distributed
+utils, framework/imperative facades (SURVEY §5 aux subsystems)."""
+import argparse
+import logging
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_logger():
+    from paddle_tpu.utils.log import get_logger
+    lg = get_logger("paddle_tpu.test", level=logging.DEBUG)
+    lg2 = get_logger("paddle_tpu.test")
+    assert lg is lg2  # no duplicate handlers
+    lg.info("hello")
+
+
+def test_profiler_records_scope():
+    from paddle_tpu.utils import profiler as P
+    P.reset_profiler()
+    P.start_profiler()
+    with P.scope("matmul_block"):
+        a = pt.to_tensor(np.ones((64, 64), "f4"))
+        (a @ a).numpy()
+    P.stop_profiler()
+    P.print_stats()
+
+
+def test_image_util_roundtrip():
+    from paddle_tpu.utils import image_util as IU
+    im = (np.random.rand(40, 50, 3) * 255).astype("u1")
+    assert min(IU.resize_image(im, 32).shape[:2]) == 32
+    assert IU.crop_img(im, 24).shape[:2] == (24, 24)
+    assert IU.crop_img(im, 24, test=False).shape[:2] == (24, 24)
+    assert IU.oversample(im, 24).shape == (10, 24, 24, 3)
+    chw = np.transpose(im, (2, 0, 1))
+    assert IU.flip(chw).shape == chw.shape
+    mean = np.zeros((3, 24, 24), "f4")
+    out = IU.preprocess_img(im, mean, 24, is_train=False)
+    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+
+
+def test_distributed_cluster_descriptors():
+    from paddle_tpu.distributed import utils as U
+    cluster, pod = U.get_cluster(["10.0.0.1", "10.0.0.2"], "10.0.0.2",
+                                 [8071, 8072], [0, 1])
+    assert cluster.trainers_nranks() == 4
+    assert pod.rank == 1
+    assert cluster.trainers_endpoints()[0] == "10.0.0.1:8071"
+    assert cluster.pods_endpoints() == ["10.0.0.1:8071", "10.0.0.2:8071"]
+    ports = U.find_free_ports(3)
+    assert len(ports) == 3
+    ap = argparse.ArgumentParser()
+    U.add_arguments("node_ip", str, "127.0.0.1", "ip", ap)
+    assert ap.parse_args([]).node_ip == "127.0.0.1"
+
+
+def test_cloud_cluster_from_env(monkeypatch):
+    from paddle_tpu.distributed import cloud_utils as CU
+    monkeypatch.setenv("PADDLE_TRAINERS", "1.1.1.1,2.2.2.2")
+    monkeypatch.setenv("POD_IP", "2.2.2.2")
+    monkeypatch.setenv("PADDLE_PORT", "9000")
+    cluster, pod = CU.get_cloud_cluster(selected_accelerators=[0])
+    assert cluster.trainers_nranks() == 2
+    assert pod.addr == "2.2.2.2" and pod.port == 9000
+
+
+def test_framework_imperative_facades():
+    assert pt.framework.manual_seed is pt.seed
+    with pt.imperative.guard():
+        v = pt.imperative.to_variable(np.ones(3, "f4"))
+        assert v.shape == [3]
+    bs = pt.imperative.BackwardStrategy()
+    assert bs.sort_sum_gradient is False
+    # grad through the imperative facade
+    x = pt.to_tensor(np.asarray([2.0], "f4"))
+    x.stop_gradient = False
+    (gx,) = pt.imperative.grad((x * x).sum(), [x])
+    np.testing.assert_allclose(np.asarray(gx.numpy()), [4.0], atol=1e-6)
+
+
+def test_distributed_batch_reader_shards(monkeypatch):
+    from paddle_tpu.fluid.contrib import distributed_batch_reader
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "1")
+    monkeypatch.setenv("PADDLE_TRAINERS_NUM", "2")
+
+    def reader():
+        for i in range(6):
+            yield i
+
+    got = list(distributed_batch_reader(reader)())
+    assert got == [1, 3, 5]
