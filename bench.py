@@ -273,13 +273,18 @@ def main():
     _arm_watchdog()
     _probe_pallas_kernels()
     bert_tps, bert_loss = bench_bert()
+    # partial lines are deliberately NOT json (exactly one JSON line at
+    # the end) — they leave evidence if the harness kills us mid-run
+    print(f"partial bert_tokens_per_sec={bert_tps:.1f}", flush=True)
     rn_ips, rn_loss = bench_resnet()
+    print(f"partial resnet_images_per_sec={rn_ips:.1f}", flush=True)
     try:
         pipe_ips, loader_ips = bench_resnet_pipeline()
     except Exception as e:
         print(f"pipeline bench failed: {type(e).__name__}: {e}",
               flush=True)
         pipe_ips, loader_ips = 0.0, 0.0
+    print(f"partial pipeline_images_per_sec={pipe_ips:.1f}", flush=True)
     try:
         long_tps, _ = bench_bert_long()
     except Exception as e:
