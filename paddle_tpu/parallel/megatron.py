@@ -62,10 +62,22 @@ def factorize_mesh(n_devices):
     return sizes
 
 
-def make_mesh(n_devices=None, devices=None):
+def make_mesh(n_devices=None, devices=None, sizes=None):
+    """Build the 5-axis mesh. sizes overrides the default factorization
+    (e.g. {"dp": 2, "sp": 2, "ep": 2} to exercise the sequence/expert
+    axes on 8 devices)."""
     devices = devices if devices is not None else jax.devices()
     n = n_devices or len(devices)
-    sizes = factorize_mesh(n)
+    if sizes is None:
+        sizes = factorize_mesh(n)
+    else:
+        full = {"dp": 1, "pp": 1, "tp": 1, "sp": 1, "ep": 1}
+        full.update(sizes)
+        sizes = full
+        total = int(np.prod(list(sizes.values())))
+        if total != n:
+            raise ValueError(f"mesh sizes {sizes} use {total} devices, "
+                             f"have {n}")
     names = ("dp", "pp", "tp", "sp", "ep")
     arr = np.asarray(devices[:n]).reshape([sizes[a] for a in names])
     return Mesh(arr, names), sizes
