@@ -275,26 +275,53 @@ def recompute(layer_or_fn, *args, **kwargs):
             if isinstance(b, Tensor):
                 holder_map["buffer:" + n] = b
         names = sorted(holder_map)
+        # None inputs (e.g. an absent attention mask) can't be traced —
+        # record their positions and re-insert at call time
+        arg_slots = [a is not None for a in args]
+        live_args = tuple(a for a in args if a is not None)
+        n_in = len(live_args)
 
-        def impl(x, *param_vals):
-            vals = dict(zip(names, param_vals))
-            with bind_state(layer, vals):
-                with _ag.no_grad():
-                    out = layer(Tensor(x), **kwargs)
+        def impl(rng_key, *vals):
+            # the RNG key is threaded EXPLICITLY: stochastic ops inside
+            # (dropout) must not advance the global key with a tracer
+            # from the checkpoint trace (leak), and the backward replay
+            # must regenerate identical masks
+            xs, param_vals = vals[:n_in], vals[n_in:]
+            it = iter(xs)
+            full = [Tensor(next(it)) if live else None
+                    for live in arg_slots]
+            state = dict(zip(names, param_vals))
+            saved = prandom._global_key.data
+            prandom._global_key.data = rng_key
+            try:
+                with bind_state(layer, state):
+                    with _ag.no_grad():
+                        out = layer(*full, **kwargs)
+            finally:
+                prandom._global_key.data = saved
             return out.data if isinstance(out, Tensor) else out
 
         ckpt = jax.checkpoint(impl)
-        tensors = (args[0],) + tuple(holder_map[n] for n in names)
+        tensors = (prandom.next_key_graph(),) + live_args + tuple(
+            holder_map[n] for n in names)
         return apply(ckpt, tensors, name="recompute")
 
     fn = layer_or_fn
 
-    def impl(*xs):
-        with _ag.no_grad():
-            out = fn(*[Tensor(x) for x in xs])
+    def impl(rng_key, *xs):
+        # same explicit RNG threading as the Layer branch (tracer-leak +
+        # backward-replay-mask invariants)
+        saved = prandom._global_key.data
+        prandom._global_key.data = rng_key
+        try:
+            with _ag.no_grad():
+                out = fn(*[Tensor(x) for x in xs])
+        finally:
+            prandom._global_key.data = saved
         return out.data if isinstance(out, Tensor) else out
 
-    return apply(jax.checkpoint(impl), args, name="recompute")
+    return apply(jax.checkpoint(impl),
+                 (prandom.next_key_graph(),) + args, name="recompute")
 
 
 class TracedLayer:

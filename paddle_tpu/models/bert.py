@@ -23,7 +23,8 @@ class BertConfig:
                  intermediate_size=3072, hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
-                 layer_norm_eps=1e-12, use_flash_attention=True):
+                 layer_norm_eps=1e-12, use_flash_attention=True,
+                 use_recompute=False):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -35,6 +36,9 @@ class BertConfig:
         self.type_vocab_size = type_vocab_size
         self.layer_norm_eps = layer_norm_eps
         self.use_flash_attention = use_flash_attention
+        # rematerialize each encoder layer's activations during backward
+        # (jax.checkpoint) — the long-context memory knob
+        self.use_recompute = use_recompute
 
     @staticmethod
     def base(**kw):
@@ -138,8 +142,13 @@ class Bert(nn.Layer):
             am = None
         x = self.embeddings(input_ids, token_type_ids)
         if isinstance(self.encoder, nn.LayerList):
-            for layer in self.encoder:
-                x = layer(x, am)
+            if getattr(self.config, "use_recompute", False):
+                from .. import jit as _jit
+                for layer in self.encoder:
+                    x = _jit.recompute(layer, x, am)
+            else:
+                for layer in self.encoder:
+                    x = layer(x, am)
         else:
             # e.g. parallel.pipeline.PipelineStack replacing the trunk
             x = self.encoder(x, am) if am is not None else self.encoder(x)
