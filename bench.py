@@ -251,6 +251,50 @@ def bench_bert_long(batch=4, seq=2048, steps=8):
                       max_position_embeddings=2048)
 
 
+def _fail_json(msg):
+    """Emit the SAME zero-value JSON schema as a successful run so the
+    driver always records a parseable line (r3's backend-init exception
+    escaped main() and the round's only number was a raw traceback)."""
+    print(json.dumps({
+        "metric": "bert_base_tokens/sec/chip", "value": 0.0,
+        "unit": "tokens/s", "vs_baseline": 0.0,
+        "resnet50_images_per_sec": 0.0, "resnet50_vs_baseline": 0.0,
+        "error": msg[:500]}), flush=True)
+
+
+def _init_backend_with_retry(attempts=3, backoff=30):
+    """The axon tunnel wedges transiently: first contact can raise
+    'UNAVAILABLE: TPU backend setup/compile error'. One failed attempt is
+    cached by jax, so clear backends between tries and back off."""
+    import jax
+
+    last = None
+    for i in range(attempts):
+        try:
+            import jax.numpy as jnp
+            jnp.zeros((8,), jnp.float32).block_until_ready()
+            print(f"backend ok: {jax.devices()[0].platform} "
+                  f"(attempt {i + 1})", flush=True)
+            return True
+        except Exception as e:  # pragma: no cover - env dependent
+            last = e
+            print(f"backend init attempt {i + 1}/{attempts} failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+            try:
+                from jax.extend import backend as _jeb
+                _jeb.clear_backends()
+            except Exception:
+                try:
+                    jax.clear_backends()  # older spelling
+                except Exception:
+                    pass
+            if i + 1 < attempts:
+                time.sleep(backoff * (i + 1))
+    _fail_json(f"backend init failed after {attempts} attempts: "
+               f"{type(last).__name__}: {last}")
+    return False
+
+
 def _arm_watchdog(seconds=3300):
     """If the device tunnel is wedged (first jax op blocks forever), bail
     with a diagnostic JSON line instead of hanging past the driver's
@@ -272,6 +316,8 @@ def _arm_watchdog(seconds=3300):
 
 def main():
     _arm_watchdog()
+    if not _init_backend_with_retry():
+        return
     _probe_pallas_kernels()
     bert_tps, bert_loss = bench_bert()
     # partial lines are deliberately NOT json (exactly one JSON line at
@@ -309,4 +355,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:  # noqa: BLE001 - last-resort diagnostic
+        if isinstance(e, SystemExit):
+            raise
+        import traceback
+        traceback.print_exc()
+        _fail_json(f"{type(e).__name__}: {e}")

@@ -307,21 +307,27 @@ def recompute(layer_or_fn, *args, **kwargs):
         return apply(ckpt, tensors, name="recompute")
 
     fn = layer_or_fn
+    # same None-slot contract as the Layer branch: record positions of
+    # None args and re-insert them at trace time
+    arg_slots = [a is not None for a in args]
+    live_args = tuple(a for a in args if a is not None)
 
     def impl(rng_key, *xs):
         # same explicit RNG threading as the Layer branch (tracer-leak +
         # backward-replay-mask invariants)
+        it = iter(xs)
+        full = [Tensor(next(it)) if live else None for live in arg_slots]
         saved = prandom._global_key.data
         prandom._global_key.data = rng_key
         try:
             with _ag.no_grad():
-                out = fn(*[Tensor(x) for x in xs])
+                out = fn(*full, **kwargs)
         finally:
             prandom._global_key.data = saved
         return out.data if isinstance(out, Tensor) else out
 
     return apply(jax.checkpoint(impl),
-                 (prandom.next_key_graph(),) + args, name="recompute")
+                 (prandom.next_key_graph(),) + live_args, name="recompute")
 
 
 class TracedLayer:

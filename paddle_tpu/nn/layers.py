@@ -712,12 +712,19 @@ class HSigmoid(Layer):
         super().__init__(dtype=dtype)
         if num_classes < 2:
             raise ValueError("num_classes must be >= 2")
+        if is_custom or is_sparse:
+            raise NotImplementedError(
+                "HSigmoid is_custom/is_sparse trees are not supported; "
+                "the default complete-binary-tree code book covers the "
+                "reference's non-custom path")
         self._C = int(num_classes)
         self._depth = max(1, int(np.ceil(np.log2(self._C))))
+        # (num_classes - 1, feature): one row per INTERNAL tree node,
+        # matching the reference's parameter shape
         self.weight = self.create_parameter(
-            (self._C, feature_size),
+            (self._C - 1, feature_size), attr=param_attr,
             default_initializer=I.Normal(0.0, 1.0 / np.sqrt(feature_size)))
-        self.bias = self.create_parameter((self._C,), attr=bias_attr,
+        self.bias = self.create_parameter((self._C - 1,), attr=bias_attr,
                                           is_bias=True)
 
     def forward(self, input, label):
@@ -726,7 +733,11 @@ class HSigmoid(Layer):
         import jax.numpy as jnp
         C, D = self._C, self._depth
 
-        def impl(x, w, b, lab):
+        has_bias = self.bias is not None
+
+        def impl(x, w, *rest):
+            b = rest[0] if has_bias else jnp.zeros((C - 1,), x.dtype)
+            lab = rest[-1]
             lab = lab.reshape(-1).astype(jnp.int32)
             # heap index of leaf `c` in a complete binary tree is c + C;
             # its ancestors c>>1 ... are the internal nodes (1..C-1)
@@ -735,7 +746,8 @@ class HSigmoid(Layer):
             for _ in range(D):
                 code = node & 1          # 1 = right child
                 parent = node >> 1
-                idx = jnp.clip(parent, 1, C - 1) % C
+                # internal node k (1..C-1) lives in weight row k-1
+                idx = jnp.clip(parent, 1, C - 1) - 1
                 logit = jnp.einsum("bd,bd->b", x, w[idx]) + b[idx]
                 sign = 1.0 - 2.0 * code.astype(jnp.float32)
                 valid = parent >= 1
@@ -744,5 +756,6 @@ class HSigmoid(Layer):
                 node = parent
             return loss[:, None]
 
-        return apply(impl, (input, self.weight, self.bias, label),
-                     name="hsigmoid")
+        args = (input, self.weight) + \
+            ((self.bias,) if has_bias else ()) + (label,)
+        return apply(impl, args, name="hsigmoid")

@@ -45,10 +45,17 @@ def _dropout_keep(seed_ref, bh, qi, j, shape, threshold):
     from the TPU PRNG seeded per tile (so fwd and both bwd kernels
     regenerate the identical mask without storing it)."""
     # libtpu's tpu.prng_set_seed_32 takes at most TWO seed words, so fold
-    # the (bh, qi, j) tile coordinates into one mixed word (odd-constant
-    # multiplies are bijections mod 2^32; ranges are far below the
-    # constants, so distinct tiles get distinct words)
-    mixed = (seed_ref[1] * 1000003 + bh) * 1000003 + qi * 16777259 + j
+    # the (bh, qi, j) tile coordinates into one mixed word via a
+    # murmur-style absorb (xor word, odd-constant multiply, logical
+    # shift-xor) — avalanches all 32 bits, so no wrap-around collision
+    # window at long sequences / large batch*heads (int32 ops wrap mod
+    # 2^32 in XLA, which is exactly what the hash wants)
+    mixed = seed_ref[1]
+    for v in (bh, qi, j):
+        mixed = (mixed ^ v) * jnp.int32(-1640531527)   # 0x9E3779B9
+        mixed = mixed ^ ((mixed >> 15) & jnp.int32(0x1FFFF))
+        mixed = mixed * jnp.int32(-1274126177)         # 0xB40E609F (odd)
+        mixed = mixed ^ ((mixed >> 13) & jnp.int32(0x7FFFF))
     pltpu.prng_seed(seed_ref[0], mixed)
     bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= jnp.uint32(threshold)

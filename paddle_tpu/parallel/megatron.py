@@ -472,6 +472,9 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
             new_params = jax.tree_util.tree_map(
                 lambda p, g: p - cfg.lr * g, params_local, grads)
             new_opt = state["opt"]
+        # `loss` is already the GLOBAL token-weighted mean: _loss_fn psums
+        # num/den over sp and pmeans over dp, so every rank holds the same
+        # value and out_spec P() is sound without further collectives
         return {"params": new_params, "opt": new_opt, "t": t}, loss
 
     # tokens: [n_micro, batch, seq]: batch over dp, seq over sp

@@ -40,8 +40,12 @@ def preprocess_img(im, img_mean, crop_size, is_train, color=True):
     """reference image_util.py:96."""
     im = crop_img(im, crop_size, color=color, test=not is_train)
     im = _img.to_chw(im).astype("float32")
-    mean = np.asarray(img_mean, "float32").reshape(im.shape)
-    return im - mean
+    mean = np.asarray(img_mean, "float32")
+    if mean.size == im.shape[0]:        # per-channel mean
+        mean = mean.reshape(-1, 1, 1)
+    else:                               # full mean image
+        mean = mean.reshape(im.shape)
+    return (im - mean).flatten()
 
 
 def load_image(img_path, is_color=True):

@@ -211,3 +211,64 @@ def test_grad_scaler_composes_with_to_static():
     assert vals[-1] < vals[0]
     # dynamic scale growth happened inside the compiled step
     assert scaler.state_dict()["scale"] == 32.0
+
+
+# ---------------------------------------------------------------------------
+# round-3 advisor findings
+
+
+def test_preprocess_img_per_channel_mean_and_flatten():
+    from paddle_tpu.utils.image_util import preprocess_img
+    img = (np.random.rand(40, 40, 3) * 255).astype("u1")
+    out = preprocess_img(img, [104.0, 117.0, 124.0], 32, is_train=False)
+    assert out.shape == (3 * 32 * 32,)          # flattened CHW
+    # each channel had its own mean subtracted (broadcast, not reshape)
+    chw = out.reshape(3, 32, 32)
+    for c, m in enumerate([104.0, 117.0, 124.0]):
+        np.testing.assert_allclose(
+            chw[c].mean(), img[4:36, 4:36, :].transpose(2, 0, 1)[c].mean()
+            - m, atol=1.5)
+    # full mean image still accepted
+    full = preprocess_img(img, np.zeros((3, 32, 32), "f4"), 32,
+                          is_train=False)
+    assert full.shape == (3 * 32 * 32,)
+
+
+def test_hsigmoid_param_shape_and_custom_raises():
+    hs = nn.HSigmoid(8, 10)
+    assert tuple(hs.weight.shape) == (9, 8)     # num_classes-1 rows
+    assert tuple(hs.bias.shape) == (9,)
+    with pytest.raises(NotImplementedError):
+        nn.HSigmoid(8, 10, is_custom=True)
+    with pytest.raises(NotImplementedError):
+        nn.HSigmoid(8, 10, is_sparse=True)
+
+
+def test_recompute_function_branch_accepts_none_args():
+    from paddle_tpu import jit
+    pt.seed(3)
+    lin = nn.Linear(6, 6)
+
+    def block(x, mask):
+        h = lin(x)
+        if mask is not None:
+            h = h + mask
+        return F.relu(h)
+
+    x = pt.to_tensor(np.random.randn(4, 6).astype("f4"))
+    x.stop_gradient = False
+    out = jit.recompute(block, x, None)          # None positional arg
+    out.sum().backward()
+    assert x.grad is not None
+    ref = F.relu(lin(x))
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5)
+
+
+def test_hsigmoid_no_bias():
+    hs = nn.HSigmoid(8, 10, bias_attr=False)
+    assert hs.bias is None
+    x = pt.to_tensor(np.random.randn(4, 8).astype("f4"))
+    lbl = pt.to_tensor(np.random.randint(0, 10, (4, 1)).astype("i4"))
+    out = hs(x, lbl)
+    assert tuple(out.shape) == (4, 1)
+    assert np.isfinite(out.numpy()).all()

@@ -39,7 +39,10 @@ def test_image_util_roundtrip():
     assert IU.flip(chw).shape == chw.shape
     mean = np.zeros((3, 24, 24), "f4")
     out = IU.preprocess_img(im, mean, 24, is_train=False)
-    assert out.shape == (3, 24, 24) and out.dtype == np.float32
+    # reference parity: returns the flattened CHW image
+    assert out.shape == (3 * 24 * 24,) and out.dtype == np.float32
+    out_pc = IU.preprocess_img(im, [10.0, 20.0, 30.0], 24, is_train=False)
+    assert out_pc.shape == (3 * 24 * 24,)
 
 
 def test_distributed_cluster_descriptors():
