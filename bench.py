@@ -303,11 +303,8 @@ def _arm_watchdog(seconds=3300):
     import signal
 
     def on_alarm(signum, frame):
-        print(json.dumps({
-            "metric": "bert_base_tokens/sec/chip", "value": 0.0,
-            "unit": "tokens/s", "vs_baseline": 0.0,
-            "error": f"watchdog: no result within {seconds}s "
-                     "(device/tunnel unresponsive)"}), flush=True)
+        _fail_json(f"watchdog: no result within {seconds}s "
+                   "(device/tunnel unresponsive)")
         os._exit(2)
 
     signal.signal(signal.SIGALRM, on_alarm)

@@ -269,6 +269,7 @@ def recompute(layer_or_fn, *args, **kwargs):
     from . import autograd as _ag
 
     if isinstance(layer_or_fn, Layer):
+        from .nn.moe import MoEFFN
         layer = layer_or_fn
         holder_map = dict(layer.named_parameters())
         for n, b in layer.named_buffers():
@@ -280,6 +281,12 @@ def recompute(layer_or_fn, *args, **kwargs):
         arg_slots = [a is not None for a in args]
         live_args = tuple(a for a in args if a is not None)
         n_in = len(live_args)
+        # MoE sublayers stash their aux (load-balance) loss on themselves
+        # during forward — inside jax.checkpoint that Tensor would hold an
+        # inner-trace tracer, so thread the aux values out as EXPLICIT
+        # checkpoint outputs and re-stash them afterwards
+        moe_subs = [l for l in layer.sublayers(include_self=True)
+                    if isinstance(l, MoEFFN)]
 
         def impl(rng_key, *vals):
             # the RNG key is threaded EXPLICITLY: stochastic ops inside
@@ -299,12 +306,20 @@ def recompute(layer_or_fn, *args, **kwargs):
                         out = layer(*full, **kwargs)
             finally:
                 prandom._global_key.data = saved
-            return out.data if isinstance(out, Tensor) else out
+            out = out.data if isinstance(out, Tensor) else out
+            auxs = tuple(l.aux_loss.data for l in moe_subs)
+            return (out,) + auxs if moe_subs else out
 
         ckpt = jax.checkpoint(impl)
         tensors = (prandom.next_key_graph(),) + live_args + tuple(
             holder_map[n] for n in names)
-        return apply(ckpt, tensors, name="recompute")
+        if not moe_subs:
+            return apply(ckpt, tensors, name="recompute")
+        res = apply(ckpt, tensors, name="recompute",
+                    n_out=1 + len(moe_subs))
+        for l, a in zip(moe_subs, res[1:]):
+            l.aux_loss = a
+        return res[0]
 
     fn = layer_or_fn
     # same None-slot contract as the Layer branch: record positions of

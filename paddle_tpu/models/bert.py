@@ -24,7 +24,8 @@ class BertConfig:
                  attention_probs_dropout_prob=0.1,
                  max_position_embeddings=512, type_vocab_size=2,
                  layer_norm_eps=1e-12, use_flash_attention=True,
-                 use_recompute=False):
+                 use_recompute=False, moe_num_experts=0, moe_every=2,
+                 moe_capacity_factor=1.25):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_hidden_layers = num_hidden_layers
@@ -39,6 +40,12 @@ class BertConfig:
         # rematerialize each encoder layer's activations during backward
         # (jax.checkpoint) — the long-context memory knob
         self.use_recompute = use_recompute
+        # moe_num_experts > 0 swaps every `moe_every`-th layer's FFN for an
+        # expert-parallel nn.MoEFFN (sharded over the mesh's ep axis under
+        # fleet.distributed_model)
+        self.moe_num_experts = moe_num_experts
+        self.moe_every = moe_every
+        self.moe_capacity_factor = moe_capacity_factor
 
     @staticmethod
     def base(**kw):
@@ -85,19 +92,29 @@ class MultiHeadAttention(nn.Layer):
 
 
 class TransformerEncoderLayer(nn.Layer):
-    def __init__(self, config: BertConfig):
+    def __init__(self, config: BertConfig, layer_idx=0):
         super().__init__()
         d = config.hidden_size
         self.attention = MultiHeadAttention(config)
         self.attn_norm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
-        self.ffn1 = nn.Linear(d, config.intermediate_size)
-        self.ffn2 = nn.Linear(config.intermediate_size, d)
+        self.moe = None
+        if config.moe_num_experts > 0 and \
+                (layer_idx + 1) % max(1, config.moe_every) == 0:
+            self.moe = nn.MoEFFN(d, config.intermediate_size,
+                                 config.moe_num_experts,
+                                 config.moe_capacity_factor)
+        else:
+            self.ffn1 = nn.Linear(d, config.intermediate_size)
+            self.ffn2 = nn.Linear(config.intermediate_size, d)
         self.ffn_norm = nn.LayerNorm(d, epsilon=config.layer_norm_eps)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
     def forward(self, x, attn_mask=None):
         x = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
-        h = self.ffn2(F.gelu(self.ffn1(x)))
+        if self.moe is not None:
+            h = self.moe(x)
+        else:
+            h = self.ffn2(F.gelu(self.ffn1(x)))
         return self.ffn_norm(x + self.dropout(h))
 
 
@@ -129,8 +146,8 @@ class Bert(nn.Layer):
         self.config = config
         self.embeddings = BertEmbeddings(config)
         self.encoder = nn.LayerList(
-            [TransformerEncoderLayer(config)
-             for _ in range(config.num_hidden_layers)])
+            [TransformerEncoderLayer(config, layer_idx=i)
+             for i in range(config.num_hidden_layers)])
         self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None):

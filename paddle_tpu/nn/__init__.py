@@ -36,4 +36,5 @@ from ..static import data  # noqa: F401
 from ..ops import nn_ops as conv  # reference exports its conv module
 from .layers import Upsample as UpSample  # noqa: F401 (2.0-alpha name)
 from .layers import HSigmoid  # noqa: F401
+from .moe import MoEFFN, moe_aux_loss  # noqa: F401
 from ..fluid.dygraph import RowConv  # noqa: F401

@@ -1,0 +1,126 @@
+"""Mixture-of-Experts FFN — expert parallelism for the user Layer stack.
+
+TPU-native design (no reference counterpart in Paddle Fluid 1.7 — the ep
+axis is part of this framework's 5-axis scale-out story, matching the
+manual-collective MoE in parallel/megatron.py): the GShard/Mesh-TF dense
+dispatch formulation. Expert weights are STACKED on a leading [E] axis; a
+top-1 gate builds a dispatch one-hot [T, E, C] (T tokens, C capacity per
+expert) and the whole layer is four einsums. Under `fleet.distributed_model`
+the expert axis is sharded over the mesh's `ep` axis (see
+fleet.megatron_param_spec), and GSPMD lowers the dispatch/combine einsums
+into the token all-to-all the megatron trainer writes by hand — static
+shapes, MXU-friendly, no data-dependent control flow.
+
+Load balancing: the standard GShard auxiliary loss E·Σ_e(mean_gate_e ·
+frac_tokens_e) is computed every forward and stashed on the layer as
+``self.aux_loss`` (a live Tensor on the autograd tape); training code adds
+``moe_aux_loss(model)`` to its objective to activate it.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .layer import Layer
+from ..tensor import Tensor
+from ..dispatch import apply
+from .. import initializer as I
+
+__all__ = ["MoEFFN", "moe_aux_loss"]
+
+
+class MoEFFN(Layer):
+    """Drop-in replacement for the Linear–act–Linear FFN block.
+
+    d_model -> [num_experts] x (d_model -> d_ffn -> d_model), top-1 gated,
+    capacity = ceil(T / E * capacity_factor) tokens per expert (overflow
+    tokens pass through the residual untouched, GShard semantics).
+    """
+
+    def __init__(self, d_model, d_ffn, num_experts, capacity_factor=1.25,
+                 activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = float(capacity_factor)
+        self.activation = activation
+        self.gate_w = self.create_parameter(
+            (d_model, num_experts),
+            default_initializer=I.Normal(0.0, 0.02))
+        k = 1.0 / np.sqrt(d_model)
+        # expert-stacked: leading axis is the EXPERT axis (sharded over ep
+        # by fleet.megatron_param_spec's "experts_" rule)
+        self.experts_w1 = self.create_parameter(
+            (num_experts, d_model, d_ffn),
+            default_initializer=I.Uniform(-k, k))
+        self.experts_b1 = self.create_parameter(
+            (num_experts, d_ffn), is_bias=True)
+        kf = 1.0 / np.sqrt(d_ffn)
+        self.experts_w2 = self.create_parameter(
+            (num_experts, d_ffn, d_model),
+            default_initializer=I.Uniform(-kf, kf))
+        self.experts_b2 = self.create_parameter(
+            (num_experts, d_model), is_bias=True)
+        self.aux_loss = None
+
+    def forward(self, x):
+        import jax
+        import jax.numpy as jnp
+        E = self.num_experts
+        act_name = self.activation
+
+        def impl(x, gate_w, w1, b1, w2, b2):
+            lead = x.shape[:-1]
+            d = x.shape[-1]
+            tokens = x.reshape(-1, d)
+            T = tokens.shape[0]
+            C = max(1, int(np.ceil(T / E * self.capacity_factor)))
+
+            logits = tokens @ gate_w                     # [T, E]
+            probs = jax.nn.softmax(logits, axis=-1)
+            expert = jnp.argmax(probs, axis=-1)          # [T]
+            gate = jnp.max(probs, axis=-1)               # [T]
+
+            onehot = jax.nn.one_hot(expert, E, dtype=jnp.float32)
+            # position of each token within its expert's capacity bucket
+            pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot   # [T, E]
+            keep = (pos < C) & (onehot > 0)
+            pos_c = jax.nn.one_hot(jnp.sum(pos, axis=-1).astype(jnp.int32),
+                                   C, dtype=jnp.float32)         # [T, C]
+            dispatch = keep.astype(jnp.float32)[:, :, None] * \
+                pos_c[:, None, :]                                # [T, E, C]
+
+            expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                                   tokens.astype(jnp.float32))
+            h = jnp.einsum("ecd,edf->ecf", expert_in, w1) + b1[:, None, :]
+            h = getattr(jax.nn, act_name)(h)
+            out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+            combine = dispatch * gate[:, None, None]             # [T, E, C]
+            y = jnp.einsum("tec,ecd->td", combine, out)
+            y = y.astype(x.dtype).reshape(*lead, d)
+
+            # GShard load-balance aux: E * sum_e mean_t(prob_e)*frac_e
+            frac = jnp.mean(onehot, axis=0)
+            mean_prob = jnp.mean(probs, axis=0)
+            aux = E * jnp.sum(frac * mean_prob)
+            return y, aux
+
+        y, aux = apply(impl, (x, self.gate_w, self.experts_w1,
+                              self.experts_b1, self.experts_w2,
+                              self.experts_b2), name="moe_ffn", n_out=2)
+        self.aux_loss = aux
+        return y
+
+
+def moe_aux_loss(model, weight=0.01):
+    """Sum the aux_loss of every MoE-bearing layer in `model`, scaled by
+    `weight` (call AFTER the forward pass; returns 0.0 if the model has no
+    MoE). Any sublayer exposing a non-None ``aux_loss`` Tensor counts —
+    MoEFFN itself, and aggregators like parallel.pipeline.PipelineStack
+    which total the aux of MoE blocks hidden inside their scan."""
+    total = None
+    for layer in model.sublayers(include_self=True):
+        aux = getattr(layer, "aux_loss", None)
+        if aux is not None:
+            total = aux if total is None else total + aux
+    if total is None:
+        return 0.0
+    return total * weight

@@ -49,10 +49,23 @@ _ROW_PAT = re.compile(
     r"(out|o_proj|out_proj|ffn2|fc2|linear2|output)[^.]*\.weight$")
 
 
-def megatron_param_spec(name, shape, tensor_axis="tp"):
+def megatron_param_spec(name, shape, tensor_axis="tp", expert_axis="ep"):
     """Default param_spec_fn for shard_model: Megatron column/row splits
-    for transformer-shaped Layers (zoo BERT/Transformer naming), replicated
+    for transformer-shaped Layers (zoo BERT/Transformer naming),
+    expert-stacked MoE weights over the expert axis, replicated
     otherwise."""
+    if "experts_" in name and (expert_axis or tensor_axis):
+        # nn.MoEFFN stacks: w1 [E, d, f] / w2 [E, f, d] / biases [E, *] —
+        # experts over ep, ffn dim additionally over tp (column then row)
+        if len(shape) == 3 and name.endswith("w1"):
+            return P(expert_axis, None, tensor_axis)
+        if len(shape) == 3 and name.endswith("w2"):
+            return P(expert_axis, tensor_axis, None)
+        if len(shape) == 2 and name.endswith("b1"):
+            return P(expert_axis, tensor_axis)
+        return P(expert_axis)
+    if tensor_axis is None:
+        return P()
     if len(shape) == 2 and _COL_PAT.search(name):
         return P(None, tensor_axis)
     if len(shape) == 1 and _COL_BIAS_PAT.search(name):
@@ -201,35 +214,49 @@ class Fleet:
             self._strategy = strategy
         return DistributedOptimizer(optimizer, self)
 
+    def _default_spec_fn(self):
+        """megatron_param_spec bound to whichever of the strategy's
+        tensor/expert axes actually exist (size > 1) on the mesh; None if
+        neither does."""
+        if self._mesh is None:
+            return None
+        names = self._mesh.axis_names
+
+        def active(ax):
+            return ax if ax in names and self._mesh.shape[ax] > 1 else None
+
+        t_ax = active(self._strategy.tensor_axis)
+        e_ax = active(self._strategy.expert_axis)
+        if not (t_ax or e_ax):
+            return None
+        return lambda n, s: megatron_param_spec(n, s, tensor_axis=t_ax,
+                                                expert_axis=e_ax)
+
     def distributed_model(self, model, param_spec_fn=None):
         """Place a user nn.Layer on the mesh. When the mesh has a >1
         tensor axis, parameters get Megatron column/row shardings by
         default (megatron_param_spec); compose with jit.to_static and
         GSPMD partitions the whole fwd+bwd+update step across dp×tp."""
-        if param_spec_fn is None and self._mesh is not None:
-            axis = self._strategy.tensor_axis
-            if axis in self._mesh.axis_names and \
-                    self._mesh.shape[axis] > 1:
-                param_spec_fn = lambda n, s: megatron_param_spec(
-                    n, s, tensor_axis=axis)
+        if param_spec_fn is None:
+            param_spec_fn = self._default_spec_fn()
         self.shard_model(model, param_spec_fn)
         self._model = model
         return model
 
-    def pipeline_stack(self, blocks, spec_fn=None):
+    def pipeline_stack(self, blocks, spec_fn=None, remat=None):
         """Stage-shard a trunk of identical blocks over the mesh's pp
         axis (reference: Fleet pipeline strategy / PipelineOptimizer —
         see parallel/pipeline.py for the GSPMD redesign). Returns a
-        drop-in Layer replacing the LayerList."""
+        drop-in Layer replacing the LayerList. remat defaults to the
+        strategy's recompute flag (per-stage jax.checkpoint)."""
         from .pipeline import PipelineStack
-        axis = self._strategy.tensor_axis
-        if spec_fn is None and self._mesh is not None and \
-                axis in self._mesh.axis_names and self._mesh.shape[axis] > 1:
-            spec_fn = lambda n, s: megatron_param_spec(n, s,
-                                                       tensor_axis=axis)
+        if spec_fn is None:
+            spec_fn = self._default_spec_fn()
+        if remat is None:
+            remat = self._strategy.recompute
         return PipelineStack(blocks, mesh=self._mesh,
                              pipeline_axis=self._strategy.pipeline_axis,
-                             spec_fn=spec_fn)
+                             spec_fn=spec_fn, remat=remat)
 
     # -- io parity ----------------------------------------------------------
     def save_persistables(self, executor=None, dirname=None,

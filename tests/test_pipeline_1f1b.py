@@ -1,0 +1,170 @@
+"""Explicit pipeline schedules (GPipe / 1F1B / interleaved) + the
+manual-vjp executor (VERDICT r3 #3; reference: fluid/optimizer.py
+PipelineOptimizer section programs).
+
+Parity: the executor's loss AND grads on a pp mesh must match a plain
+single-device forward/backward over the same stages, for both schedule
+kinds, at 8 microbatches."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel.pipeline import (build_schedule, pipeline_step,
+                                          PipelineSchedule)
+
+
+# ---------------------------------------------------------------------------
+# schedule analytics
+
+
+def test_1f1b_memory_beats_gpipe_equal_time():
+    """Non-interleaved 1F1B: same timeline length as GPipe, far lower
+    peak activation memory (the reference's section runner is GPipe-only,
+    i.e. always at the `m` end)."""
+    for n, m in ((2, 8), (4, 8), (4, 16)):
+        g = build_schedule("gpipe", n, m)
+        f = build_schedule("1f1b", n, m)
+        assert f.n_ticks == g.n_ticks
+        assert f.bubble_fraction() == pytest.approx(g.bubble_fraction())
+        assert f.peak_live_activations() == min(m, n)
+        assert g.peak_live_activations() == m
+        assert f.peak_live_activations() < g.peak_live_activations()
+
+
+def test_interleaved_bubble_beats_gpipe():
+    """Interleaved 1F1B (v virtual stages per rank) shrinks the TIME
+    bubble vs GPipe at n_micro >= 4."""
+    for n, m in ((2, 4), (4, 8), (2, 8)):
+        g = build_schedule("gpipe", n, m)
+        i2 = build_schedule("interleaved", n, m, n_chunks=2)
+        assert i2.bubble_fraction() < g.bubble_fraction()
+
+
+def test_schedule_tables_are_dependency_valid():
+    """Every F(s, mb) fires strictly after F(s-1, mb); every B(s, mb)
+    strictly after F(s, mb) and B(s+1, mb)."""
+    for kind, v in (("gpipe", 1), ("1f1b", 1), ("interleaved", 2)):
+        s = build_schedule(kind, 4, 8, n_chunks=v)
+        done_f, done_b = {}, {}
+        for t in range(s.n_ticks):
+            row = s.table[t]
+            for r in range(s.n_ranks):
+                op, mb, c = row[r]
+                stage = c * s.n_ranks + r
+                if op == 1:
+                    if stage > 0:
+                        assert done_f[(stage - 1, mb)] < t
+                    done_f[(stage, mb)] = t
+                elif op == 2:
+                    assert done_f[(stage, mb)] < t
+                    if stage < v * s.n_ranks - 1:
+                        assert done_b[(stage + 1, mb)] < t
+                    done_b[(stage, mb)] = t
+        total = v * s.n_ranks * s.n_micro
+        assert len(done_f) == total and len(done_b) == total
+
+
+# ---------------------------------------------------------------------------
+# executor parity
+
+
+def _stage_fn(x, p):
+    return x + jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _loss_fn(y, lab):
+    return jnp.mean((y - lab) ** 2)
+
+
+def _make_problem(n_stages, m, mb=4, h=8, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {
+        "w": jnp.asarray(rng.randn(n_stages, h, h) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.randn(n_stages, h) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(m, mb, h), jnp.float32)
+    lab = jnp.asarray(rng.randn(m, mb, h), jnp.float32)
+    return params, x, lab
+
+
+def _reference(params, x, lab, stage_order):
+    """Plain autodiff over sequentially-applied stages. stage_order[s] is
+    the index into the stacked params holding stage s (identity for v=1,
+    the rank-major permutation for interleaved)."""
+
+    def loss(params):
+        tot = 0.0
+        for i in range(x.shape[0]):
+            h = x[i]
+            for s in stage_order:
+                h = _stage_fn(h, jax.tree_util.tree_map(
+                    lambda l: l[s], params))
+            tot = tot + _loss_fn(h, lab[i])
+        return tot / x.shape[0]
+
+    return jax.value_and_grad(loss)(params)
+
+
+def _run_on_mesh(schedule, params, x, lab, n_ranks):
+    mesh = Mesh(np.asarray(jax.devices()[:n_ranks]), ("pp",))
+
+    def fn(params, x, lab):
+        return pipeline_step(schedule, _stage_fn, _loss_fn, params, x,
+                             lab, axis="pp")
+
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params),
+                  P(), P()),
+        out_specs=(P(), jax.tree_util.tree_map(lambda _: P("pp"), params)),
+        check_vma=False))(params, x, lab)
+
+
+@pytest.mark.parametrize("kind", ["gpipe", "1f1b"])
+def test_executor_matches_single_device_8_micro(kind):
+    n, m = 4, 8
+    params, x, lab = _make_problem(n, m)
+    ref_loss, ref_grads = _reference(params, x, lab, range(n))
+
+    sched = build_schedule(kind, n, m)
+    loss, grads = _run_on_mesh(sched, params, x, lab, n)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(ref_grads[k]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_executor_interleaved_matches_single_device():
+    """v=2 virtual stages per rank: the stacked params are rank-major
+    (global index r*v + c holds stage c*n + r)."""
+    n, v, m = 2, 2, 8
+    n_stages = n * v
+    params, x, lab = _make_problem(n_stages, m)
+    # stage s lives at stacked index (s % n) * v + s // n
+    order = [(s % n) * v + s // n for s in range(n_stages)]
+    ref_loss, ref_grads = _reference(params, x, lab, order)
+
+    sched = build_schedule("interleaved", n, m, n_chunks=v)
+    loss, grads = _run_on_mesh(sched, params, x, lab, n)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(grads[k]),
+                                   np.asarray(ref_grads[k]),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_executor_trains():
+    """SGD over pipeline_step grads actually reduces the loss."""
+    n, m = 2, 4
+    params, x, lab = _make_problem(n, m, seed=3)
+    sched = build_schedule("1f1b", n, m)
+    losses = []
+    for _ in range(6):
+        loss, grads = _run_on_mesh(sched, params, x, lab, n)
+        losses.append(float(loss))
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.2 * g,
+                                        params, grads)
+    assert losses[-1] < losses[0] * 0.7, losses
