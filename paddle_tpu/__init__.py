@@ -50,6 +50,7 @@ from . import reader
 from .reader import batch
 from . import distribution
 from . import quantization
+from . import slim
 from . import dataset
 
 # dygraph/static mode management (reference: fluid.enable_dygraph /

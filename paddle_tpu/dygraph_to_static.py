@@ -14,8 +14,15 @@ mode and becomes compiled control flow under `jit.to_static` tracing
 Transform scope (the reference's core cases):
 * ``if``/``elif``/``else`` statements → ``convert_ifelse`` with the
   branch-assigned names threaded as explicit operands,
-* ``while`` loops (without break/continue) → ``convert_while`` with the
-  body-assigned names as loop carry,
+* ``while`` loops → ``convert_while`` with the body-assigned names as
+  loop carry; ``break``/``continue`` are lowered first to guard flags
+  (reference: break_continue_transformer.py) — the loop condition gains
+  ``not _brk`` and statements after a potential break/continue point are
+  wrapped in ``if not (_brk or _cont)`` blocks, all tensor-aware,
+* ``for`` over ``range(...)`` or a sequence/Tensor → an index-carrying
+  ``while`` (reference: loop_transformer.py); a ``range`` with traced
+  bounds compiles to ``lax.while_loop``, python iterables keep eager
+  python-loop semantics (the trace unrolls them exactly as before),
 * ``and`` / ``or`` / ``not`` inside the converted predicates →
   ``convert_and/or/not`` (tensor-aware, short-circuit preserved for
   Python values).
@@ -96,23 +103,41 @@ def convert_ifelse(pred, true_fn, false_fn, names, operands,
 
 
 def convert_while(cond_fn, body_fn, names, operands):
-    """Runtime dispatch for a rewritten `while`."""
-    probe = cond_fn(*operands)
-    if not _is_tensorish(probe) and not isinstance(probe, Tensor):
-        # plain python loop
-        vals = tuple(operands)
-        while cond_fn(*vals):
-            out = body_fn(*vals)
-            vals = out if isinstance(out, tuple) else (out,)
-        return vals
-    for v, n in zip(operands, names):
-        if isinstance(v, _Undefined):
-            raise ValueError(
-                f"to_static while-conversion: loop variable '{n}' must be "
-                "initialized before a tensor-dependent `while`")
-    from .ops.control_flow import while_loop as _while
-    out = _while(cond_fn, body_fn, list(operands))
-    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+    """Runtime dispatch for a rewritten `while`.
+
+    Re-probes the condition EVERY iteration: a loop can start with
+    concrete python carries (run eagerly) and turn tensor-dependent
+    mid-loop — e.g. a lowered `break` flag that becomes a traced bool the
+    first time its guard fires — at which point the remaining iterations
+    defer to lax.while_loop with the current values as carry."""
+    def _go_lax(vals):
+        for v, n in zip(vals, names):
+            if isinstance(v, _Undefined):
+                raise ValueError(
+                    f"to_static while-conversion: loop variable '{n}' "
+                    "must be initialized before a tensor-dependent "
+                    "`while`")
+        from .ops.control_flow import while_loop as _while
+        out = _while(cond_fn, body_fn, list(vals))
+        return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+    vals = tuple(operands)
+    while True:
+        probe = cond_fn(*vals)
+        if isinstance(probe, Tensor):
+            if isinstance(probe.data, jax.core.Tracer):
+                return _go_lax(vals)
+            taken = bool(np.asarray(jax.device_get(probe.data)).item())
+        elif _is_tensorish(probe):
+            if isinstance(probe, jax.core.Tracer):
+                return _go_lax(vals)
+            taken = bool(np.asarray(jax.device_get(probe)).item())
+        else:
+            taken = bool(probe)
+        if not taken:
+            return vals
+        out = body_fn(*vals)
+        vals = out if isinstance(out, tuple) else (out,)
 
 
 def convert_and(a_thunk, b_thunk):
@@ -143,6 +168,72 @@ def _as_bool(x):
     if isinstance(x, Tensor) and x.data.dtype != jax.numpy.bool_:
         return M.cast(x, "bool")
     return x
+
+
+def convert_for_seq(it):
+    """Normalize a for-loop iterable ONCE (assigned in the conversion's
+    prelude): Tensors and random-access sequences pass through;
+    enumerate/zip/generators and other len-less iterables materialize to
+    a list — the loop body then indexes without per-iteration copies.
+    (Deviation: an INFINITE generator can no longer be broken out of —
+    the reference's loop_transformer has the same constraint.)"""
+    if isinstance(it, Tensor) or _is_tensorish(it):
+        return it
+    if hasattr(it, "__len__") and hasattr(it, "__getitem__"):
+        return it
+    return list(it)
+
+
+def convert_for_len(it):
+    """Loop length for a for→while conversion. Tensor leading dims are
+    static under jax, so this is a python int for everything but a traced
+    scalar range bound (handled by convert_range_len)."""
+    if isinstance(it, Tensor):
+        return int(it.shape[0])
+    if _is_tensorish(it):
+        return int(it.shape[0])
+    return len(it)
+
+
+def convert_for_item(it, i):
+    """it[i]; tolerates the pre-loop init probe on empty sequences."""
+    if not (isinstance(it, Tensor) or _is_tensorish(it)):
+        if len(it) == 0:
+            return None  # loop body never runs; placeholder only
+        if isinstance(i, Tensor):
+            if isinstance(i.data, jax.core.Tracer):
+                raise ValueError(
+                    "to_static for-conversion: a tensor-dependent loop "
+                    "index over a PYTHON sequence cannot compile — make "
+                    "the iterable a Tensor (stack it) or keep the exit "
+                    "condition concrete")
+            i = int(np.asarray(jax.device_get(i.data)).item())
+        return it[int(i)]
+    return it[i]
+
+
+def convert_range_len(*args):
+    """len(range(start, stop, step)) for int OR Tensor bounds."""
+    if all(isinstance(a, (int, np.integer)) for a in args):
+        return len(range(*[int(a) for a in args]))
+    if len(args) == 1:
+        start, stop, step = 0, args[0], 1
+    elif len(args) == 2:
+        start, stop, step = args[0], args[1], 1
+    else:
+        start, stop, step = args
+    if not isinstance(step, (int, np.integer)):
+        raise ValueError("to_static for-range: a traced STEP is not "
+                         "supported (start/stop may be tensors)")
+    step = int(step)
+    if step == 0:
+        raise ValueError("range() step must not be zero")
+    # ceil((stop-start)/step) clamped at 0, in tensor arithmetic
+    from .ops import math as M
+    n = (stop - start + (step - 1 if step > 0 else step + 1)) // step
+    if isinstance(n, Tensor) or _is_tensorish(n):
+        return M.maximum(n, 0)
+    return max(int(n), 0)
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +350,62 @@ def _has_early_return(stmts):
     return v.found
 
 
+def _contains_break_or_continue(stmt):
+    """break/continue at THIS loop's level inside one statement (nested
+    loops own theirs) — the single-statement view of _has_break."""
+    return _has_break([stmt])
+
+
+def _flag_guard_test(brk, cont):
+    """`not (<brk> or <cont>)` as AST (BoolOp-rewritten later)."""
+    return ast.UnaryOp(op=ast.Not(), operand=ast.BoolOp(
+        op=ast.Or(), values=[ast.Name(id=brk, ctx=ast.Load()),
+                             ast.Name(id=cont, ctx=ast.Load())]))
+
+
+def _set_flag(name):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=True))
+
+
+class _CannotLower(Exception):
+    """break/continue buried where the lowering can't guard (with/try)."""
+
+
+def _lower_break_continue(stmts, brk, cont):
+    """Rewrite a loop body (reference: break_continue_transformer.py):
+    `break`/`continue` become flag assignments, and every statement that
+    could execute after a flag was set is wrapped in
+    `if not (brk or cont): ...` — so the lowered body is flag-pure and
+    the surrounding while converts through the ordinary path."""
+    out = []
+    for i, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_set_flag(brk))
+            break  # statically unreachable afterwards
+        if isinstance(s, ast.Continue):
+            out.append(_set_flag(cont))
+            break
+        if _contains_break_or_continue(s):
+            if isinstance(s, ast.If):
+                s = ast.If(test=s.test,
+                           body=_lower_break_continue(s.body, brk, cont),
+                           orelse=_lower_break_continue(s.orelse, brk,
+                                                        cont)
+                           if s.orelse else [])
+            else:
+                raise _CannotLower(ast.dump(s)[:80])
+            # anything after this statement runs only if no flag fired
+            out.append(s)
+            rest = _lower_break_continue(stmts[i + 1:], brk, cont)
+            if rest:
+                out.append(ast.If(test=_flag_guard_test(brk, cont),
+                                  body=rest, orelse=[]))
+            return out
+        out.append(s)
+    return out
+
+
 class _BoolOpRewriter(ast.NodeTransformer):
     """and/or/not → tensor-aware converters (inside predicates)."""
 
@@ -352,13 +499,38 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # -- while -------------------------------------------------------------
     def visit_While(self, node):
+        if node.orelse or _has_early_return(node.body):
+            self.generic_visit(node)
+            return node  # python semantics kept (logged by caller)
+        prelude = []
+        if _has_break(node.body):
+            # lower break/continue to guard flags FIRST (the guards are
+            # plain `if`s the visitor below then converts tensor-aware)
+            uid = self._uid()
+            brk, cont = f"_jst_brk_{uid}", f"_jst_cont_{uid}"
+            try:
+                body = _lower_break_continue(list(node.body), brk, cont)
+            except _CannotLower:
+                self.generic_visit(node)
+                return node
+            reset_cont = ast.Assign(
+                targets=[ast.Name(id=cont, ctx=ast.Store())],
+                value=ast.Constant(value=False))
+            test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(),
+                            operand=ast.Name(id=brk, ctx=ast.Load())),
+                node.test])
+            node = ast.While(test=test, body=[reset_cont] + body,
+                             orelse=[])
+            # both flags enter the loop carry -> both need pre-loop inits
+            prelude = [ast.Assign(
+                targets=[ast.Name(id=name, ctx=ast.Store())],
+                value=ast.Constant(value=False))
+                for name in (brk, cont)]
         self.generic_visit(node)
-        if node.orelse or _has_break(node.body) or \
-                _has_early_return(node.body):
-            return node
         carry = sorted(_assigned(node.body))
         if not carry:
-            return node
+            return (prelude + [node]) if prelude else node
         uid = self._uid()
         test = _BoolOpRewriter().visit(node.test)
         cname, bname = f"_jst_cond_{uid}", f"_jst_body_{uid}"
@@ -388,7 +560,109 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                       for n in carry], ctx=ast.Load()),
                       ast.Tuple(elts=loads, ctx=ast.Load())],
                 keywords=[]))
-        return [cond_def, body_def, call]
+        return prelude + [cond_def, body_def, call]
+
+    # -- for ---------------------------------------------------------------
+    def visit_For(self, node):
+        """for → index-carrying while (reference: loop_transformer.py).
+        range(...) iterates by arithmetic on (possibly traced) bounds;
+        other iterables go through convert_for_len/item, which keeps
+        python-loop semantics for python sequences (static trace unroll)
+        and row iteration for Tensors."""
+        if node.orelse or _has_early_return(node.body):
+            self.generic_visit(node)
+            return node
+        uid = self._uid()
+        i_name = f"_jst_i_{uid}"
+        n_name = f"_jst_n_{uid}"
+        prelude = []
+
+        def assign(name, value):
+            return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                              value=value)
+
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.iter.keywords)
+        if is_range:
+            rargs = node.iter.args
+            names = []
+            for j, a in enumerate(rargs):
+                rn = f"_jst_r_{uid}_{j}"
+                prelude.append(assign(rn, a))
+                names.append(rn)
+            prelude.append(assign(n_name, ast.Call(
+                func=ast.Name(id="_jst_range_len", ctx=ast.Load()),
+                args=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+                keywords=[])))
+            if len(names) == 1:
+                start, step = ast.Constant(value=0), ast.Constant(value=1)
+            else:
+                start = ast.Name(id=names[0], ctx=ast.Load())
+                step = ast.Name(id=names[2], ctx=ast.Load()) \
+                    if len(names) == 3 else ast.Constant(value=1)
+            item = ast.BinOp(
+                left=start, op=ast.Add(),
+                right=ast.BinOp(left=step, op=ast.Mult(),
+                                right=ast.Name(id=i_name, ctx=ast.Load())))
+            init_item = start
+        else:
+            it_name = f"_jst_it_{uid}"
+            prelude.append(assign(it_name, ast.Call(
+                func=ast.Name(id="_jst_for_seq", ctx=ast.Load()),
+                args=[node.iter], keywords=[])))
+            prelude.append(assign(n_name, ast.Call(
+                func=ast.Name(id="_jst_for_len", ctx=ast.Load()),
+                args=[ast.Name(id=it_name, ctx=ast.Load())],
+                keywords=[])))
+            item = ast.Call(
+                func=ast.Name(id="_jst_for_item", ctx=ast.Load()),
+                args=[ast.Name(id=it_name, ctx=ast.Load()),
+                      ast.Name(id=i_name, ctx=ast.Load())],
+                keywords=[])
+            init_item = ast.Call(
+                func=ast.Name(id="_jst_for_item", ctx=ast.Load()),
+                args=[ast.Name(id=it_name, ctx=ast.Load()),
+                      ast.Constant(value=0)],
+                keywords=[])
+        prelude.append(assign(i_name, ast.Constant(value=0)))
+        # init the target before the loop so convert_while's carry check
+        # passes (never observed when the loop runs zero times)
+        prelude.append(ast.Assign(targets=[node.target], value=init_item))
+        target_assign = ast.Assign(
+            targets=[node.target],
+            value=item)
+        incr = assign(i_name, ast.BinOp(
+            left=ast.Name(id=i_name, ctx=ast.Load()), op=ast.Add(),
+            right=ast.Constant(value=1)))
+        test = ast.Compare(left=ast.Name(id=i_name, ctx=ast.Load()),
+                           ops=[ast.Lt()],
+                           comparators=[ast.Name(id=n_name,
+                                                 ctx=ast.Load())])
+        body = list(node.body)
+        if _has_break(body):
+            # lower break/continue HERE (not in visit_While) so the index
+            # increment stays OUTSIDE the guards: `continue` must skip
+            # the rest of the body but still advance the index
+            brk, cont = f"_jst_brk_{uid}", f"_jst_cont_{uid}"
+            try:
+                body = _lower_break_continue(body, brk, cont)
+            except _CannotLower:
+                self.generic_visit(node)
+                return node
+            body = [assign(cont, ast.Constant(value=False))] + body
+            prelude.append(assign(brk, ast.Constant(value=False)))
+            prelude.append(assign(cont, ast.Constant(value=False)))
+            test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(),
+                            operand=ast.Name(id=brk, ctx=ast.Load())),
+                test])
+        loop = ast.While(test=test,
+                         body=[target_assign] + body + [incr],
+                         orelse=[])
+        out = self.visit_While(loop)
+        return prelude + (out if isinstance(out, list) else [out])
 
 
 def _ld_expr(name):
@@ -408,12 +682,16 @@ _HELPERS = {
     "_jst_or": convert_or,
     "_jst_not": convert_not,
     "_jst_ld": ld,
+    "_jst_for_seq": convert_for_seq,
+    "_jst_for_len": convert_for_len,
+    "_jst_for_item": convert_for_item,
+    "_jst_range_len": convert_range_len,
 }
 
 
 def _needs_transform(tree):
     for node in ast.walk(tree):
-        if isinstance(node, (ast.If, ast.While)):
+        if isinstance(node, (ast.If, ast.While, ast.For)):
             return True
     return False
 

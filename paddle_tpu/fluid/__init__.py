@@ -41,6 +41,21 @@ from .transpiler import (DistributeTranspiler,  # noqa: F401
                          DistributeTranspilerConfig, memory_optimize,
                          release_memory)
 from .data_feeder import DataFeeder, PyReader
+from . import install_check
+from . import debugger
+from . import net_drawer
+from . import evaluator
+from . import trainer_desc
+from . import data_feed_desc
+from .trainer_desc import (TrainerDesc, MultiTrainer,  # noqa: F401
+                           DistMultiTrainer, TrainerFactory, Communicator)
+from .data_feed_desc import DataFeedDesc  # noqa: F401
+# device_worker / trainer_factory / communicator share trainer_desc.py's
+# redesign (one module; the reference splits them only for protobuf
+# codegen reasons)
+device_worker = trainer_desc
+trainer_factory = trainer_desc
+communicator = trainer_desc
 
 
 class Variable(Tensor):

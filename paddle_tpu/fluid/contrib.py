@@ -75,7 +75,16 @@ mixed_precision = types.SimpleNamespace(
     AutoMixedPrecisionLists=AutoMixedPrecisionLists,
 )
 
-slim = types.SimpleNamespace(quantization=_quantization)
+from ..slim import prune as _prune          # noqa: E402
+from ..slim import distill as _distillation  # noqa: E402
+from ..slim import nas as _nas               # noqa: E402
+from ..slim import core as _slim_core        # noqa: E402
+
+slim = types.SimpleNamespace(quantization=_quantization,
+                             prune=_prune,
+                             distillation=_distillation,
+                             nas=_nas,
+                             core=_slim_core)
 quantize = _quantization
 
 
@@ -111,10 +120,15 @@ def extend_with_decoupled_weight_decay(base_optimizer):
     return DecoupledWeightDecay
 
 
-def op_freq_statistic(program):
+def op_freq_statistic(program, *example_args):
     """reference contrib/op_frequence.py:op_freq_statistic — (uni, pair)
-    op-type frequency counters over the recorded graph."""
+    op-type frequency counters over the recorded graph. Also accepts a
+    CALLABLE + example args: counts primitive names in the traced jaxpr
+    (the op stream XLA actually compiles) — contrib_tools.py."""
     from collections import Counter, OrderedDict
+    if callable(program) and not hasattr(program, "blocks"):
+        from .contrib_tools import op_freq_statistic as _jaxpr_freq
+        return _jaxpr_freq(program, *example_args)
     uni = Counter()
     adj = Counter()
     for block in program.blocks:
@@ -130,8 +144,13 @@ def op_freq_statistic(program):
 def memory_usage(program, batch_size=1):
     """reference contrib/memory_usage_calc.py:memory_usage — lower/upper
     estimate (MB) from the program's var shapes with None/-1 dims filled
-    by batch_size."""
+    by batch_size. Also accepts an nn.Layer (params+grads .. +adam-slot
+    band — contrib_tools.py)."""
     import numpy as _np
+    from ..nn.layer import Layer as _Layer
+    if isinstance(program, _Layer):
+        from .contrib_tools import memory_usage as _layer_mem
+        return _layer_mem(program, batch_size)
     total = 0.0
     for block in program.blocks:
         for var in block.vars.values():
@@ -150,9 +169,16 @@ def memory_usage(program, batch_size=1):
     return mb * 0.9, mb * 1.1
 
 
-def summary(main_prog):
+def summary(main_prog, input_spec=None, input=None):
     """reference contrib/model_stat.py:summary — PARAMs/FLOPs table over
-    the recorded static program; returns the table string (and prints)."""
+    the recorded static program; returns the table string (and prints).
+    Also accepts an nn.Layer + example input: per-layer shape/param/FLOPs
+    table via capture hooks (contrib_tools.py)."""
+    from ..nn.layer import Layer as _Layer
+    if isinstance(main_prog, _Layer):
+        from .contrib_tools import summary as _layer_summary
+        return _layer_summary(main_prog, input_spec=input_spec,
+                              input=input)
     rows = []
     total_params = 0
     for name, p in main_prog.param_vars.items():

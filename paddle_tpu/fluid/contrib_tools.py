@@ -1,0 +1,150 @@
+"""contrib stat utilities: model_stat, memory_usage_calc, op_frequence
+(reference: python/paddle/fluid/contrib/{model_stat.py:40 summary,
+memory_usage_calc.py:46 memory_usage, op_frequence.py}).
+
+The reference walks static Program op-descs. The rebuild offers both
+entry points that matter here: Layer-based (params/FLOPs from a shaped
+forward with capture hooks — the dygraph-natural form) and function-based
+(op frequency from the actual traced jaxpr, which is what XLA compiles)."""
+from __future__ import annotations
+
+from collections import Counter, OrderedDict
+
+import numpy as np
+
+__all__ = ["summary", "memory_usage", "op_freq_statistic"]
+
+_DTYPE_BYTES = {"float32": 4, "float64": 8, "float16": 2, "bfloat16": 2,
+                "int64": 8, "int32": 4, "int16": 2, "int8": 1, "uint8": 1,
+                "bool": 1}
+
+
+def _flops_of(layer, x_shape, y_shape):
+    """Per-layer MAC-based FLOPs (reference model_stat counts convs and
+    muls the same way)."""
+    from .. import nn
+    if isinstance(layer, nn.Linear):
+        out = int(np.prod(y_shape))
+        return out * layer.weight.shape[0] * 2
+    if isinstance(layer, nn.Conv2D):
+        kh, kw = layer.weight.shape[-2:]
+        cin = layer.weight.shape[1]
+        out = int(np.prod(y_shape))
+        return out * cin * kh * kw * 2
+    return 0
+
+
+def summary(model, input_spec=None, input=None):
+    """Layer/param/FLOPs table (reference: model_stat.py:40 summary).
+
+    model: an nn.Layer; input_spec: example input(s) (Tensor/ndarray or
+    tuple) run through the model with shape-capture hooks. Returns the
+    table text and prints it."""
+    from ..tensor import Tensor
+    from .. import to_tensor
+
+    rows = []
+    handles = []
+
+    def cap(name):
+        def hook(layer, inputs, output):
+            x = inputs[0] if inputs else None
+            xs = tuple(getattr(x, "shape", ())) if x is not None else ()
+            ys = tuple(getattr(output, "shape", ())) \
+                if not isinstance(output, (tuple, list)) else \
+                tuple(getattr(output[0], "shape", ()))
+            n_params = sum(int(np.prod(p.shape))
+                           for p in layer._parameters.values()
+                           if p is not None)
+            rows.append((name or type(layer).__name__,
+                         type(layer).__name__, xs, ys, n_params,
+                         _flops_of(layer, xs, ys)))
+            return None
+        return hook
+
+    for name, sub in model.named_sublayers():
+        if not sub._sub_layers:  # leaves only
+            handles.append(sub.register_forward_post_hook(cap(name)))
+    example = input if input is not None else input_spec
+    if example is not None:
+        model.eval()
+        if not isinstance(example, (tuple, list)):
+            example = (example,)
+        example = tuple(to_tensor(np.asarray(e)) if not isinstance(e, Tensor)
+                        else e for e in example)
+        from .. import autograd
+        with autograd.no_grad():
+            model(*example)
+    for h in handles:
+        h.remove()
+
+    total_params = sum(r[4] for r in rows)
+    total_flops = sum(r[5] for r in rows)
+    lines = [f"{'layer':<28}{'type':<14}{'input':<18}{'output':<18}"
+             f"{'params':>10}{'FLOPs':>14}"]
+    for r in rows:
+        lines.append(f"{r[0]:<28}{r[1]:<14}{str(r[2]):<18}{str(r[3]):<18}"
+                     f"{r[4]:>10}{r[5]:>14}")
+    lines.append(f"Total params: {total_params:,}  "
+                 f"({total_params * 4 / 1024 / 1024:.2f} MB fp32)")
+    lines.append(f"Total FLOPs: {total_flops:,} "
+                 f"({total_flops / 1e9:.3f} GFLOPs/sample-batch)")
+    text = "\n".join(lines)
+    print(text)
+    return OrderedDict(total_params=total_params, total_flops=total_flops,
+                       table=text)
+
+
+def memory_usage(program_or_model, batch_size=1):
+    """Rough training-memory estimate in MB (reference:
+    memory_usage_calc.py:46 — sums var bytes with a lower/upper band).
+
+    Accepts a static Program (sums its recorded vars) or an nn.Layer
+    (params + grads + adam-style slots as the steady-state band)."""
+    from ..nn.layer import Layer
+
+    if isinstance(program_or_model, Layer):
+        p_bytes = 0
+        for p in program_or_model.parameters():
+            nbytes = int(np.prod(p.shape)) * _DTYPE_BYTES.get(
+                str(p.data.dtype), 4)
+            p_bytes += nbytes
+        low = p_bytes * 2 / 1024 / 1024          # params + grads
+        high = p_bytes * 4 / 1024 / 1024         # + two adam slots
+        return low, high
+
+    program = program_or_model
+    total = 0
+    for name, v in program.global_block().vars.items():
+        shape = [batch_size if (d is None or d < 0) else d
+                 for d in (v.shape or ())]
+        total += int(np.prod(shape)) * _DTYPE_BYTES.get(
+            str(getattr(v, "dtype", "float32")), 4)
+    mb = total / 1024 / 1024
+    # the reference reports a +-30% band around the op-desc estimate
+    return mb * 0.7, mb * 1.3
+
+
+def op_freq_statistic(program_or_fn, *example_args):
+    """Op frequency count (reference: op_frequence.py op_freq_statistic).
+
+    For a static Program: counts recorded OpNode types. For a callable +
+    example args: counts primitive names in the TRACED jaxpr — the op
+    stream XLA actually compiles."""
+    if callable(program_or_fn) and not hasattr(program_or_fn,
+                                               "global_block"):
+        import jax
+        jaxpr = jax.make_jaxpr(program_or_fn)(*example_args)
+
+        def walk(jx, c):
+            for eqn in jx.eqns:
+                c[eqn.primitive.name] += 1
+                for sub in eqn.params.values():
+                    if hasattr(sub, "jaxpr"):
+                        walk(sub.jaxpr, c)
+            return c
+
+        return Counter(walk(jaxpr.jaxpr, Counter()))
+    program = program_or_fn
+    return Counter(op.type or "unknown"
+                   for op in program.global_block().ops)

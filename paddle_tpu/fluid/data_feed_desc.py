@@ -1,0 +1,93 @@
+"""fluid.data_feed_desc (reference:
+python/paddle/fluid/data_feed_desc.py — wraps the DataFeedDesc protobuf
+describing MultiSlot datasets: slot names/types/dims, batch size, the
+pipe command).
+
+The rebuild parses the same protobuf-TEXT format (so existing .prototxt
+feed configs load unchanged) into a plain dict the dataset/ parsers and
+io.DataLoader consume — no protobuf dependency needed for the subset the
+reference actually uses."""
+from __future__ import annotations
+
+import re
+
+__all__ = ["DataFeedDesc"]
+
+
+class DataFeedDesc:
+    """Parse + edit a MultiSlot data feed description.
+
+    Accepts the reference's proto-text, e.g.::
+
+        name: "MultiSlotDataFeed"
+        batch_size: 2
+        multi_slot_desc {
+          slots { name: "words"  type: "uint64" is_dense: false is_used: true }
+          slots { name: "label"  type: "uint64" is_dense: false is_used: true }
+        }
+    """
+
+    def __init__(self, proto_info):
+        self.proto_desc = {"name": "MultiSlotDataFeed", "batch_size": 1}
+        self.slots = []  # list of dicts: name/type/is_dense/is_used/dims
+        self._parse(proto_info)
+
+    # -- proto-text subset parser -------------------------------------------
+    def _parse(self, text):
+        top = re.sub(r"multi_slot_desc\s*{(.*)}", "", text,
+                     flags=re.DOTALL)
+        for key, val in re.findall(r"(\w+)\s*:\s*(\"[^\"]*\"|\S+)", top):
+            self.proto_desc[key] = self._val(val)
+        for slot_txt in re.findall(r"slots\s*{([^}]*)}", text):
+            slot = {"name": "", "type": "uint64", "is_dense": False,
+                    "is_used": False, "dims": []}
+            for key, val in re.findall(r"(\w+)\s*:\s*(\"[^\"]*\"|\S+)",
+                                       slot_txt):
+                if key == "dims":
+                    slot["dims"].append(int(val))
+                else:
+                    slot[key] = self._val(val)
+            self.slots.append(slot)
+
+    @staticmethod
+    def _val(tok):
+        if tok.startswith('"'):
+            return tok.strip('"')
+        if tok in ("true", "false"):
+            return tok == "true"
+        try:
+            return int(tok)
+        except ValueError:
+            return tok
+
+    # -- reference API ------------------------------------------------------
+    def set_batch_size(self, batch_size):
+        self.proto_desc["batch_size"] = int(batch_size)
+
+    def set_dense_slots(self, dense_slots_name):
+        for s in self.slots:
+            if s["name"] in dense_slots_name:
+                s["is_dense"] = True
+
+    def set_use_slots(self, use_slots_name):
+        for s in self.slots:
+            if s["name"] in use_slots_name:
+                s["is_used"] = True
+
+    def desc(self):
+        """Text form (reference returns proto text; we return the same
+        fields re-serialized)."""
+        lines = [f'name: "{self.proto_desc["name"]}"',
+                 f'batch_size: {self.proto_desc["batch_size"]}',
+                 "multi_slot_desc {"]
+        for s in self.slots:
+            dims = "".join(f" dims: {d}" for d in s["dims"])
+            lines.append(
+                f'  slots {{ name: "{s["name"]}" type: "{s["type"]}" '
+                f'is_dense: {str(s["is_dense"]).lower()} '
+                f'is_used: {str(s["is_used"]).lower()}{dims} }}')
+        lines.append("}")
+        return "\n".join(lines)
+
+    def used_slots(self):
+        return [s["name"] for s in self.slots if s["is_used"]]

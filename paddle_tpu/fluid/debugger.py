@@ -1,0 +1,91 @@
+"""fluid.debugger — Program visualization + pretty printing (reference:
+python/paddle/fluid/debugger.py:1 draw_block_graphviz /
+pprint_program_codes, graphviz.py Graph builder).
+
+Works on this framework's static Program (static/__init__.py Block of
+OpNodes): ops and vars become graphviz nodes with data edges. The DOT
+text is self-contained — no graphviz python binding needed; `dot -Tpng`
+renders it."""
+from __future__ import annotations
+
+__all__ = ["draw_block_graphviz", "pprint_block_codes",
+           "pprint_program_codes", "program_to_dot"]
+
+
+def _esc(s):
+    return str(s).replace('"', r'\"')
+
+
+def program_to_dot(program, graph_name="program"):
+    """DOT source for a static Program's global block (ops = boxes,
+    vars = ellipses, data deps = edges)."""
+    block = program.global_block()
+    lines = [f'digraph "{_esc(graph_name)}" {{',
+             "  rankdir=TB;",
+             '  node [fontsize=10];']
+    feed_names = set(program.feed_vars)
+    param_names = set(program.param_vars)
+    seen_vars = set()
+
+    def var_node(name):
+        if name in seen_vars:
+            return
+        seen_vars.add(name)
+        v = block.vars.get(name)
+        shape = getattr(v, "shape", None)
+        label = f"{name}\\n{shape}" if shape is not None else name
+        if name in feed_names:
+            color = "lightblue"
+        elif name in param_names:
+            color = "lightyellow"
+        else:
+            color = "white"
+        lines.append(f'  "v_{_esc(name)}" [label="{_esc(label)}", '
+                     f'shape=ellipse, style=filled, fillcolor={color}];')
+
+    for i, op in enumerate(block.ops):
+        op_id = f"op_{i}"
+        typ = op.type or "op"
+        lines.append(f'  "{op_id}" [label="{_esc(typ)}", shape=box, '
+                     'style=filled, fillcolor=lightgrey];')
+        for name in op.inputs:
+            var_node(name)
+            lines.append(f'  "v_{_esc(name)}" -> "{op_id}";')
+        for name in op.outputs:
+            var_node(name)
+            lines.append(f'  "{op_id}" -> "v_{_esc(name)}";')
+    lines.append("}")
+    return "\n".join(lines)
+
+
+def draw_block_graphviz(block_or_program, highlights=None, path=None):
+    """reference: debugger.py draw_block_graphviz — write the block's
+    graph as DOT to `path` (default ./program.dot); returns the DOT
+    text."""
+    program = getattr(block_or_program, "program", block_or_program)
+    dot = program_to_dot(program)
+    path = path or "./program.dot"
+    with open(path, "w") as f:
+        f.write(dot)
+    return dot
+
+
+def pprint_block_codes(block, show_backward=False):
+    """Program-as-pseudocode text (reference: debugger.py
+    pprint_block_codes)."""
+    out = []
+    for i, op in enumerate(block.ops):
+        ins = ", ".join(op.inputs)
+        outs = ", ".join(op.outputs)
+        attrs = ""
+        if op.attrs:
+            attrs = " {" + ", ".join(
+                f"{k}={v!r}" for k, v in sorted(op.attrs.items())
+                if not callable(v)) + "}"
+        out.append(f"{i:4d}: {outs or '_'} = {op.type or 'op'}({ins})"
+                   f"{attrs}")
+    return "\n".join(out)
+
+
+def pprint_program_codes(program):
+    return pprint_block_codes(program.global_block())

@@ -1,0 +1,103 @@
+"""fluid.evaluator (reference: python/paddle/fluid/evaluator.py:1 — the
+fluid-era Evaluator family, deprecated upstream in favor of
+fluid.metrics; kept for API parity).
+
+The reference Evaluators maintain accumulator VARIABLES inside the
+Program and emit update ops each step. The rebuild keeps accumulation on
+the host (the numbers involved are a handful of scalars; device round
+trips would cost more than they save) and delegates the math to
+paddle_tpu.metric, which is the maintained implementation."""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+from .. import metric as _metric
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+class Evaluator:
+    """reference: evaluator.py:45 — base: states + reset/eval."""
+
+    def __init__(self, name=None, **kwargs):
+        warnings.warn("fluid.evaluator.* is the deprecated fluid-era API;"
+                      " prefer paddle_tpu.metric", DeprecationWarning,
+                      stacklevel=2)
+        self.name = name
+        self.states = []
+
+    def reset(self, executor=None, reset_program=None):
+        self._m.reset()
+
+    def eval(self, executor=None, eval_program=None):
+        return self._m.accumulate()
+
+
+class ChunkEvaluator(Evaluator):
+    """reference: evaluator.py:127 — chunking F1 from per-batch counts.
+    update(num_infer_chunks, num_label_chunks, num_correct_chunks)."""
+
+    def __init__(self, input=None, label=None, chunk_scheme=None,
+                 num_chunk_types=None, excluded_chunk_types=None,
+                 name=None):
+        super().__init__(name)
+        self._m = _metric.ChunkEvaluator()
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self._m.update(num_infer_chunks, num_label_chunks,
+                       num_correct_chunks)
+        return self._m.accumulate()
+
+
+class EditDistance(Evaluator):
+    """reference: evaluator.py:218 — accumulates PRECOMPUTED per-instance
+    distances (the reference wires an edit_distance op in front); returns
+    (avg distance, instance error rate)."""
+
+    def __init__(self, input=None, label=None, ignored_tokens=None,
+                 name=None):
+        super().__init__(name)
+        self.reset()
+
+    def reset(self, executor=None, reset_program=None):
+        self._total = 0.0
+        self._seq_num = 0
+        self._errors = 0
+
+    def update(self, distances, seq_num=None):
+        distances = np.asarray(distances, "f4").reshape(-1)
+        self._total += float(distances.sum())
+        self._seq_num += int(seq_num if seq_num is not None
+                             else len(distances))
+        self._errors += int((distances > 0).sum())
+        return self.eval()
+
+    def eval(self, executor=None, eval_program=None):
+        if not self._seq_num:
+            return 0.0, 0.0
+        return (self._total / self._seq_num,
+                self._errors / self._seq_num)
+
+
+class DetectionMAP(Evaluator):
+    """reference: evaluator.py:299 — detection mean average precision."""
+
+    def __init__(self, input=None, gt_label=None, gt_box=None,
+                 gt_difficult=None, class_num=None,
+                 background_label=0, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral", name=None):
+        super().__init__(name)
+        self._m = _metric.DetectionMAP(
+            class_num=class_num, overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult, ap_version=ap_version,
+            background_label=background_label)
+
+    def update(self, *args, **kwargs):
+        self._m.update(*args, **kwargs)
+        return self._m.accumulate()
+
+    def get_map_var(self):
+        return None  # no Program variable in the rebuilt design

@@ -1,0 +1,22 @@
+"""fluid.net_drawer (reference: python/paddle/fluid/net_drawer.py —
+Graphviz op-graph drawing CLI). Thin front over debugger.program_to_dot
+for the rebuilt Program."""
+from __future__ import annotations
+
+from .debugger import program_to_dot
+
+__all__ = ["draw_graph"]
+
+
+def draw_graph(startup_program=None, main_program=None, graph_name="graph",
+               path=None, **_):
+    """Write main_program's op graph as DOT (reference keeps startup and
+    main separate; startup in this rebuild is parameter placement, which
+    has no op graph)."""
+    from .. import static
+    program = main_program or static.default_main_program()
+    dot = program_to_dot(program, graph_name=graph_name)
+    if path:
+        with open(path, "w") as f:
+            f.write(dot)
+    return dot

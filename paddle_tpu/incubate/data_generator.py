@@ -1,0 +1,108 @@
+"""incubate.data_generator — the CTR data-generator protocol (reference:
+python/paddle/fluid/incubate/data_generator/__init__.py:21 DataGenerator,
+MultiSlotDataGenerator, MultiSlotStringDataGenerator).
+
+Users subclass and implement ``generate_sample(line)`` returning a
+generator of (slot_name, values) tuples; ``run_from_stdin`` /
+``run_from_memory`` emit the MultiSlot text protocol consumed by the
+dataset feeders (and by the reference's C++ DataFeed — the wire format is
+kept byte-compatible so existing ETL pipelines keep working)."""
+from __future__ import annotations
+
+import sys
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator"]
+
+
+class DataGenerator:
+    """reference: data_generator/__init__.py:21."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    # -- user hooks ---------------------------------------------------------
+    def generate_sample(self, line):
+        """Override: return a generator yielding one or more samples,
+        each a list/tuple of (slot_name, value_list) pairs."""
+        raise NotImplementedError(
+            "implement generate_sample(self, line) in your subclass")
+
+    def generate_batch(self, samples):
+        """Override optionally: batch-level postprocess; yields samples."""
+        for s in samples:
+            yield s
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = batch_size
+
+    # -- drivers ------------------------------------------------------------
+    def run_from_stdin(self):
+        """Read lines from stdin, write protocol lines to stdout (the
+        shape MapReduce-style ETL invokes)."""
+        batch = []
+        for line in sys.stdin:
+            for sample in self._samples_of(line):
+                batch.append(sample)
+                if len(batch) >= self.batch_size_:
+                    self._flush(batch)
+                    batch = []
+        if batch:
+            self._flush(batch)
+
+    def run_from_memory(self):
+        """Self-test driver: generate_sample(None) repeatedly."""
+        batch = []
+        for sample in self._samples_of(None):
+            batch.append(sample)
+            if len(batch) >= self.batch_size_:
+                self._flush(batch)
+                batch = []
+        if batch:
+            self._flush(batch)
+
+    # -- internals ----------------------------------------------------------
+    def _samples_of(self, line):
+        gen = self.generate_sample(line)
+        if gen is None:
+            return
+        for sample in gen() if callable(gen) else gen:
+            if sample is not None:
+                yield sample
+
+    def _flush(self, batch):
+        for sample in self.generate_batch(batch):
+            sys.stdout.write(self._gen_str(sample))
+
+    def _gen_str(self, line):
+        raise NotImplementedError
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Numeric slots: ``<n> v1 .. vn`` per slot, space-joined
+    (reference MultiSlotDataGenerator._gen_str)."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError("sample must be a list of "
+                             "(slot_name, values) pairs")
+        parts = []
+        for _name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """String slots: same framing, values passed through as strings."""
+
+    def _gen_str(self, line):
+        if not isinstance(line, (list, tuple)):
+            raise ValueError("sample must be a list of "
+                             "(slot_name, values) pairs")
+        parts = []
+        for _name, values in line:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts) + "\n"

@@ -20,6 +20,7 @@ import os
 import pickle
 import threading
 import queue as _queue
+import warnings
 
 import numpy as np
 import jax
@@ -277,9 +278,44 @@ def default_collate_fn(batch):
     return np.stack([np.asarray(b) for b in batch])
 
 
+def _mp_worker_loop(dataset, collate_fn, index_q, data_q):
+    """Worker-process body (reference: fluid/dataloader/dataloader_iter.py
+    _worker_loop): pull (batch_id, indices), push (batch_id, batch).
+    Runs dataset[i] + collate in a separate PROCESS, so Python-level
+    decode/augment transforms scale past the GIL.
+
+    Workers are FORKED (zero-copy dataset inheritance) after jax may have
+    initialized in the parent — safe ONLY because this loop never touches
+    jax: datasets/collate for num_workers>0 must return numpy, not device
+    arrays (same rule as the reference's worker processes, which must not
+    touch CUDA)."""
+    while True:
+        job = index_q.get()
+        if job is None:
+            break
+        bid, idx = job
+        try:
+            data_q.put((bid, collate_fn([dataset[i] for i in idx])))
+        except BaseException as e:  # surface to the consumer
+            try:
+                pickle.dumps(e)  # Queue.put pickles in a FEEDER THREAD —
+                # a pickling failure there is silent, so pre-validate
+                data_q.put((bid, _WorkerError(e)))
+            except Exception:
+                import traceback
+                data_q.put((bid, _WorkerError(RuntimeError(
+                    "worker failed (original exception unpicklable):\n"
+                    + traceback.format_exc()))))
+
+
 class DataLoader:
-    """reference: fluid/reader.py DataLoader. Background-thread prefetch
-    (the C++ fast path in csrc covers contiguous array datasets)."""
+    """reference: fluid/reader.py DataLoader +
+    fluid/dataloader/dataloader_iter.py (multiprocess workers).
+
+    num_workers=0: background-thread prefetch (the C++ fast path in csrc
+    covers contiguous array datasets). num_workers>0: that many worker
+    PROCESSES run dataset[i] + collate (order-preserving, windowed
+    dispatch of num_workers*prefetch_factor batches ahead)."""
 
     def __init__(self, dataset, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, prefetch_factor=2,
@@ -322,6 +358,9 @@ class DataLoader:
             q.put(_WorkerError(e))
 
     def __iter__(self):
+        if self.num_workers > 0 and self._native_epoch is None:
+            yield from self._iter_multiprocess()
+            return
         if self._native_epoch is not None:
             yield from self._native_epoch
             return
@@ -342,6 +381,84 @@ class DataLoader:
             if isinstance(item, _WorkerError):
                 raise item.exc
             yield item
+
+    def _iter_multiprocess(self):
+        """Order-preserving multiprocess iteration (reference:
+        dataloader_iter.py _DataLoaderIterMultiProcess). Fork-start
+        workers inherit the dataset without pickling; index batches are
+        dispatched num_workers*prefetch ahead and results are reordered
+        by batch id."""
+        import multiprocessing as mp
+        try:
+            ctx = mp.get_context("fork")
+        except ValueError:  # pragma: no cover - non-posix fallback
+            warnings.warn("fork unavailable; num_workers>0 falls back to "
+                          "the threaded loader")
+            saved, self.num_workers = self.num_workers, 0
+            try:
+                yield from self.__iter__()
+            finally:
+                self.num_workers = saved
+            return
+        index_q = ctx.Queue()
+        data_q = ctx.Queue()
+        workers = [
+            ctx.Process(target=_mp_worker_loop,
+                        args=(self.dataset, self.collate_fn, index_q,
+                              data_q), daemon=True)
+            for _ in range(self.num_workers)
+        ]
+        for w in workers:
+            w.start()
+        batches = list(self.batch_sampler)
+        ahead = max(1, self.num_workers * self.prefetch)
+        sent = 0
+        pending = {}
+        try:
+            while sent < min(ahead, len(batches)):
+                index_q.put((sent, batches[sent]))
+                sent += 1
+            stall_limit = 120.0  # seconds without ANY batch arriving
+            for want in range(len(batches)):
+                waited = 0.0
+                while want not in pending:
+                    try:
+                        bid, item = data_q.get(timeout=5.0)
+                    except _queue.Empty:
+                        dead = [w for w in workers
+                                if not w.is_alive() and w.exitcode]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker died (exitcode "
+                                f"{dead[0].exitcode}) — batch {want} "
+                                "will never arrive")
+                        waited += 5.0
+                        if waited >= stall_limit:
+                            raise RuntimeError(
+                                f"DataLoader stalled {stall_limit:.0f}s "
+                                f"waiting for batch {want}: a worker's "
+                                "batch likely failed to pickle (batches "
+                                "must be numpy, not device arrays) or a "
+                                "transform is hung")
+                        continue
+                    waited = 0.0
+                    if isinstance(item, _WorkerError):
+                        raise item.exc
+                    pending[bid] = item
+                if sent < len(batches):
+                    index_q.put((sent, batches[sent]))
+                    sent += 1
+                yield pending.pop(want)
+        finally:
+            for _ in workers:
+                try:
+                    index_q.put_nowait(None)
+                except Exception:
+                    pass
+            for w in workers:
+                w.join(timeout=1.0)
+                if w.is_alive():  # pragma: no cover
+                    w.terminate()
 
 
 _SENTINEL = object()

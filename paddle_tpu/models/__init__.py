@@ -36,4 +36,7 @@ def __getattr__(name):
     if name in ("YOLOv3", "SSD"):
         from . import detection
         return getattr(detection, name)
+    if name in ("SEResNeXt", "se_resnext50", "se_resnext101"):
+        from . import se_resnext
+        return getattr(se_resnext, name)
     raise AttributeError(name)

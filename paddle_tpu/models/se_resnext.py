@@ -1,0 +1,116 @@
+"""SE-ResNeXt (reference: the fluid benchmark/dist-train model family —
+python/paddle/fluid/tests/unittests/dist_se_resnext.py — grouped
+bottlenecks + squeeze-and-excitation gating).
+
+TPU notes: grouped 3x3 convs lower to one MXU conv with
+feature_group_count=cardinality; the SE block's global-pool + two tiny
+FCs fuse into the epilogue under XLA. NCHW at the API like the rest of
+the zoo (data_format="NHWC" available for layout A/B on TPU)."""
+from __future__ import annotations
+
+from .. import nn, ops
+
+
+class SEBlock(nn.Layer):
+    """Squeeze-and-excitation: global-avg-pool -> fc/r -> relu -> fc ->
+    sigmoid channel gate."""
+
+    def __init__(self, channels, reduction=16, data_format="NCHW"):
+        super().__init__()
+        self._df = data_format
+        mid = max(channels // reduction, 4)
+        self.squeeze = nn.Linear(channels, mid)
+        self.excite = nn.Linear(mid, channels)
+
+    def forward(self, x):
+        axes = [2, 3] if self._df == "NCHW" else [1, 2]
+        s = x.mean(axis=axes)                      # [N, C]
+        s = ops.sigmoid(self.excite(ops.relu(self.squeeze(s))))
+        if self._df == "NCHW":
+            s = s.unsqueeze(-1).unsqueeze(-1)
+        else:
+            s = s.unsqueeze(1).unsqueeze(1)
+        return x * s
+
+
+class SEResNeXtBottleneck(nn.Layer):
+    expansion = 2
+
+    def __init__(self, in_channels, channels, stride=1, cardinality=32,
+                 reduction=16, downsample=None, data_format="NCHW"):
+        super().__init__()
+        df = dict(data_format=data_format)
+        self.conv0 = nn.Conv2D(in_channels, channels, 1, bias_attr=False,
+                               **df)
+        self.bn0 = nn.BatchNorm2D(channels, **df)
+        self.conv1 = nn.Conv2D(channels, channels, 3, stride=stride,
+                               padding=1, groups=cardinality,
+                               bias_attr=False, **df)
+        self.bn1 = nn.BatchNorm2D(channels, **df)
+        self.conv2 = nn.Conv2D(channels, channels * self.expansion, 1,
+                               bias_attr=False, **df)
+        self.bn2 = nn.BatchNorm2D(channels * self.expansion, **df)
+        self.se = SEBlock(channels * self.expansion, reduction,
+                          data_format=data_format)
+        self.relu = nn.ReLU()
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn0(self.conv0(x)))
+        out = self.relu(self.bn1(self.conv1(out)))
+        out = self.se(self.bn2(self.conv2(out)))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class SEResNeXt(nn.Layer):
+    """depths e.g. [3, 4, 6, 3] (50-layer) / [3, 4, 23, 3] (101)."""
+
+    def __init__(self, depths, num_classes=1000, cardinality=32,
+                 data_format="NCHW"):
+        super().__init__()
+        df = dict(data_format=data_format)
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3, bias_attr=False,
+                      **df),
+            nn.BatchNorm2D(64, **df), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1, **df))
+        in_ch = 64
+        stages = []
+        channels = 128
+        for si, depth in enumerate(depths):
+            blocks = []
+            for bi in range(depth):
+                stride = 2 if (si > 0 and bi == 0) else 1
+                downsample = None
+                out_ch = channels * SEResNeXtBottleneck.expansion
+                if stride != 1 or in_ch != out_ch:
+                    downsample = nn.Sequential(
+                        nn.Conv2D(in_ch, out_ch, 1, stride=stride,
+                                  bias_attr=False, **df),
+                        nn.BatchNorm2D(out_ch, **df))
+                blocks.append(SEResNeXtBottleneck(
+                    in_ch, channels, stride=stride,
+                    cardinality=cardinality, downsample=downsample,
+                    data_format=data_format))
+                in_ch = out_ch
+            stages.append(nn.Sequential(*blocks))
+            channels *= 2
+        self.stages = nn.Sequential(*stages)
+        self._df = data_format
+        self.head = nn.Linear(in_ch, num_classes)
+
+    def forward(self, x):
+        x = self.stages(self.stem(x))
+        axes = [2, 3] if self._df == "NCHW" else [1, 2]
+        return self.head(x.mean(axis=axes))
+
+
+def se_resnext50(num_classes=1000, **kw):
+    return SEResNeXt([3, 4, 6, 3], num_classes, **kw)
+
+
+def se_resnext101(num_classes=1000, **kw):
+    return SEResNeXt([3, 4, 23, 3], num_classes, **kw)

@@ -192,25 +192,60 @@ def _freeze_weight(w, ch_axis, bits):
 
 class QuantizedLinear(Layer):
     """Frozen int8 linear (reference: QuantizationFreezePass output —
-    int8 weight + per-channel scale). Weight ships int8; the matmul
-    dequantizes into the activation dtype for the MXU. A calibrated
-    activation scale (from the QAT/PTQ observer) fake-quantizes inputs."""
+    int8 weight + per-channel scale). Weight ships int8.
 
-    def __init__(self, inner, bits=8, act_scale=None, act_bits=8):
+    With a CALIBRATED activation scale (QAT/PTQ observer) the matmul runs
+    int8 x int8 -> int32 on the MXU (`lax.dot_general` with
+    preferred_element_type=int32) and only the edges are float: quantize
+    the input once, rescale the int32 accumulator by
+    act_step * per-channel weight_step. Uncalibrated models keep the
+    dequantize-to-activation-dtype path (memory win only)."""
+
+    def __init__(self, inner, bits=8, act_scale=None, act_bits=8,
+                 int8_compute=True):
         super().__init__()
         q, scale = _freeze_weight(inner.weight, 1, bits)
         self.register_buffer("qweight", Tensor(q), persistable=True)
         self.register_buffer("wscale", Tensor(scale), persistable=True)
-        self.register_buffer("act_scale", Tensor(jnp.asarray(
-            0.0 if act_scale is None else float(np.asarray(
-                jax.device_get(act_scale.data if isinstance(
-                    act_scale, Tensor) else act_scale))), jnp.float32)),
-            persistable=True)
+        a = 0.0 if act_scale is None else float(np.asarray(
+            jax.device_get(act_scale.data if isinstance(
+                act_scale, Tensor) else act_scale)))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(a, jnp.float32)),
+                             persistable=True)
         self._act_bits = act_bits
+        # int8 MXU math needs a host-known calibrated scale (the dtype of
+        # the dot is a trace-time property, not a jnp.where branch)
+        self._int8_opt_in = bool(int8_compute)
+        self._int8_compute = self._int8_opt_in and a > 0.0
         self.bias = inner.bias
 
+    def _refresh_int8_gate(self):
+        """Re-decide the int8-vs-dequant path whenever the act_scale
+        buffer is host-readable: a calibrated state_dict loaded into a
+        convert()-built layer (or a scale zeroed after the fact) must
+        flip the path, not silently keep the construction-time choice."""
+        a = self.act_scale.data
+        if not isinstance(a, jax.core.Tracer):
+            self._int8_compute = self._int8_opt_in and \
+                float(np.asarray(jax.device_get(a))) > 0.0
+
     def forward(self, x):
+        self._refresh_int8_gate()
         a_bits = self._act_bits
+        a_qmax = float(2 ** (a_bits - 1) - 1)
+
+        def impl_int8(x, q, s, ascale, *b):
+            step = jnp.maximum(ascale, 1e-8) / a_qmax
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / step),
+                          -a_qmax, a_qmax).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                xq, q, (((xq.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * step * s  # s: (1, out) steps
+            if b:
+                out = out + b[0]
+            return out.astype(x.dtype)
 
         def impl(x, q, s, ascale, *b):
             x = jnp.where(ascale > 0.0, _qdq(x, ascale, a_bits), x)
@@ -223,33 +258,78 @@ class QuantizedLinear(Layer):
         args = (x, self.qweight, self.wscale, self.act_scale)
         if self.bias is not None:
             args = args + (self.bias,)
-        return apply(impl, args, name="quantized_linear")
+        return apply(impl_int8 if self._int8_compute else impl, args,
+                     name="quantized_linear")
 
 
 class QuantizedConv2D(Layer):
-    def __init__(self, inner, bits=8, act_scale=None, act_bits=8):
+    """Frozen int8 conv — same int8 x int8 -> int32 design as
+    QuantizedLinear (lax.conv_general_dilated accumulates int32 on the
+    MXU when calibrated; dequant-to-float fallback otherwise)."""
+
+    def __init__(self, inner, bits=8, act_scale=None, act_bits=8,
+                 int8_compute=True):
         super().__init__()
         q, scale = _freeze_weight(inner.weight, 0, bits)
-        self.register_buffer("act_scale", Tensor(jnp.asarray(
-            0.0 if act_scale is None else float(np.asarray(
-                jax.device_get(act_scale.data if isinstance(
-                    act_scale, Tensor) else act_scale))), jnp.float32)),
-            persistable=True)
+        a = 0.0 if act_scale is None else float(np.asarray(
+            jax.device_get(act_scale.data if isinstance(
+                act_scale, Tensor) else act_scale)))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(a, jnp.float32)),
+                             persistable=True)
         self._act_bits = act_bits
+        self._int8_opt_in = bool(int8_compute)
+        self._int8_compute = self._int8_opt_in and a > 0.0
         self.register_buffer("qweight", Tensor(q), persistable=True)
         self.register_buffer("wscale", Tensor(scale), persistable=True)
         self.bias = inner.bias
         self._conv_attrs = dict(inner._attrs)
 
+    _refresh_int8_gate = QuantizedLinear._refresh_int8_gate
+
     def forward(self, x):
         from .ops import nn_ops as F
+        self._refresh_int8_gate()
         a_bits = self._act_bits
-        x = apply(lambda x, a: jnp.where(a > 0.0, _qdq(x, a, a_bits), x),
-                  (x, self.act_scale), name="act_quant")
-        w = apply(lambda q, s: q.astype(jnp.float32) * s,
-                  (self.qweight, self.wscale), nondiff=True,
-                  name="dequant_w")
-        return F.conv2d(x, w, self.bias, **self._conv_attrs)
+        if not self._int8_compute:
+            x = apply(lambda x, a: jnp.where(a > 0.0, _qdq(x, a, a_bits),
+                                             x),
+                      (x, self.act_scale), name="act_quant")
+            w = apply(lambda q, s: q.astype(jnp.float32) * s,
+                      (self.qweight, self.wscale), nondiff=True,
+                      name="dequant_w")
+            return F.conv2d(x, w, self.bias, **self._conv_attrs)
+
+        from .ops.nn_ops import (_conv_dimension_numbers, _norm_padding,
+                                 _pair)
+        a_qmax = float(2 ** (a_bits - 1) - 1)
+        at = self._conv_attrs
+        data_format = at.get("data_format", "NCHW")
+        dn = _conv_dimension_numbers(4, data_format)
+        stride = _pair(at.get("stride", 1), 2)
+        padding = _norm_padding(at.get("padding", 0), 2)
+        dilation = _pair(at.get("dilation", 1), 2)
+        groups = at.get("groups", 1)
+
+        def impl(x, q, s, ascale, *b):
+            step = jnp.maximum(ascale, 1e-8) / a_qmax
+            xq = jnp.clip(jnp.round(x.astype(jnp.float32) / step),
+                          -a_qmax, a_qmax).astype(jnp.int8)
+            acc = jax.lax.conv_general_dilated(
+                xq, q, window_strides=stride, padding=padding,
+                rhs_dilation=dilation, feature_group_count=groups,
+                dimension_numbers=dn,
+                preferred_element_type=jnp.int32)
+            ch = (1, -1, 1, 1) if dn[2] == "NCHW" else (1, 1, 1, -1)
+            out = acc.astype(jnp.float32) * step * s.reshape(ch)
+            if b:
+                out = out + b[0].reshape(ch)
+            return out.astype(x.dtype)
+
+        args = (x, self.qweight, self.wscale, self.act_scale)
+        if self.bias is not None:
+            args = args + (self.bias,)
+        return apply(impl, args, name="quantized_conv2d")
 
 
 def convert(model, bits=8):

@@ -1,0 +1,24 @@
+"""paddle_tpu.slim — model compression: pruning, distillation, NAS, and
+the Compressor driver (quantization lives in paddle_tpu.quantization).
+
+TPU-native rebuild of the reference's slim suite
+(reference: python/paddle/fluid/contrib/slim/{prune,distillation,nas,core}).
+The reference's strategies rewrite the static Program graph between
+epochs; here each strategy is a dygraph Layer transform / loss builder,
+which composes with jit.to_static and GSPMD sharding the same way the
+rest of the framework does.
+"""
+from .prune import (Pruner, StructurePruner, MagnitudePruner,
+                    prune_model, sensitivity)
+from .distill import (l2_distill, soft_label_distill, fsp_matrix,
+                      fsp_distill, DistillationModel, merge)
+from .nas import SearchSpace, LightNASStrategy
+from .core import Compressor, Strategy, PruneStrategy, DistillationStrategy
+
+__all__ = [
+    "Pruner", "StructurePruner", "MagnitudePruner", "prune_model",
+    "sensitivity", "l2_distill", "soft_label_distill", "fsp_matrix",
+    "fsp_distill", "DistillationModel", "merge", "SearchSpace",
+    "LightNASStrategy", "Compressor", "Strategy", "PruneStrategy",
+    "DistillationStrategy",
+]

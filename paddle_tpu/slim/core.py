@@ -1,0 +1,125 @@
+"""Compressor driver (reference: contrib/slim/core/compressor.py:1 —
+604 L epoch loop dispatching on_epoch/on_batch callbacks into the
+registered strategies, with checkpoint/eval plumbing).
+
+Dygraph redesign: strategies are small objects with on_compression_begin
+/ on_epoch_begin / on_epoch_end hooks receiving a Context; the Compressor
+runs the train loop (any callable train_fn(model, batch) -> loss works —
+typically a jit.to_static step) and applies strategies at their scheduled
+epochs."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Context", "Strategy", "PruneStrategy",
+           "DistillationStrategy", "Compressor"]
+
+
+class Context:
+    """What strategies see (reference: compressor.py Context)."""
+
+    def __init__(self, model, optimizer=None, epoch=0):
+        self.model = model
+        self.optimizer = optimizer
+        self.epoch = epoch
+        self.eval_results = {}
+
+
+class Strategy:
+    """reference: strategy.py:17 — epoch-windowed callbacks."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
+
+
+class PruneStrategy(Strategy):
+    """Uniform magnitude/structured pruning at start_epoch (reference:
+    prune_strategy.py UniformPruneStrategy). The masks persist through
+    subsequent finetuning epochs."""
+
+    def __init__(self, ratios, pruner=None, params=None, start_epoch=0,
+                 end_epoch=0):
+        super().__init__(start_epoch, end_epoch)
+        self.ratios = ratios
+        self.pruner = pruner
+        self.params = params
+        self.masks = None
+
+    def on_epoch_begin(self, context):
+        from .prune import prune_model
+        if context.epoch == self.start_epoch and self.masks is None:
+            self.masks = prune_model(context.model, self.ratios,
+                                     pruner=self.pruner,
+                                     params=self.params)
+
+
+class DistillationStrategy(Strategy):
+    """Swap the model for a DistillationModel during [start, end) epochs
+    (reference: distillation_strategy.py)."""
+
+    def __init__(self, teacher, distill_specs=None, start_epoch=0,
+                 end_epoch=1000):
+        super().__init__(start_epoch, end_epoch)
+        self.teacher = teacher
+        self.specs = distill_specs
+
+    def on_compression_begin(self, context):
+        from .distill import DistillationModel
+        context.model = DistillationModel(context.model, self.teacher,
+                                          self.specs)
+
+
+class Compressor:
+    """reference: compressor.py:64 — the strategy-driven train loop.
+
+    train_fn(model, batch) -> loss float; eval_fn(model) -> metric.
+    train_reader: callable returning an iterable of batches per epoch.
+    """
+
+    def __init__(self, model, optimizer=None, train_fn=None,
+                 train_reader=None, eval_fn=None, epochs=1, strategies=()):
+        self.context = Context(model, optimizer)
+        self.train_fn = train_fn
+        self.train_reader = train_reader
+        self.eval_fn = eval_fn
+        self.epochs = epochs
+        self.strategies = list(strategies)
+
+    def run(self):
+        ctx = self.context
+        for s in self.strategies:
+            s.on_compression_begin(ctx)
+        history = []
+        for epoch in range(self.epochs):
+            ctx.epoch = epoch
+            for s in self.strategies:
+                s.on_epoch_begin(ctx)
+            losses = []
+            if self.train_fn and self.train_reader:
+                for batch in self.train_reader():
+                    losses.append(float(self.train_fn(ctx.model, batch)))
+            for s in self.strategies:
+                s.on_epoch_end(ctx)
+            metric = float(self.eval_fn(ctx.model)) if self.eval_fn \
+                else None
+            ctx.eval_results[epoch] = metric
+            history.append({"epoch": epoch,
+                            "loss": float(np.mean(losses)) if losses
+                            else None,
+                            "metric": metric})
+        for s in self.strategies:
+            s.on_compression_end(ctx)
+        return ctx.model, history

@@ -1,0 +1,155 @@
+"""Knowledge distillation (reference: contrib/slim/distillation/
+distiller.py:1 — L2Distiller, FSPDistiller, SoftLabelDistiller — and
+distillation_strategy.py merging teacher+student graphs).
+
+The reference merges the teacher Program into the student's and wires
+loss ops between named vars. The dygraph redesign: a
+``DistillationModel`` wrapper runs teacher (no-grad) and student on the
+same input, captures intermediate features by LAYER NAME via forward-post
+hooks, and builds the combined distillation loss from declarative specs —
+the same (s_name, t_name) pairing language the reference uses, minus the
+graph surgery.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..ops import nn_ops as F
+from ..nn.layer import Layer
+from .. import autograd as _ag
+
+__all__ = ["l2_distill", "soft_label_distill", "fsp_matrix",
+           "fsp_distill", "merge", "DistillationModel"]
+
+
+def l2_distill(teacher_feat, student_feat, weight=1.0):
+    """reference distiller.py:25 L2Distiller: mean-square feature match."""
+    return (student_feat - teacher_feat).square().mean() * weight
+
+
+def soft_label_distill(teacher_logits, student_logits,
+                       teacher_temperature=2.0, student_temperature=2.0,
+                       weight=1.0):
+    """reference distiller.py:195 SoftLabelDistiller:
+    CE(softmax(t/Tt), log_softmax(s/Ts))."""
+    t = F.softmax(teacher_logits / teacher_temperature)
+    s = F.log_softmax(student_logits / student_temperature)
+    return -(t * s).sum(axis=-1).mean() * weight
+
+
+def fsp_matrix(feat_a, feat_b):
+    """reference distiller.py:191 _fsp_matrix: the FSP (flow of solution
+    procedure) gram matrix between two NCHW feature maps of equal spatial
+    size: [N, Ca, Cb] = A·Bᵀ over the flattened spatial axis / (H*W)."""
+    n, ca, h, w = feat_a.shape
+    cb = feat_b.shape[1]
+    a = feat_a.reshape([n, ca, h * w])
+    b = feat_b.reshape([n, cb, h * w]).transpose([0, 2, 1])
+    return ops.matmul(a, b) / float(h * w)
+
+
+def fsp_distill(teacher_pair, student_pair, weight=1.0):
+    """reference distiller.py:103 FSPDistiller: L2 between teacher and
+    student FSP matrices of a (start, end) feature-map pair."""
+    tm = fsp_matrix(*teacher_pair)
+    sm = fsp_matrix(*student_pair)
+    return (sm - tm).square().mean() * weight
+
+
+def merge(teacher, student, *args, **kwargs):
+    """reference distillation_strategy.py graph merge — in the dygraph
+    redesign teacher/student stay separate Layers; use
+    DistillationModel."""
+    return DistillationModel(student, teacher)
+
+
+class DistillationModel(Layer):
+    """Wraps (student, teacher) for distillation training.
+
+    distill_specs: list of dicts —
+      {"kind": "soft_label", "s": s_layer_name, "t": t_layer_name,
+       "weight": w, "teacher_temperature": Tt, "student_temperature": Ts}
+      {"kind": "l2", "s": ..., "t": ..., "weight": w}
+      {"kind": "fsp", "s": (name_a, name_b), "t": (name_a, name_b),
+       "weight": w}
+    Layer names are as in named_sublayers(); captured feature = that
+    layer's forward OUTPUT. Calling the wrapper returns (student_out,
+    distill_loss); add your task loss to distill_loss and train — only
+    student parameters receive gradients (teacher runs under no_grad).
+    """
+
+    def __init__(self, student, teacher, distill_specs=None):
+        super().__init__()
+        self.student = student
+        # teacher is intentionally NOT registered as a sublayer: its
+        # params must not reach the optimizer / state_dict of the
+        # distilled model
+        object.__setattr__(self, "teacher", teacher)
+        self.specs = distill_specs or []
+        self._s_feats = {}
+        self._t_feats = {}
+        self._hook_names = self._needed_names()
+        self._install_hooks()
+
+    def _needed_names(self):
+        s_names, t_names = set(), set()
+        for spec in self.specs:
+            s, t = spec.get("s"), spec.get("t")
+            for names, v in ((s_names, s), (t_names, t)):
+                if isinstance(v, (tuple, list)):
+                    names.update(v)
+                elif v is not None:
+                    names.add(v)
+        return {"s": s_names, "t": t_names}
+
+    def _install_hooks(self):
+        def cap(store, name):
+            def hook(layer, inputs, output):
+                store[name] = output
+                return None
+            return hook
+
+        for name, sub in self.student.named_sublayers(include_self=True):
+            if name in self._hook_names["s"]:
+                sub.register_forward_post_hook(cap(self._s_feats, name))
+        for name, sub in self.teacher.named_sublayers(include_self=True):
+            if name in self._hook_names["t"]:
+                sub.register_forward_post_hook(cap(self._t_feats, name))
+
+    def _feat(self, store, key):
+        if isinstance(key, (tuple, list)):
+            return tuple(store[k] for k in key)
+        return store[key]
+
+    def forward(self, *args, **kwargs):
+        self.teacher.eval()
+        with _ag.no_grad():
+            t_out = self.teacher(*args, **kwargs)
+        s_out = self.student(*args, **kwargs)
+        loss = None
+        for spec in self.specs:
+            kind = spec["kind"]
+            w = spec.get("weight", 1.0)
+            if kind == "soft_label":
+                t = self._feat(self._t_feats, spec["t"]) \
+                    if spec.get("t") else t_out
+                s = self._feat(self._s_feats, spec["s"]) \
+                    if spec.get("s") else s_out
+                term = soft_label_distill(
+                    t, s, spec.get("teacher_temperature", 2.0),
+                    spec.get("student_temperature", 2.0), w)
+            elif kind == "l2":
+                term = l2_distill(self._feat(self._t_feats, spec["t"]),
+                                  self._feat(self._s_feats, spec["s"]), w)
+            elif kind == "fsp":
+                term = fsp_distill(self._feat(self._t_feats, spec["t"]),
+                                   self._feat(self._s_feats, spec["s"]), w)
+            else:
+                raise ValueError(f"unknown distill kind {kind!r}")
+            loss = term if loss is None else loss + term
+        self._s_feats.clear()
+        self._t_feats.clear()
+        if loss is None:
+            loss = soft_label_distill(t_out, s_out)
+        return s_out, loss

@@ -1,0 +1,76 @@
+"""Light-NAS (reference: contrib/slim/nas/light_nas_strategy.py:1,
+search_space.py, controller_server.py).
+
+Reasoned facade: the reference's LightNAS is a simulated-annealing
+architecture search driven by a socket controller server coordinating
+multiple trainer processes — a CPU-side search harness, not an
+accelerator workload. The TPU rebuild keeps the SearchSpace contract (so
+user search spaces port unchanged) and a single-process annealing driver;
+the distributed controller-server machinery is intentionally out of scope
+(multi-host search coordination belongs to the cluster layer, not the
+framework)."""
+from __future__ import annotations
+
+import math
+import random
+
+__all__ = ["SearchSpace", "LightNASStrategy"]
+
+
+class SearchSpace:
+    """reference: search_space.py:20 — user subclasses implement these."""
+
+    def init_tokens(self):
+        """Initial token vector encoding an architecture."""
+        raise NotImplementedError
+
+    def range_table(self):
+        """Per-token upper bounds (list of ints)."""
+        raise NotImplementedError
+
+    def create_model(self, tokens=None):
+        """Build the model for a token vector."""
+        raise NotImplementedError
+
+
+class LightNASStrategy:
+    """Single-process simulated-annealing search over a SearchSpace
+    (reference: light_nas_strategy.py + controller.py SAController).
+
+    eval_fn(model) -> reward (higher better). Distributed
+    controller-server search is deliberately not implemented — see module
+    docstring."""
+
+    def __init__(self, search_space, eval_fn, init_temperature=100.0,
+                 reduce_rate=0.85, search_steps=10, seed=0):
+        self.space = search_space
+        self.eval_fn = eval_fn
+        self.t = init_temperature
+        self.reduce_rate = reduce_rate
+        self.search_steps = search_steps
+        self._rng = random.Random(seed)
+
+    def _mutate(self, tokens, table):
+        tokens = list(tokens)
+        i = self._rng.randrange(len(tokens))
+        tokens[i] = self._rng.randrange(table[i])
+        return tokens
+
+    def search(self):
+        """Returns (best_tokens, best_reward, history)."""
+        table = self.space.range_table()
+        cur = list(self.space.init_tokens())
+        cur_r = self.eval_fn(self.space.create_model(cur))
+        best, best_r = cur, cur_r
+        history = [(list(cur), cur_r)]
+        for _ in range(self.search_steps):
+            cand = self._mutate(cur, table)
+            r = self.eval_fn(self.space.create_model(cand))
+            history.append((list(cand), r))
+            if r > cur_r or self._rng.random() < math.exp(
+                    (r - cur_r) / max(self.t, 1e-9)):
+                cur, cur_r = cand, r
+            if r > best_r:
+                best, best_r = cand, r
+            self.t *= self.reduce_rate
+        return best, best_r, history

@@ -1,0 +1,192 @@
+"""Pruning (reference: contrib/slim/prune/pruner.py:1 Pruner /
+StructurePruner, prune_strategy.py:1 sensitive/uniform strategies).
+
+Two layers of API:
+
+* ``StructurePruner`` keeps the reference's numpy-level contract
+  (``cal_pruned_idx`` / ``prune_tensor`` with l1_norm group criterion);
+* :func:`prune_model` is the dygraph transform: it computes PERSISTENT
+  0/1 masks for the chosen parameters (magnitude / structured l1-norm)
+  and registers them as buffers; every masked parameter is multiplied by
+  its mask on the forward path (a forward-pre hook swaps the masked value
+  in), so pruned weights contribute nothing to forward OR gradient and
+  stay pruned through finetuning — the state_dict still holds dense
+  arrays + masks, which is what a TPU wants (dense MXU math; the zeros
+  compress at serialization time).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer import Layer
+
+__all__ = ["Pruner", "StructurePruner", "MagnitudePruner", "prune_model",
+           "sensitivity"]
+
+
+class Pruner:
+    """reference: pruner.py:22 — base class."""
+
+    def prune(self, param, ratio):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Group (filter/channel) pruning by axis (reference: pruner.py:34).
+
+    pruning_axis: {param_name_or_'*': axis}; criterions:
+    {param_name_or_'*': 'l1_norm'}."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indices of the lowest-norm groups along `axis`."""
+        criterion = self.criterions.get(name, self.criterions.get("*"))
+        if criterion != "l1_norm":
+            raise ValueError(f"unsupported criterion {criterion!r}")
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*"))
+        param = np.asarray(param)
+        prune_num = int(round(param.shape[axis] * ratio))
+        reduce_dims = tuple(i for i in range(param.ndim) if i != axis)
+        scores = np.sum(np.abs(param), axis=reduce_dims)
+        return np.argsort(scores)[:prune_num]
+
+    def prune_tensor(self, tensor, pruned_idx, pruned_axis, lazy=False):
+        """Drop (or zero, when lazy) the pruned groups."""
+        tensor = np.asarray(tensor)
+        if lazy:
+            out = tensor.copy()
+            idx = [slice(None)] * tensor.ndim
+            idx[pruned_axis] = pruned_idx
+            out[tuple(idx)] = 0
+            return out
+        keep = np.setdiff1d(np.arange(tensor.shape[pruned_axis]),
+                            pruned_idx)
+        return np.take(tensor, keep, axis=pruned_axis)
+
+    def mask(self, name, param, ratio, axis=None):
+        """0/1 mask zeroing the pruned groups (persistent-mask form)."""
+        param = np.asarray(param)
+        if axis is None:
+            axis = self.pruning_axis.get(name, self.pruning_axis.get("*"))
+        idx = self.cal_pruned_idx(name, param, ratio, axis)
+        m = np.ones(param.shape, "float32")
+        sl = [slice(None)] * param.ndim
+        sl[axis] = idx
+        m[tuple(sl)] = 0.0
+        return m
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured magnitude pruning: zero the smallest |w| entries."""
+
+    def mask(self, name, param, ratio, axis=None):
+        param = np.asarray(param)
+        k = int(round(param.size * ratio))
+        if k <= 0:
+            return np.ones(param.shape, "float32")
+        thresh = np.partition(np.abs(param).ravel(), k - 1)[k - 1]
+        return (np.abs(param) > thresh).astype("float32")
+
+    def prune(self, param, ratio):
+        return np.asarray(param) * self.mask("", param, ratio)
+
+
+def _iter_target_params(model, params=None):
+    for name, p in model.named_parameters():
+        if params is not None and not any(pat in name for pat in params):
+            continue
+        if p.data.ndim < 2:  # biases/norms are never pruned
+            continue
+        yield name, p
+
+
+def prune_model(model, ratios, pruner=None, params=None):
+    """Apply persistent pruning masks to `model` in place.
+
+    ratios: float (uniform) or {param_substring: ratio}. pruner: a
+    Pruner with .mask() (default MagnitudePruner). params: optional list
+    of name substrings to restrict pruning. Returns {name: mask}.
+
+    The masks install as forward-pre hooks on each owning layer: the
+    parameter's value is multiplied by its mask for the call and restored
+    after, so optimizer state keeps tracking the dense parameter while
+    pruned weights stay exactly zero in every forward/backward
+    (reference: prune_strategy.py applying pruned params on the graph).
+    """
+    pruner = pruner or MagnitudePruner()
+    if not isinstance(ratios, dict):
+        ratios = {"": float(ratios)}
+    masks = {}
+    # name -> (owning layer, attr) map for hook installation
+    owners = {}
+    for lname, layer in model.named_sublayers(include_self=True):
+        for attr, p in layer._parameters.items():
+            full = f"{lname}.{attr}" if lname else attr
+            owners[full] = (layer, attr)
+
+    for name, p in _iter_target_params(model, params):
+        ratio = None
+        for pat, r in ratios.items():
+            if pat in name:
+                ratio = r
+                break
+        if ratio is None or ratio <= 0:
+            continue
+        m = pruner.mask(name, np.asarray(p.data), ratio)
+        mask_arr = jnp.asarray(m)
+        p.data = p.data * mask_arr  # prune NOW
+        masks[name] = mask_arr
+        layer, attr = owners[name]
+
+        def make_hook(attr, mask_arr):
+            state = {}
+
+            def pre(layer_, inputs):
+                param = layer_._parameters[attr]
+                state["dense"] = param.data
+                param.data = param.data * mask_arr
+                return None
+
+            def post(layer_, inputs, outputs):
+                # restore the dense value so the optimizer updates it;
+                # the NEXT forward re-masks (masked-forward => masked
+                # grads, so pruned entries only drift by weight decay and
+                # are re-zeroed each call)
+                layer_._parameters[attr].data = state.pop("dense")
+                return None
+
+            return pre, post
+
+        pre, post = make_hook(attr, mask_arr)
+        layer.register_forward_pre_hook(pre)
+        layer.register_forward_post_hook(post)
+        if not hasattr(layer, "_prune_masks"):
+            layer._prune_masks = {}
+        layer._prune_masks[attr] = mask_arr
+    return masks
+
+
+def sensitivity(model, eval_fn, ratios=(0.1, 0.3, 0.5), pruner=None,
+                params=None):
+    """Per-parameter pruning sensitivity (reference:
+    prune_strategy.py SensitivePruneStrategy): for each prunable param,
+    temporarily prune at each ratio and record eval_fn(model). Returns
+    {param_name: {ratio: metric}}; the model is restored afterwards."""
+    pruner = pruner or MagnitudePruner()
+    out = {}
+    for name, p in _iter_target_params(model, params):
+        dense = p.data
+        scores = {}
+        for r in ratios:
+            m = pruner.mask(name, np.asarray(dense), r)
+            p.data = dense * jnp.asarray(m)
+            scores[float(r)] = float(eval_fn(model))
+        p.data = dense
+        out[name] = scores
+    return out

@@ -1,0 +1,54 @@
+"""int8 vs bf16 Predictor throughput on the real chip (VERDICT r3 #7's
+bench line). Run: python -u scripts/bench_int8.py
+
+Measures a Linear-tower inference model (the MXU-bound regime where int8
+doubles the systolic-array throughput ceiling) through the Predictor at
+bf16 and at calibrated int8, printing one JSON line."""
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, Predictor
+
+    pt.seed(0)
+    d, layers, batch = 4096, 8, 64
+    blocks = []
+    for _ in range(layers):
+        blocks += [nn.Linear(d, d), nn.ReLU()]
+    model = nn.Sequential(*blocks)
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, d).astype("f4")
+    cal = [pt.to_tensor(x)]
+
+    def rate(predictor, steps=30):
+        out = predictor.run(x)  # compile
+        np.asarray(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = predictor.run(x)
+        np.asarray(out)
+        return batch * steps / (time.perf_counter() - t0)
+
+    bf16 = rate(Predictor(model, Config().enable_bf16()))
+    pt.seed(0)
+    model2 = nn.Sequential(*[l for l in blocks])  # same weights (shared)
+    int8 = rate(Predictor(model2, Config().enable_int8(cal)))
+    print(json.dumps({
+        "metric": "int8_vs_bf16_inference",
+        "bf16_samples_per_sec": round(bf16, 1),
+        "int8_samples_per_sec": round(int8, 1),
+        "speedup": round(int8 / bf16, 3),
+        "model": f"{layers}x Linear({d},{d})",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,155 @@
+"""Dev-tooling tail (VERDICT r3 #8 + Missing #3/#4/#5): install_check,
+Program graphviz dump, evaluator facade, model_stat/memory_usage/
+op_frequence, PS-async trainer descriptors, data generator protocol."""
+import io as _io
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, static, optimizer as opt, fluid
+
+
+def test_install_check_runs(capsys):
+    assert fluid.install_check.run_check() is True
+    out = capsys.readouterr().out
+    assert "works" in out and "compiled step ok" in out
+    assert "data-parallel step ok on 8 devices" in out  # CPU mesh
+
+
+def _tiny_program():
+    pt.seed(0)
+    pt.enable_static()
+    try:
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 3], "float32")
+            m = nn.Linear(3, 2)
+            y = m(x)
+            loss = y.square().mean()
+    finally:
+        pt.disable_static()
+    return prog, loss
+
+
+def test_graphviz_dump_and_pprint(tmp_path):
+    prog, _ = _tiny_program()
+    p = str(tmp_path / "g.dot")
+    dot = fluid.debugger.draw_block_graphviz(prog, path=p)
+    text = open(p).read()
+    assert text == dot
+    assert dot.startswith("digraph") and "shape=box" in dot
+    assert dot.count("->") >= 2  # data edges exist
+    code = fluid.debugger.pprint_program_codes(prog)
+    assert "= " in code and len(code.splitlines()) >= 2
+    # net_drawer front
+    dot2 = fluid.net_drawer.draw_graph(main_program=prog)
+    assert dot2.startswith("digraph")
+
+
+def test_evaluator_facades():
+    with pytest.warns(DeprecationWarning):
+        ce = fluid.evaluator.ChunkEvaluator()
+    ce.update(10, 8, 6)
+    p, r, f1 = ce.eval()
+    assert abs(p - 0.6) < 1e-9 and abs(r - 0.75) < 1e-9
+    ce.reset()
+    assert ce.eval() == (0, 0, 0.0)
+
+    with pytest.warns(DeprecationWarning):
+        ed = fluid.evaluator.EditDistance()
+    ed.update([0.0, 2.0, 1.0])
+    avg, err = ed.eval()
+    assert abs(avg - 1.0) < 1e-9 and abs(err - 2 / 3) < 1e-9
+
+
+def test_model_stat_summary_and_memory():
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    info = fluid.contrib.summary(m, input_spec=np.zeros((4, 8), "f4"))
+    assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+    # Linear FLOPs = 2 * out_elems * in_features
+    assert info["total_flops"] == 2 * (4 * 16 * 8 + 4 * 2 * 16)
+
+    low, high = fluid.contrib.memory_usage(m, batch_size=4)
+    assert 0 < low < high
+
+    prog, _ = _tiny_program()
+    plow, phigh = fluid.contrib.memory_usage(prog, batch_size=4)
+    assert 0 < plow < phigh
+
+
+def test_op_frequence_program_and_jaxpr():
+    prog, _ = _tiny_program()
+    uni, adj = fluid.contrib.op_freq_statistic(prog)
+    assert sum(uni.values()) == len(prog.global_block().ops)
+    assert all("->" in k for k in adj)
+
+    import jax.numpy as jnp
+    freq2 = fluid.contrib.op_freq_statistic(
+        lambda x: jnp.tanh(x) @ x.T + 1.0, np.ones((3, 3), "f4"))
+    assert freq2["tanh"] == 1 and freq2["dot_general"] == 1
+
+
+def test_trainer_factory_and_communicator():
+    tf = fluid.TrainerFactory()
+    t = tf._create_trainer()
+    assert isinstance(t, fluid.MultiTrainer)
+    t2 = tf._create_trainer({"trainer": "DistMultiTrainer",
+                             "device_worker": "DownpourSGD"})
+    assert isinstance(t2, fluid.DistMultiTrainer)
+    t2.set_fetch_var_and_info(["loss"], ["train loss"], 10)
+    assert t2._desc()["worker"] == "DownpourSGD"
+    with pytest.raises(ValueError):
+        tf._create_trainer({"trainer": "NoSuch"})
+
+    c = fluid.Communicator()
+    assert not c.is_running()
+    c.start()
+    assert c.is_running()
+    c.stop()
+    assert not c.is_running()
+    with pytest.warns(UserWarning, match="geo"):
+        fluid.Communicator(kwargs={"geo_need_push_nums": 400})
+
+
+def test_data_feed_desc_roundtrip():
+    proto = '''
+    name: "MultiSlotDataFeed"
+    batch_size: 2
+    multi_slot_desc {
+      slots { name: "words" type: "uint64" is_dense: false is_used: false }
+      slots { name: "label" type: "uint64" is_dense: false is_used: false }
+    }
+    '''
+    desc = fluid.DataFeedDesc(proto)
+    assert [s["name"] for s in desc.slots] == ["words", "label"]
+    desc.set_batch_size(128)
+    desc.set_use_slots(["words"])
+    desc.set_dense_slots(["label"])
+    assert desc.proto_desc["batch_size"] == 128
+    assert desc.used_slots() == ["words"]
+    assert next(s for s in desc.slots if s["name"] == "label")["is_dense"]
+    text = desc.desc()
+    # re-parse what we serialized
+    again = fluid.DataFeedDesc(text)
+    assert again.proto_desc["batch_size"] == 128
+    assert again.used_slots() == ["words"]
+
+
+def test_multi_slot_data_generator_protocol(monkeypatch, capsys):
+    from paddle_tpu.incubate.data_generator import MultiSlotDataGenerator
+
+    class MyGen(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def gen():
+                yield [("words", [1, 2, 3]), ("label", [0])]
+            return gen
+
+    g = MyGen()
+    g.set_batch(1)
+    monkeypatch.setattr(sys, "stdin", _io.StringIO("x\ny\n"))
+    g.run_from_stdin()
+    out = capsys.readouterr().out
+    # per line: "3 1 2 3 1 0" (count-prefixed slots, space-joined)
+    assert out.splitlines() == ["3 1 2 3 1 0", "3 1 2 3 1 0"]

@@ -173,3 +173,125 @@ class TestTranslatorSwitch:
         assert not ProgramTranslator.is_enabled()
         a.enable(True)
         assert ProgramTranslator.is_enabled()
+
+
+# ---------------------------------------------------------------------------
+# break/continue + for conversion (VERDICT r3 #4; reference:
+# break_continue_transformer.py, loop_transformer.py)
+
+
+def first_power_above(x, limit):
+    """Tensor-dependent while WITH break: doubles x until above limit."""
+    n = pt.ops.zeros([], dtype="float32")
+    while n < 100.0:
+        if x > limit:
+            break
+        x = x * 2.0
+        n = n + 1.0
+    return n
+
+
+def sum_skip_negatives(xs):
+    """Tensor-dependent continue inside a for over Tensor rows."""
+    total = pt.ops.zeros([], dtype="float32")
+    for v in xs:
+        if v.sum() < 0.0:
+            continue
+        total = total + v.sum()
+    return total
+
+
+def sum_range(t):
+    """for over range(tensor) — trip count is DATA."""
+    s = pt.ops.zeros([], dtype="float32")
+    for i in range(t):
+        s = s + 1.0 + 0.0 * i
+    return s
+
+
+class TestLoopTransforms:
+    def test_while_break_follows_data(self):
+        fn = jit.to_static(first_power_above)
+        out1 = fn(pt.to_tensor(np.float32(1.0)),
+                  pt.to_tensor(np.float32(10.0)))
+        assert float(out1.numpy()) == 4.0   # 1->2->4->8->16, breaks at 16
+        # same compiled fn, different data: a baked trace would answer 4
+        out2 = fn(pt.to_tensor(np.float32(1.0)),
+                  pt.to_tensor(np.float32(100.0)))
+        assert float(out2.numpy()) == 7.0   # breaks when x=128
+    def test_eager_semantics_preserved_with_break(self):
+        # the converted function still runs correct plain python
+        f = convert_function(first_power_above)
+        out = f(pt.to_tensor(np.float32(1.0)),
+                pt.to_tensor(np.float32(10.0)))
+        assert float(out.numpy()) == 4.0
+
+    def test_for_over_tensor_rows_with_continue(self):
+        xs = np.array([[1.0, 2.0], [-5.0, 1.0], [3.0, 4.0]], "f4")
+        f = convert_function(sum_skip_negatives)
+        out = f(pt.to_tensor(xs))
+        assert float(out.numpy()) == pytest.approx(10.0)  # skips row 1
+        # compiled too (leading dim static -> unrolled, but guards traced)
+        fn = jit.to_static(sum_skip_negatives)
+        out = fn(pt.to_tensor(xs))
+        assert float(out.numpy()) == pytest.approx(10.0)
+
+    def test_for_over_traced_range(self):
+        fn = jit.to_static(sum_range)
+        out1 = fn(pt.to_tensor(np.int32(4)))
+        assert float(out1.numpy()) == 4.0
+        # SAME executable, new bound — lax.while_loop follows the data
+        out2 = fn(pt.to_tensor(np.int32(9)))
+        assert float(out2.numpy()) == 9.0
+
+    def test_for_python_iterable_unchanged(self):
+        def poly(x):
+            acc = x * 0.0
+            for c in [1.0, 2.0, 3.0]:
+                acc = acc * x + c
+            return acc
+
+        f = convert_function(poly)
+        x = pt.to_tensor(np.float32(2.0))
+        assert float(f(x).numpy()) == float(poly(x).numpy()) == 11.0
+        fn = jit.to_static(poly)
+        assert float(fn(x).numpy()) == 11.0
+
+    def test_for_range_with_break(self):
+        def find_first_ge(xs, thresh):
+            idx = pt.ops.zeros([], dtype="float32")
+            found = pt.ops.zeros([], dtype="float32")
+            for i in range(xs.shape[0]):
+                if (xs[i] >= thresh).astype("float32").sum() > 0.0:
+                    found = found + 1.0
+                    idx = idx + 0.0
+                    break
+                idx = idx + 1.0
+            return idx, found
+
+        xs = np.array([1.0, 3.0, 7.0, 2.0], "f4")
+        f = convert_function(find_first_ge)
+        idx, found = f(pt.to_tensor(xs), pt.to_tensor(np.float32(5.0)))
+        assert float(idx.numpy()) == 2.0 and float(found.numpy()) == 1.0
+        fn = jit.to_static(find_first_ge)
+        idx, found = fn(pt.to_tensor(xs), pt.to_tensor(np.float32(5.0)))
+        assert float(idx.numpy()) == 2.0 and float(found.numpy()) == 1.0
+
+    def test_for_enumerate_zip_generator_still_work(self):
+        """len-less iterables (enumerate/zip/generators) must keep their
+        python semantics through the for-conversion (materialized once)."""
+        def f(x):
+            acc = x * 0.0
+            for i, c in enumerate([1.0, 2.0, 3.0]):
+                acc = acc + c * (i + 1)
+            for a, b in zip([1.0, 2.0], [10.0, 20.0]):
+                acc = acc + a * b
+            for g in (v * 2.0 for v in [1.0, 2.0]):
+                acc = acc + g
+            return acc
+
+        x = pt.to_tensor(np.float32(0.0))
+        ref = 1 + 4 + 9 + 10 + 40 + 2 + 4
+        out = convert_function(f)(x)
+        assert float(out.numpy()) == ref
+        assert float(jit.to_static(f)(x).numpy()) == ref
