@@ -51,6 +51,7 @@ from .reader import batch
 from . import distribution
 from . import quantization
 from . import slim
+from . import fleet
 from . import dataset
 
 # dygraph/static mode management (reference: fluid.enable_dygraph /

@@ -1,0 +1,22 @@
+"""paddle_tpu.fleet — top-level alias of the fleet API (reference:
+python/paddle/fleet/__init__.py, an empty placeholder in this
+generation; the working implementation lives in
+incubate/fleet → here parallel/fleet.py).
+
+The module DELEGATES unknown attributes to the Fleet singleton, so both
+spellings work identically:
+
+    from paddle_tpu import fleet
+    fleet.init(strategy=st)
+    model = fleet.distributed_model(model)   # singleton method
+"""
+from .parallel.fleet import (fleet, init, Fleet,  # noqa: F401
+                             DistributedStrategy, PaddleCloudRoleMaker,
+                             UserDefinedRoleMaker, DistributedOptimizer,
+                             megatron_param_spec)
+
+
+def __getattr__(name):
+    # any Fleet method/property (distributed_model, shard_batch, mesh,
+    # pipeline_stack, save_persistables, ...) resolves on the singleton
+    return getattr(fleet, name)

@@ -14,3 +14,10 @@ from . import model  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import loss  # noqa: F401
 from . import metrics  # noqa: F401
+from . import distributed  # noqa: F401,E402
+from .distributed import DistributedBatchSampler  # noqa: F401,E402
+from . import datasets  # noqa: F401,E402
+from . import download  # noqa: F401,E402
+from .download import get_weights_path_from_url  # noqa: F401,E402
+from . import progressbar  # noqa: F401,E402
+from . import vision  # noqa: F401,E402

@@ -1,0 +1,163 @@
+"""hapi.datasets (reference: incubate/hapi/datasets/{mnist,flowers,
+folder}.py — map-style Datasets with transform hooks, usable with
+io.DataLoader).
+
+MNIST/Flowers wrap the fluid-era paddle_tpu.dataset sources (which fall
+back to deterministic synthetic data in this zero-egress environment);
+DatasetFolder/ImageFolder walk a class-per-directory tree on local disk
+(reference folder.py:60) loading through PIL when present."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "Flowers", "DatasetFolder", "ImageFolder"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp",
+                  ".npy")
+
+
+class MNIST(Dataset):
+    """reference: datasets/mnist.py — mode 'train'|'test', optional
+    transform(img) -> img."""
+
+    def __init__(self, mode="train", transform=None, return_label=True):
+        from ..dataset import mnist as _mnist
+        images, labels = _mnist.train_arrays() if mode == "train" \
+            else _mnist.test_arrays()
+        self.images = np.asarray(images, "float32")
+        self.labels = np.asarray(labels, "int64")
+        self.mode = mode
+        self.transform = transform
+        self.return_label = return_label
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].reshape(28, 28)
+        if self.transform is not None:
+            img = self.transform(img)
+        if self.return_label:
+            return img, np.int64(self.labels[idx])
+        return (img,)
+
+
+class Flowers(Dataset):
+    """reference: datasets/flowers.py."""
+
+    def __init__(self, mode="train", transform=None):
+        from ..dataset import flowers as _flowers
+        reader = {"train": _flowers.train, "test": _flowers.test,
+                  "valid": _flowers.valid}[mode]()
+        samples = list(reader())
+        self.images = np.stack([np.asarray(s[0], "float32")
+                                for s in samples])
+        self.labels = np.asarray([s[1] for s in samples], "int64")
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+def has_valid_extension(filename, extensions=IMG_EXTENSIONS):
+    """reference: folder.py:24."""
+    return filename.lower().endswith(tuple(extensions))
+
+
+def _default_loader(path):
+    if path.lower().endswith(".npy"):
+        return np.load(path)
+    try:
+        from PIL import Image
+        with Image.open(path) as im:
+            return np.asarray(im.convert("RGB"))
+    except ImportError:  # pragma: no cover
+        from ..dataset import image as _img
+        return _img.load_image(path)
+
+
+def make_dataset(directory, class_to_idx, extensions=IMG_EXTENSIONS,
+                 is_valid_file=None):
+    """reference: folder.py:37 — (path, class_idx) list over a
+    class-per-subdir tree."""
+    samples = []
+    check = is_valid_file or (
+        lambda p: has_valid_extension(p, extensions))
+    for cls in sorted(class_to_idx):
+        d = os.path.join(directory, cls)
+        if not os.path.isdir(d):
+            continue
+        for root, _, files in sorted(os.walk(d)):
+            for f in sorted(files):
+                path = os.path.join(root, f)
+                if check(path):
+                    samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+class DatasetFolder(Dataset):
+    """reference: folder.py:60 — root/class_x/xxx.png layout."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        classes = sorted(e.name for e in os.scandir(root) if e.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = make_dataset(root, self.class_to_idx, extensions,
+                                    is_valid_file)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root} "
+                               f"(extensions {extensions})")
+        self.loader = loader or _default_loader
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+
+class ImageFolder(Dataset):
+    """reference: folder.py:197 — flat (unlabeled) image list."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        check = is_valid_file or (
+            lambda p: has_valid_extension(p, extensions))
+        samples = []
+        for r, _, files in sorted(os.walk(root)):
+            for f in sorted(files):
+                p = os.path.join(r, f)
+                if check(p):
+                    samples.append(p)
+        if not samples:
+            raise RuntimeError(f"no valid files found under {root}")
+        self.samples = samples
+        self.loader = loader or _default_loader
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)

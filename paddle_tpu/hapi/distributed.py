@@ -1,0 +1,65 @@
+"""hapi.distributed (reference:
+python/paddle/incubate/hapi/distributed.py:36 DistributedBatchSampler).
+
+TPU note: with the GSPMD path a GLOBAL batch is usually placed with
+`fleet.shard_batch` and XLA splits it over dp — but per-process input
+pipelines (multi-host, or io.DataLoader feeding per-replica shards) still
+want the reference's rank-exclusive sampler, so it is kept behaviorally
+identical: pad indices to a multiple of nranks, optional epoch-seeded
+shuffle, contiguous per-rank subsample, set_epoch for reshuffling."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..io import BatchSampler
+from ..parallel.env import ParallelEnv
+
+__all__ = ["DistributedBatchSampler"]
+
+
+class DistributedBatchSampler(BatchSampler):
+    def __init__(self, dataset, batch_size, shuffle=False, drop_last=False,
+                 num_replicas=None, rank=None):
+        if not (isinstance(batch_size, int) and batch_size > 0):
+            raise ValueError("batch_size should be a positive integer")
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = bool(shuffle)
+        self.drop_last = bool(drop_last)
+        env = ParallelEnv()
+        self.nranks = num_replicas if num_replicas is not None \
+            else env.world_size
+        self.local_rank = rank if rank is not None else env.rank
+        self.epoch = 0
+        self.num_samples = int(
+            math.ceil(len(dataset) * 1.0 / self.nranks))
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        indices = list(range(n))
+        indices += indices[:self.total_size - n]  # pad to a rank multiple
+        if self.shuffle:
+            np.random.RandomState(self.epoch).shuffle(indices)
+            self.epoch += 1
+        # contiguous per-rank slice (reference subsampling)
+        start = self.local_rank * self.num_samples
+        indices = indices[start:start + self.num_samples]
+        batch = []
+        for idx in indices:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return int(math.ceil(self.num_samples / self.batch_size))
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch

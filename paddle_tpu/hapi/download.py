@@ -1,0 +1,47 @@
+"""hapi.download (reference: incubate/hapi/download.py —
+get_weights_path_from_url / get_path_from_url with a ~/.cache dir and md5
+checks).
+
+This build environment has ZERO network egress, so the download step is
+redesigned rather than stubbed: URLs resolve through the local cache
+only (shared derivation with dataset.common — one DATA_HOME, one md5
+helper). A file already present (same basename, optional md5 match) is
+returned; otherwise the error says exactly where to drop the file —
+which is also the sane behavior for air-gapped TPU pods."""
+from __future__ import annotations
+
+import os
+import os.path as osp
+
+from ..dataset.common import md5file
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "DATA_HOME"]
+
+DATA_HOME = osp.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu"))
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    """Resolve `url` to a local file under root_dir (default DATA_HOME).
+    Never touches the network: the file must already be in the cache (put
+    there by your data-prep pipeline). check_exist=False skips the md5
+    validation of an already-cached file (reference semantics)."""
+    root_dir = osp.expanduser(root_dir) if root_dir else DATA_HOME
+    fname = osp.basename(url.rstrip("/")) or "download"
+    path = osp.join(root_dir, fname)
+    if osp.exists(url):  # a local path was passed directly
+        return url
+    if osp.exists(path):
+        if not check_exist or md5sum is None or md5file(path) == md5sum:
+            return path
+        raise ValueError(
+            f"cached file {path} exists but its md5 does not match "
+            f"{md5sum} — replace the corrupt/stale file (source: {url})")
+    raise FileNotFoundError(
+        f"'{fname}' not found in the local cache ({root_dir}) and this "
+        "environment has no network egress. Place the file at "
+        f"{path} (source: {url}).")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, osp.join(DATA_HOME, "weights"), md5sum)

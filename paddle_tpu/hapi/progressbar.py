@@ -1,0 +1,66 @@
+"""hapi.progressbar (reference: incubate/hapi/progressbar.py —
+the Keras-style training bar the hapi callbacks drive)."""
+from __future__ import annotations
+
+import sys
+import time
+
+__all__ = ["ProgressBar"]
+
+
+class ProgressBar:
+    """num: total steps (None = unknown/stream mode). update(i, values)
+    renders `i/num [====>...] - metric: v` to the stream."""
+
+    def __init__(self, num=None, width=30, verbose=1, start=True,
+                 file=None):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self._file = file  # None = live sys.stdout at write time
+        self._start = time.time()
+        self._last_len = 0
+
+    @property
+    def _out(self):
+        return self._file if self._file is not None else sys.stdout
+
+    def start(self):
+        self._start = time.time()
+
+    def _format_values(self, values):
+        out = []
+        for k, v in values:
+            if isinstance(v, (int, float)):
+                out.append(f"{k}: {v:.4f}")
+            else:
+                out.append(f"{k}: {v}")
+        return " - ".join(out)
+
+    def update(self, current_num, values=None):
+        if self._verbose == 0:
+            return
+        values = values or []
+        if self._num:
+            frac = min(current_num / self._num, 1.0)
+            filled = int(frac * self._width)
+            bar = "=" * max(filled - 1, 0)
+            bar += ">" if filled < self._width else "="
+            bar = bar.ljust(self._width, ".")
+            line = f"step {current_num}/{self._num} [{bar}]"
+        else:
+            line = f"step {current_num}"
+        metrics = self._format_values(values)
+        if metrics:
+            line += " - " + metrics
+        elapsed = time.time() - self._start
+        line += f" - {1000 * elapsed / max(current_num, 1):.0f}ms/step"
+        if self._verbose == 1:
+            pad = max(self._last_len - len(line), 0)
+            self._out.write("\r" + line + " " * pad)
+            if self._num and current_num >= self._num:
+                self._out.write("\n")
+            self._last_len = len(line)
+        else:
+            self._out.write(line + "\n")
+        self._out.flush()

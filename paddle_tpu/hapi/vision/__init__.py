@@ -1,0 +1,3 @@
+"""hapi.vision (reference: incubate/hapi/vision — the models package;
+transforms arrived in later generations)."""
+from . import models  # noqa: F401

@@ -1,0 +1,5 @@
+"""hapi.vision.models (reference: incubate/hapi/vision/models — LeNet in
+this generation; the wider zoo lives in paddle_tpu.models)."""
+from ....models.lenet import LeNet  # noqa: F401
+
+__all__ = ["LeNet"]
