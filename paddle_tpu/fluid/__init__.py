@@ -41,6 +41,7 @@ from .transpiler import (DistributeTranspiler,  # noqa: F401
                          DistributeTranspilerConfig, memory_optimize,
                          release_memory)
 from .data_feeder import DataFeeder, PyReader
+from . import incubate
 from . import install_check
 from . import debugger
 from . import net_drawer

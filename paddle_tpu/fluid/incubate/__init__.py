@@ -1,0 +1,4 @@
+"""fluid.incubate (reference: python/paddle/fluid/incubate — fleet +
+data_generator)."""
+from . import fleet  # noqa: F401
+from . import data_generator  # noqa: F401

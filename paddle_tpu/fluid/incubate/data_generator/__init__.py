@@ -1,0 +1,5 @@
+"""fluid.incubate.data_generator — same module as
+paddle_tpu.incubate.data_generator (reference keeps two import paths)."""
+from ....incubate.data_generator import (DataGenerator,  # noqa: F401
+                                         MultiSlotDataGenerator,
+                                         MultiSlotStringDataGenerator)

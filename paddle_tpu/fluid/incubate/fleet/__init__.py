@@ -1,0 +1,5 @@
+"""fluid.incubate.fleet (reference:
+python/paddle/fluid/incubate/fleet/__init__.py)."""
+from . import base  # noqa: F401
+from . import collective  # noqa: F401
+from . import parameter_server  # noqa: F401

@@ -1,0 +1,21 @@
+"""fluid.incubate.fleet.base.role_maker (reference: role_maker.py:33 —
+role discovery for distributed jobs).
+
+TPU redesign: roles come from jax.distributed / the launch env
+(parallel/env.py ParallelEnv); the MPI role makers have no TPU analogue
+(jax.distributed owns rendezvous), so the collective-mode makers are the
+real ones and MPI names alias them for import parity."""
+from .....parallel.fleet import (RoleMakerBase,  # noqa: F401
+                                PaddleCloudRoleMaker, UserDefinedRoleMaker)
+
+# collective-only environment: MPI makers map to the env-driven one
+MPIRoleMaker = PaddleCloudRoleMaker
+MPISymetricRoleMaker = PaddleCloudRoleMaker
+GeneralRoleMaker = PaddleCloudRoleMaker
+UserDefinedCollectiveRoleMaker = UserDefinedRoleMaker
+
+
+class Role:
+    """reference: role_maker.py Role enum."""
+    WORKER = 1
+    SERVER = 2

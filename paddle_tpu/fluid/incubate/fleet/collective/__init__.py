@@ -1,0 +1,23 @@
+"""fluid.incubate.fleet.collective (reference: collective/__init__.py:64
+Collective(Fleet) + CollectiveOptimizer + the module-level `fleet`
+singleton launch scripts import).
+
+The TPU rebuild's Fleet (parallel/fleet.py) IS collective-mode, so this
+module re-exports the same singleton under the reference import path."""
+from .....parallel.fleet import (Fleet, DistributedStrategy,  # noqa: F401
+                                DistributedOptimizer, fleet)
+
+# reference: collective/__init__.py:384 CollectiveOptimizer(loss-scaled
+# NCCL allreduce wrapper) — the GSPMD DistributedOptimizer plays its role
+CollectiveOptimizer = DistributedOptimizer
+
+
+class TrainStatus:
+    """reference: collective/__init__.py:49."""
+
+    def __init__(self, epoch_no=-1):
+        self.epoch_no = epoch_no
+
+    def __eq__(self, other):
+        return isinstance(other, TrainStatus) and \
+            self.epoch_no == other.epoch_no

@@ -1,0 +1,10 @@
+"""fluid.incubate.fleet.parameter_server.distribute_transpiler
+(reference: the PS-mode `fleet` singleton CTR jobs import).
+
+TPU redesign (docs/scope.md): there is no parameter-server role on a TPU
+pod — the PS path's big sharded embeddings become
+parallel/embedding.py's row-sharded tables with all-to-all lookups, and
+training is synchronous collective dp. This module exposes the SAME
+`fleet` singleton so PS-mode launch scripts run; the async knobs parse
+via fluid.trainer_desc and warn where semantics differ."""
+from ......parallel.fleet import fleet, DistributedOptimizer  # noqa: F401

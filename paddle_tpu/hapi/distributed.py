@@ -39,8 +39,11 @@ class DistributedBatchSampler(BatchSampler):
 
     def __iter__(self):
         n = len(self.dataset)
+        if n == 0:
+            return
         indices = list(range(n))
-        indices += indices[:self.total_size - n]  # pad to a rank multiple
+        while len(indices) < self.total_size:  # pad to a rank multiple
+            indices += indices[:self.total_size - len(indices)]
         if self.shuffle:
             np.random.RandomState(self.epoch).shuffle(indices)
             self.epoch += 1

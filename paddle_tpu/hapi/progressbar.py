@@ -18,7 +18,7 @@ class ProgressBar:
         self._width = width
         self._verbose = verbose
         self._file = file  # None = live sys.stdout at write time
-        self._start = time.time()
+        self._start = time.time() if start else None
         self._last_len = 0
 
     @property
@@ -53,6 +53,8 @@ class ProgressBar:
         metrics = self._format_values(values)
         if metrics:
             line += " - " + metrics
+        if self._start is None:  # start=False: timer begins at first tick
+            self._start = time.time()
         elapsed = time.time() - self._start
         line += f" - {1000 * elapsed / max(current_num, 1):.0f}ms/step"
         if self._verbose == 1:

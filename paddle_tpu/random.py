@@ -17,18 +17,60 @@ import jax.numpy as jnp
 
 from .tensor import Tensor
 
-# the global key lives in a Tensor so mode transforms can swap its payload
-_global_key = Tensor(jax.random.PRNGKey(0), stop_gradient=True,
-                     name="global_rng_key")
 _seed_value = 0
+
+
+class _LazyKeyTensor(Tensor):
+    """The global key Tensor, materialized on FIRST USE: building the key
+    at import would initialize the XLA backend, which must not happen
+    before a multi-host child calls jax.distributed.initialize()
+    (distributed/launch.py imports this package before the worker
+    script runs)."""
+
+    __slots__ = ()
+
+    def __init__(self):
+        Tensor.data.__set__(self, None)
+
+    def _materialize(self):
+        # run the CANONICAL Tensor.__init__ now — it fills every slot the
+        # same way any Tensor gets them, so this class never has to
+        # mirror tensor.py's field list
+        Tensor.__init__(self, jax.random.PRNGKey(_seed_value),
+                        stop_gradient=True, name="global_rng_key")
+
+    def __getattr__(self, name):
+        # a slot unset because we have not materialized yet (e.g.
+        # stop_gradient read before first key use): materialize + retry
+        if name.startswith("__"):
+            raise AttributeError(name)
+        self._materialize()
+        return object.__getattribute__(self, name)
+
+    @property
+    def data(self):
+        d = Tensor.data.__get__(self)
+        if d is None:
+            self._materialize()
+            d = Tensor.data.__get__(self)
+        return d
+
+    @data.setter
+    def data(self, value):
+        Tensor.data.__set__(self, value)
+
+
+# the global key lives in a Tensor so mode transforms can swap its payload
+_global_key = _LazyKeyTensor()
 
 
 def seed(value: int):
     """Set the global seed (paddle.seed / fluid.default_main_program
-    random_seed equivalent)."""
+    random_seed equivalent). Stays lazy: the key materializes on first
+    use, so seeding at program start keeps the backend untouched."""
     global _seed_value
     _seed_value = int(value)
-    _global_key.data = jax.random.PRNGKey(int(value))
+    Tensor.data.__set__(_global_key, None)  # re-derive from the new seed
     return _seed_value
 
 

@@ -1,0 +1,74 @@
+"""Worker script for test_multiprocess_launch.py — run through
+`paddle_tpu.distributed.launch --nproc_per_node 2`.
+
+Each process owns 4 virtual CPU devices; jax.distributed stitches them
+into one 8-device global mesh (the same code path a multi-host TPU pod's
+DCN uses). Trains a tiny regression data-parallel: every process feeds
+its LOCAL batch shard, gradients sync through the jitted step's
+collectives, and the final params (gathered) must be identical on every
+rank — written to a per-rank JSON for the test to compare."""
+import json
+import os
+import sys
+
+# forced-CPU child: must happen before jax initializes a backend
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from paddle_tpu.distributed import init_parallel_env  # noqa: E402
+
+init_parallel_env()  # consumes COORDINATOR_ADDRESS / trainer env
+
+import jax  # noqa: E402
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu import nn, optimizer as opt, jit  # noqa: E402
+from paddle_tpu.nn import functional as F  # noqa: E402
+from paddle_tpu.parallel.fleet import Fleet  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+rank = jax.process_index()
+assert jax.device_count() == 8 and jax.local_device_count() == 4
+
+fleet = Fleet().init(mesh_shape={"dp": 8})
+pt.seed(0)
+model = fleet.distributed_model(nn.Linear(4, 2))
+o = fleet.distributed_optimizer(
+    opt.SGD(learning_rate=0.1, parameters=model.parameters()))
+
+rng = np.random.RandomState(0)          # SAME data on both ranks...
+x_global = rng.randn(16, 4).astype("f4")
+y_global = (x_global @ rng.randn(4, 2).astype("f4"))
+# ...but each process PLACES only its half (8 rows) — the multi-host
+# feeding pattern: make_array_from_process_local_data builds the global
+# sharded batch from per-process shards
+mesh = fleet.mesh
+sh = NamedSharding(mesh, P("dp"))
+lo = rank * 8
+tx = pt.Tensor(jax.make_array_from_process_local_data(
+    sh, x_global[lo:lo + 8]))
+ty = pt.Tensor(jax.make_array_from_process_local_data(
+    sh, y_global[lo:lo + 8]))
+
+
+def step(x, y):
+    loss = F.mse_loss(model(x), y)
+    loss.backward()
+    o.step()
+    o.clear_grad()
+    return loss
+
+
+cstep = jit.to_static(step, models=[model], optimizers=[o])
+losses = [float(np.asarray(jax.device_get(cstep(tx, ty).data)))
+          for _ in range(4)]
+
+w = np.asarray(jax.device_get(model.weight.data)).tolist()
+out = {"rank": rank, "losses": losses, "weight": w}
+with open(os.environ["MULTIPROC_OUT"] + f".{rank}", "w") as f:
+    json.dump(out, f)
+print(f"[rank {rank}] done losses={losses}", flush=True)

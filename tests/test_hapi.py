@@ -224,3 +224,20 @@ def test_fleet_module_delegates_to_singleton():
     assert callable(fl.shard_batch)
     from paddle_tpu.hapi.vision.models import LeNet  # real package path
     assert LeNet.__name__ == "LeNet"
+
+
+def test_distributed_batch_sampler_many_ranks_small_dataset():
+    """total_size > 2*len(dataset): every rank still yields the same
+    number of batches (lockstep-safe padding)."""
+    from paddle_tpu.hapi import DistributedBatchSampler
+
+    class DS:
+        def __len__(self):
+            return 3
+
+    counts = []
+    for rank in range(8):
+        s = DistributedBatchSampler(DS(), batch_size=1, num_replicas=8,
+                                    rank=rank)
+        counts.append(sum(1 for _ in s))
+    assert counts == [1] * 8

@@ -1,0 +1,47 @@
+"""REAL multi-process distributed training (reference:
+distributed/launch.py spawning worker processes + NCCL init;
+TPU rebuild: jax.distributed over two local processes — the same
+coordinator/collective path a multi-host pod uses over DCN, exercised
+with CPU devices so it runs anywhere).
+
+The launcher fans out 2 processes x 4 virtual devices = one 8-device
+GLOBAL mesh; each process feeds its local batch shard; losses and final
+weights must agree bit-exactly across ranks."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def test_launch_two_process_global_mesh(tmp_path):
+    out_base = str(tmp_path / "result.json")
+    env = dict(os.environ)
+    # hermetic forced-CPU children: never let the TPU plugin grab them
+    for var in ("TPU_NAME", "TPU_LIBRARY_PATH", "PALLAS_AXON_POOL_IPS",
+                "PJRT_DEVICE", "TPU_WORKER_HOSTNAMES"):
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MULTIPROC_OUT"] = out_base
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multiproc_worker.py")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", worker],
+        env=env, cwd=os.path.dirname(os.path.dirname(worker)),
+        capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+
+    results = []
+    for rank in range(2):
+        with open(out_base + f".{rank}") as f:
+            results.append(json.load(f))
+    r0, r1 = sorted(results, key=lambda r: r["rank"])
+    # both ranks saw the SAME global loss every step (grads psum'd
+    # across processes inside the jitted step)
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=0)
+    # training progressed and the replicated weights stayed in sync
+    assert r0["losses"][-1] < r0["losses"][0]
+    np.testing.assert_allclose(r0["weight"], r1["weight"], rtol=0)

@@ -179,3 +179,19 @@ def test_detection_map_difficult_excluded():
     m = metric.DetectionMAP(class_num=2, evaluate_difficult=False)
     m.update(pt.to_tensor(det), pt.to_tensor(lab6))
     assert m.accumulate() == 0.0  # no countable gt → no AP
+
+
+def test_fluid_incubate_fleet_import_paths():
+    """The reference's launch-script import paths must resolve
+    (reference: fluid/incubate/fleet/{collective,base,parameter_server})."""
+    from paddle_tpu.fluid.incubate.fleet.collective import (
+        fleet, CollectiveOptimizer, DistributedStrategy, TrainStatus)
+    from paddle_tpu.fluid.incubate.fleet.base.role_maker import (
+        PaddleCloudRoleMaker, UserDefinedRoleMaker, MPISymetricRoleMaker)
+    from paddle_tpu.fluid.incubate.fleet.parameter_server. \
+        distribute_transpiler import fleet as ps_fleet
+    from paddle_tpu.fluid.incubate.data_generator import (
+        MultiSlotDataGenerator)
+    assert fleet is ps_fleet  # one singleton, collective-backed
+    assert TrainStatus(3) == TrainStatus(3)
+    assert callable(CollectiveOptimizer)
