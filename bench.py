@@ -318,8 +318,23 @@ def _arm_watchdog(seconds=3300):
     signal.alarm(seconds)
 
 
+def _enable_persistent_compile_cache():
+    """Persist XLA compilations across bench processes: first compile of
+    a BERT-size step over the tunnel costs minutes — a cache seeded by an
+    earlier run (e.g. the watcher's) makes the driver's run start from
+    warm executables."""
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/paddle_tpu_xla_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # pragma: no cover - version dependent
+        print(f"compile cache unavailable: {e}", flush=True)
+
+
 def main():
     _arm_watchdog()
+    _enable_persistent_compile_cache()
     if not _init_backend_with_retry():
         return
     _probe_pallas_kernels()
@@ -344,25 +359,20 @@ def main():
               flush=True)
         pipe_ips, loader_ips = 0.0, 0.0
     print(f"partial pipeline_images_per_sec={pipe_ips:.1f}", flush=True)
+    _RESULTS.update(
+        resnet50_pipeline_images_per_sec=round(pipe_ips, 1),
+        loader_images_per_sec=round(loader_ips, 1))
     try:
         long_tps, _ = bench_bert_long()
     except Exception as e:
         print(f"long-seq bench failed: {type(e).__name__}: {e}",
               flush=True)
         long_tps = 0.0
-    result = {
-        "metric": "bert_base_tokens/sec/chip",
-        "value": round(bert_tps, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(bert_tps / BERT_BASELINE_TOKENS_S, 3),
-        "resnet50_images_per_sec": round(rn_ips, 1),
-        "resnet50_vs_baseline": round(rn_ips / RESNET_BASELINE_IMG_S, 3),
-        "resnet50_pipeline_images_per_sec": round(pipe_ips, 1),
-        "loader_images_per_sec": round(loader_ips, 1),
-        "bert_seq2048_tokens_per_sec": round(long_tps, 1),
-        "bert_loss": round(bert_loss, 4),
-        "resnet50_loss": round(rn_loss, 4),
-    }
+    _RESULTS.update(bert_seq2048_tokens_per_sec=round(long_tps, 1))
+    # ONE output schema: everything was banked into _RESULTS as its
+    # stage finished (the same dict _fail_json reports from)
+    result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",
+              **_RESULTS}
     print(json.dumps(result))
 
 

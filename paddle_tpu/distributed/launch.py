@@ -96,12 +96,14 @@ def _spawn_local(args):
 def main(argv=None):
     args = parse_args(argv)
     if args.nproc_per_node > 1:
-        if args.coordinator is not None:
+        if args.coordinator is not None or args.num_hosts != 1 or \
+                args.host_id is not None:
             raise SystemExit(
-                "--nproc_per_node cannot combine with --coordinator: the "
-                "process model is one jax.distributed participant per "
-                "process — either local fan-out (--nproc_per_node alone) "
-                "or one launch per host (--coordinator/--host_id)")
+                "--nproc_per_node cannot combine with --coordinator/"
+                "--num_hosts/--host_id: the process model is one "
+                "jax.distributed participant per process — either local "
+                "fan-out (--nproc_per_node alone) or one launch per host "
+                "(--coordinator/--num_hosts/--host_id)")
         _spawn_local(args)
         return
     if args.coordinator and args.num_hosts > 1:

@@ -10,6 +10,7 @@ returned; otherwise the error says exactly where to drop the file —
 which is also the sane behavior for air-gapped TPU pods."""
 from __future__ import annotations
 
+import os
 import os.path as osp
 
 from ..dataset import common as _common
@@ -17,9 +18,12 @@ from ..dataset.common import md5file
 
 __all__ = ["get_weights_path_from_url", "get_path_from_url", "DATA_HOME"]
 
-# ONE cache-root derivation: dataset.common owns the env var; hapi's
-# root is its parent (reference: ~/.cache/paddle/{dataset,hapi})
-DATA_HOME = osp.dirname(_common.DATA_HOME)
+# ONE env var governs both cache roots: when PADDLE_TPU_DATA_HOME is set
+# it IS the root for hapi (and dataset.common uses it as its dataset
+# dir); unset, both default under ~/.cache/paddle_tpu
+_env_home = os.environ.get("PADDLE_TPU_DATA_HOME")
+DATA_HOME = osp.expanduser(_env_home) if _env_home \
+    else osp.dirname(_common.DATA_HOME)
 
 
 def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):

@@ -10,7 +10,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def bench(batch, seq, flash, pallas_ln, fused_adam, steps=15):
+def bench(batch, seq, flash, pallas_ln, fused_adam, steps=16, inner=4):
+    """`inner` real optimizer steps per compiled call (same amortization
+    as bench.py): the tunnel's 30-45 ms per-dispatch overhead would
+    otherwise drown the per-kernel deltas this ablation exists to
+    measure."""
     import paddle_tpu as pt
     from paddle_tpu import optimizer as opt, jit, amp
     from paddle_tpu.models.bert import BertConfig, BertForPretraining
@@ -24,13 +28,14 @@ def bench(batch, seq, flash, pallas_ln, fused_adam, steps=15):
     o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("i4")
-    mlm = np.where(rng.rand(batch, seq) < 0.15,
-                   rng.randint(0, cfg.vocab_size, (batch, seq)), -1
-                   ).astype("i4")
-    nsp = rng.randint(0, 2, (batch,)).astype("i4")
+    ids = rng.randint(0, cfg.vocab_size,
+                      (inner, batch, seq)).astype("i4")
+    mlm = np.where(rng.rand(inner, batch, seq) < 0.15,
+                   rng.randint(0, cfg.vocab_size, (inner, batch, seq)),
+                   -1).astype("i4")
+    nsp = rng.randint(0, 2, (inner, batch)).astype("i4")
 
-    def step(ids, mlm, nsp):
+    def one(ids, mlm, nsp):
         with amp.auto_cast(dtype="bfloat16"):
             logits, nsp_logits = model(ids)
         loss = model.loss(logits.astype("float32"),
@@ -40,17 +45,24 @@ def bench(batch, seq, flash, pallas_ln, fused_adam, steps=15):
         o.clear_grad()
         return loss
 
+    def step(ids_k, mlm_k, nsp_k):
+        loss = None
+        for i in range(inner):
+            loss = one(ids_k[i], mlm_k[i], nsp_k[i])
+        return loss
+
     fn = jit.to_static(step, models=[model], optimizers=[o])
     t_ids, t_mlm, t_nsp = pt.to_tensor(ids), pt.to_tensor(mlm), \
         pt.to_tensor(nsp)
     fn(t_ids, t_mlm, t_nsp)
     loss = fn(t_ids, t_mlm, t_nsp)
     loss.numpy()
+    n_calls = max(1, steps // inner)
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for _ in range(n_calls):
         loss = fn(t_ids, t_mlm, t_nsp)
     loss.numpy()
-    dt = (time.perf_counter() - t0) / steps
+    dt = (time.perf_counter() - t0) / (n_calls * inner)
     return batch * seq / dt, float(loss.numpy())
 
 
