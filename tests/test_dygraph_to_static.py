@@ -295,3 +295,48 @@ class TestLoopTransforms:
         out = convert_function(f)(x)
         assert float(out.numpy()) == ref
         assert float(jit.to_static(f)(x).numpy()) == ref
+
+    def test_nested_loops_with_breaks(self):
+        """Each loop owns its break; inner tensor-dependent break inside
+        an outer python loop."""
+        def f(x):
+            total = x * 0.0
+            for _ in range(3):            # python outer
+                s = x * 0.0
+                while s < 10.0:           # tensor inner with break
+                    s = s + x
+                    if s > 4.0:
+                        break
+                total = total + s
+            return total
+
+        x = pt.to_tensor(np.float32(2.0))
+        # inner: 2,4,6 -> breaks at 6; x3 outer => 18
+        assert float(convert_function(f)(x).numpy()) == 18.0
+        assert float(jit.to_static(f)(x).numpy()) == 18.0
+
+    def test_while_continue_only(self):
+        def f(x):
+            i = pt.ops.zeros([], dtype="float32")
+            acc = x * 0.0
+            while i < 6.0:
+                i = i + 1.0
+                if (i % 2.0) > 0.5:       # odd -> skip
+                    continue
+                acc = acc + i
+            return acc
+
+        x = pt.to_tensor(np.float32(0.0))
+        assert float(convert_function(f)(x).numpy()) == 12.0  # 2+4+6
+        assert float(jit.to_static(f)(x).numpy()) == 12.0
+
+    def test_for_over_dict_items(self):
+        def f(x):
+            acc = x * 0.0
+            for k, v in {"a": 1.0, "b": 2.0}.items():
+                acc = acc + v
+            return acc
+
+        x = pt.to_tensor(np.float32(0.0))
+        assert float(convert_function(f)(x).numpy()) == 3.0
+        assert float(jit.to_static(f)(x).numpy()) == 3.0
