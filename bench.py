@@ -269,36 +269,63 @@ def _fail_json(msg):
     print(json.dumps(out), flush=True)
 
 
+def _subprocess_probe(timeout_s=300):
+    """First contact with a wedged tunnel BLOCKS UNINTERRUPTIBLY (the
+    hang sits in C, so an in-process SIGALRM never fires — observed
+    r4). Probe in a SUBPROCESS that an external kill can always reap;
+    only touch jax in this process once the probe proves the backend
+    answers."""
+    import subprocess
+    import sys
+
+    code = ("import jax, jax.numpy as jnp;"
+            "jnp.zeros((8,), jnp.float32).block_until_ready();"
+            "print('PROBE_OK', jax.devices()[0].platform)")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return False, f"no backend response in {timeout_s}s (tunnel " \
+                      "wedged: first contact blocks uninterruptibly)"
+    if proc.returncode == 0 and "PROBE_OK" in proc.stdout:
+        return True, proc.stdout.strip().splitlines()[-1]
+    return False, (proc.stderr or proc.stdout).strip()[-300:]
+
+
 def _init_backend_with_retry(attempts=3, backoff=30):
     """The axon tunnel wedges transiently: first contact can raise
-    'UNAVAILABLE: TPU backend setup/compile error'. One failed attempt is
-    cached by jax, so clear backends between tries and back off."""
-    import jax
-
+    'UNAVAILABLE: TPU backend setup/compile error' — or hang forever.
+    Each attempt is a subprocess probe (see _subprocess_probe); the
+    in-process backend is touched only after a probe succeeds."""
     last = None
     for i in range(attempts):
-        try:
-            import jax.numpy as jnp
-            jnp.zeros((8,), jnp.float32).block_until_ready()
-            print(f"backend ok: {jax.devices()[0].platform} "
-                  f"(attempt {i + 1})", flush=True)
-            return True
-        except Exception as e:  # pragma: no cover - env dependent
-            last = e
-            print(f"backend init attempt {i + 1}/{attempts} failed: "
-                  f"{type(e).__name__}: {e}", flush=True)
+        ok, msg = _subprocess_probe()
+        if ok:
             try:
-                from jax.extend import backend as _jeb
-                _jeb.clear_backends()
-            except Exception:
+                import jax
+                import jax.numpy as jnp
+                jnp.zeros((8,), jnp.float32).block_until_ready()
+                print(f"backend ok: {jax.devices()[0].platform} "
+                      f"(attempt {i + 1})", flush=True)
+                return True
+            except Exception as e:  # transient per-connection failure:
+                # clear the cached bad backend and keep retrying (an
+                # in-process HANG here remains possible but the probe
+                # narrowed that window to seconds)
+                msg = f"probe ok but in-process init failed: " \
+                      f"{type(e).__name__}: {e}"
                 try:
-                    jax.clear_backends()  # older spelling
+                    from jax.extend import backend as _jeb
+                    _jeb.clear_backends()
                 except Exception:
                     pass
-            if i + 1 < attempts:
-                time.sleep(backoff * (i + 1))
-    _fail_json(f"backend init failed after {attempts} attempts: "
-               f"{type(last).__name__}: {last}")
+        last = msg
+        print(f"backend init attempt {i + 1}/{attempts} failed: {msg}",
+              flush=True)
+        if i + 1 < attempts:
+            time.sleep(backoff * (i + 1))
+    _fail_json(f"backend init failed after {attempts} attempts: {last}")
     return False
 
 

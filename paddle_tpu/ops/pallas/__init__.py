@@ -30,14 +30,30 @@ _overrides = {}
 _KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent")
 
 
-def configure(**kernels):
+# flash is an O(S^2)-score win: below some sequence length the XLA sdpa
+# (one fused attention) can beat the blocked kernel's overheads — the
+# crossover is measured by scripts/ablate_bert.py and set here (0 = flash
+# whenever enabled)
+_flash_min_seq = 0
+_UNSET = object()
+
+
+def configure(flash_min_seq=_UNSET, **kernels):
     """configure(layer_norm=False, fused_adam=None, ...) — override the
     auto default for named kernels ('layer_norm', 'fused_adam',
     'flash_attention', 'softmax_xent'). None restores auto.
+    flash_min_seq=N routes sequences shorter than N to XLA sdpa even
+    with the flash kernel enabled (the ablation-tuned crossover);
+    flash_min_seq=None restores the no-threshold default, matching the
+    kernel knobs' None-resets semantics.
 
     The flag is read when an op traces, so call configure() BEFORE the
     first jitted step — a step already compiled keeps the kernel choice
     it was traced with."""
+    global _flash_min_seq
+    if flash_min_seq is not _UNSET:
+        _flash_min_seq = 0 if flash_min_seq is None \
+            else int(flash_min_seq)
     for k, v in kernels.items():
         if k not in _KERNELS:
             raise ValueError(
@@ -48,10 +64,15 @@ def configure(**kernels):
             _overrides[k] = bool(v)
 
 
-def enabled(kernel):
-    """Effective default for one kernel, honoring configure() overrides."""
+def enabled(kernel, seq_len=None):
+    """Effective default for one kernel, honoring configure() overrides
+    (and the flash seq-length crossover when seq_len is given)."""
     v = _overrides.get(kernel)
-    return on_tpu() if v is None else v
+    on = on_tpu() if v is None else v
+    if on and kernel == "flash_attention" and seq_len is not None and \
+            seq_len < _flash_min_seq:
+        return False
+    return on
 
 
 from . import layer_norm as layer_norm_mod

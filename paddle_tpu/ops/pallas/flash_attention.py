@@ -556,7 +556,9 @@ def flash_attention(q, k, v, attn_mask=None, causal=False, scale=None,
     p_drop = float(dropout_p) if training else 0.0
     has_mask = attn_mask is not None
     mode = _mask_mode(attn_mask.shape if has_mask else None, b, h, sq, sk)
-    if mode == "fallback" or (not enabled("flash_attention") and not force):
+    if mode == "fallback" or (not force and
+                              not enabled("flash_attention",
+                                          seq_len=max(sq, sk))):
         from ..nn_ops import scaled_dot_product_attention as sdpa
         return sdpa(q, k, v, attn_mask=attn_mask, is_causal=causal,
                     scale=scale, dropout_p=p_drop, training=training)

@@ -539,3 +539,27 @@ def test_transformer_loss_pallas_gate():
     finally:
         P.configure(softmax_xent=None)
     np.testing.assert_allclose(l_k, l_x, rtol=1e-5)
+
+
+def test_flash_min_seq_gate():
+    """configure(flash_min_seq=N) routes short sequences to sdpa even
+    with the kernel force-enabled (the ablation-tuned crossover knob)."""
+    from paddle_tpu.ops import pallas as P
+    import numpy as np
+    import paddle_tpu as pt
+
+    q = pt.to_tensor(np.random.RandomState(0).randn(1, 2, 16, 8)
+                     .astype("f4"))
+    try:
+        P.configure(flash_attention=True, flash_min_seq=64)
+        assert not P.enabled("flash_attention", seq_len=16)
+        assert P.enabled("flash_attention", seq_len=128)
+        # short seq runs through the sdpa fallback (no interpret-mode
+        # kernel = fast) and matches plain attention
+        out = P.flash_attention(q, q, q)
+        from paddle_tpu.ops.nn_ops import scaled_dot_product_attention
+        ref = scaled_dot_product_attention(q, q, q, training=False)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+    finally:
+        P.configure(flash_attention=None, flash_min_seq=None)
