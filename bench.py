@@ -319,7 +319,10 @@ def _init_backend_with_retry(attempts=3, backoff=30):
                     from jax.extend import backend as _jeb
                     _jeb.clear_backends()
                 except Exception:
-                    pass
+                    try:
+                        jax.clear_backends()  # older spelling
+                    except Exception:
+                        pass
         last = msg
         print(f"backend init attempt {i + 1}/{attempts} failed: {msg}",
               flush=True)

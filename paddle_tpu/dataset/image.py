@@ -84,3 +84,14 @@ def load_and_transform(filename, resize_size, crop_size, is_train,
     """reference: image.py:383."""
     return simple_transform(load_image(filename, is_color), resize_size,
                             crop_size, is_train, is_color, mean)
+
+
+def resize_exact(im, h, w):
+    """Nearest-neighbor resize to exactly (h, w) — the shared separable
+    index arithmetic (used by resize_short and hapi transforms)."""
+    im = np.asarray(im)
+    ys = (np.arange(h) * (im.shape[0] / h)).astype(int).clip(0,
+                                                             im.shape[0] - 1)
+    xs = (np.arange(w) * (im.shape[1] / w)).astype(int).clip(0,
+                                                             im.shape[1] - 1)
+    return im[ys][:, xs]

@@ -1,3 +1,5 @@
 """hapi.vision (reference: incubate/hapi/vision — the models package;
-transforms arrived in later generations)."""
+transforms shipped beside this generation's hapi and are rebuilt in
+transforms.py)."""
 from . import models  # noqa: F401
+from . import transforms  # noqa: F401

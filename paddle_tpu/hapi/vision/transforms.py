@@ -1,0 +1,206 @@
+"""hapi.vision.transforms — composable image preprocessing (reference:
+the hapi generation's vision transforms used with DatasetFolder; this
+paddle generation shipped them beside incubate/hapi — rebuilt here as
+pure-numpy callables).
+
+Design: every transform is HOST-side numpy over HWC images (uint8 or
+float). That is deliberate: decode/augment is the GIL-bound work
+io.DataLoader's worker PROCESSES parallelize (num_workers>0), and the
+device should receive small uint8 batches (4x cheaper over the
+host-to-device link) and normalize on-chip inside the jitted step —
+compose Normalize into the model input when feeding uint8, or into the
+transform chain when CPU cycles are free."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ...dataset import image as _img
+
+__all__ = ["Compose", "Resize", "CenterCrop", "RandomCrop",
+           "RandomHorizontalFlip", "RandomVerticalFlip",
+           "RandomResizedCrop", "Normalize", "Transpose", "ToTensor",
+           "BrightnessTransform", "Lambda"]
+
+
+def _pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+class Compose:
+    """Chain transforms: Compose([Resize(256), RandomCrop(224), ...])."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+    def __repr__(self):
+        inner = ", ".join(type(t).__name__ for t in self.transforms)
+        return f"Compose([{inner}])"
+
+
+class Resize:
+    """Resize so the SHORT side equals `size` (int, aspect preserved) or
+    to exact (h, w)."""
+
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if isinstance(self.size, numbers.Number):
+            return _img.resize_short(img, int(self.size))
+        h, w = _pair(self.size)
+        return _img.resize_exact(img, h, w)
+
+
+def _check_crop(img, ch, cw, kind):
+    h, w = img.shape[:2]
+    if h < ch or w < cw:
+        raise ValueError(
+            f"{kind}({ch}, {cw}) on a {h}x{w} image — the input is "
+            "smaller than the crop (an undersized sample would crash "
+            "batch collation downstream); Resize first")
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = _pair(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        ch, cw = self.size
+        _check_crop(img, ch, cw, "CenterCrop")
+        h, w = img.shape[:2]
+        top = (h - ch) // 2
+        left = (w - cw) // 2
+        return img[top:top + ch, left:left + cw]
+
+
+class RandomCrop:
+    def __init__(self, size, rng=None):
+        self.size = _pair(size)
+        self.rng = rng or np.random
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        ch, cw = self.size
+        _check_crop(img, ch, cw, "RandomCrop")
+        h, w = img.shape[:2]
+        top = self.rng.randint(0, h - ch + 1)
+        left = self.rng.randint(0, w - cw + 1)
+        return img[top:top + ch, left:left + cw]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, rng=None):
+        self.prob = prob
+        self.rng = rng or np.random
+
+    def __call__(self, img):
+        if self.rng.rand() < self.prob:
+            return np.asarray(img)[:, ::-1]
+        return np.asarray(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, rng=None):
+        self.prob = prob
+        self.rng = rng or np.random
+
+    def __call__(self, img):
+        if self.rng.rand() < self.prob:
+            return np.asarray(img)[::-1]
+        return np.asarray(img)
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize to `size` — the ImageNet
+    training crop."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 rng=None):
+        self.size = _pair(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.rng = rng or np.random
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * self.rng.uniform(*self.scale)
+            aspect = self.rng.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * aspect)))
+            ch = int(round(np.sqrt(target / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = self.rng.randint(0, h - ch + 1)
+                left = self.rng.randint(0, w - cw + 1)
+                crop = img[top:top + ch, left:left + cw]
+                return Resize(self.size)(crop)
+        return Resize(self.size)(CenterCrop(min(h, w))(img))
+
+
+class Normalize:
+    """(img - mean) / std, channel-last by default; outputs float32."""
+
+    def __init__(self, mean, std, channel_axis=-1):
+        self.mean = np.asarray(mean, "float32")
+        self.std = np.asarray(std, "float32")
+        self.channel_axis = channel_axis
+
+    def __call__(self, img):
+        img = np.asarray(img, "float32")
+        shape = [1] * img.ndim
+        shape[self.channel_axis] = -1
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class Transpose:
+    """HWC -> CHW (the zoo models' NCHW input layout)."""
+
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+class ToTensor:
+    """uint8 HWC -> float32 CHW in [0, 1]."""
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        if img.dtype == np.uint8:
+            img = img.astype("float32") / 255.0
+        return img.transpose(2, 0, 1) if img.ndim == 3 else img
+
+
+class BrightnessTransform:
+    def __init__(self, value, rng=None):
+        self.value = float(value)
+        self.rng = rng or np.random
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        # value range follows DTYPE, not data (a dark uint8 frame must
+        # not get clipped to [0, 1])
+        ceil = 255.0 if img.dtype == np.uint8 else 1.0
+        alpha = 1.0 + self.rng.uniform(-self.value, self.value)
+        return np.clip(img.astype("float32") * alpha, 0.0, ceil)
+
+
+class Lambda:
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, img):
+        return self.fn(img)

@@ -241,3 +241,69 @@ def test_distributed_batch_sampler_many_ranks_small_dataset():
                                     rank=rank)
         counts.append(sum(1 for _ in s))
     assert counts == [1] * 8
+
+
+def test_vision_transforms_pipeline():
+    from paddle_tpu.hapi.vision import transforms as T
+    rng = np.random.RandomState(0)
+    img = (rng.rand(50, 40, 3) * 255).astype("u1")
+
+    tf = T.Compose([T.Resize(48), T.CenterCrop(32), T.ToTensor()])
+    out = tf(img)
+    assert out.shape == (3, 32, 32) and out.dtype == np.float32
+    assert 0.0 <= out.min() and out.max() <= 1.0
+
+    # deterministic random transforms via injected rng
+    r = np.random.RandomState(3)
+    tf2 = T.Compose([T.RandomResizedCrop(16, rng=r),
+                     T.RandomHorizontalFlip(prob=1.0),
+                     T.Normalize([127.5] * 3, [127.5] * 3),
+                     T.Transpose()])
+    out2 = tf2(img)
+    assert out2.shape == (3, 16, 16)
+    assert abs(float(out2.mean())) < 1.5  # roughly centered
+
+    # exact-size resize + flip identity checks
+    assert T.Resize((20, 24))(img).shape == (20, 24, 3)
+    np.testing.assert_array_equal(
+        T.RandomHorizontalFlip(prob=1.0)(img), img[:, ::-1])
+    np.testing.assert_array_equal(
+        T.RandomVerticalFlip(prob=1.0)(img), img[::-1])
+
+
+def test_vision_transforms_with_dataset_folder(tmp_path):
+    """transforms compose into DatasetFolder + the multiprocess loader —
+    the decode/augment pipeline the worker processes exist for."""
+    from paddle_tpu.hapi.datasets import DatasetFolder
+    from paddle_tpu.hapi.vision import transforms as T
+    rng = np.random.RandomState(0)
+    for cls in ("a", "b"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(str(d / f"{i}.npy"),
+                    (rng.rand(20, 20, 3) * 255).astype("u1"))
+    tf = T.Compose([T.CenterCrop(16), T.ToTensor()])
+    ds = DatasetFolder(str(tmp_path), transform=tf)
+    loader = io.DataLoader(ds, batch_size=2, num_workers=2,
+                           use_native=False)
+    batches = list(loader)
+    assert sum(x.shape[0] for x, _ in batches) == 6
+    assert batches[0][0].shape == (2, 3, 16, 16)
+
+
+def test_vision_transforms_edge_semantics():
+    from paddle_tpu.hapi.vision import transforms as T
+    small = (np.random.RandomState(0).rand(10, 10, 3) * 255).astype("u1")
+    with pytest.raises(ValueError, match="smaller than the crop"):
+        T.CenterCrop(16)(small)
+    with pytest.raises(ValueError, match="smaller than the crop"):
+        T.RandomCrop(16)(small)
+    # brightness range follows dtype: dark uint8 scales, not clips to 1
+    dark = np.ones((4, 4, 3), "u1")
+    r = np.random.RandomState(0)
+    out = T.BrightnessTransform(0.0, rng=r)(dark)
+    np.testing.assert_allclose(out, 1.0)
+    out2 = np.clip(dark.astype("f4") * 1.4, 0, 255)
+    got = T.BrightnessTransform(0.0, rng=r)(dark) * 1.4
+    np.testing.assert_allclose(got, out2)
