@@ -1,0 +1,62 @@
+"""Train LeNet on MNIST end-to-end — the 60-second tour.
+
+    python examples/train_mnist.py
+
+Covers: hapi datasets + transforms, the multiprocess DataLoader, a
+compiled train step (jit.to_static: fwd+bwd+optimizer as ONE donated XLA
+computation), and eval."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer as opt, jit, io
+from paddle_tpu.nn import functional as F
+from paddle_tpu.hapi.datasets import MNIST
+from paddle_tpu.hapi.vision import transforms as T
+from paddle_tpu.models import LeNet
+
+
+def main():
+    # MNIST arrays arrive already normalized to [-1, 1] (reference
+    # mnist reader semantics) — just shape HW -> CHW
+    tf = T.Compose([T.Lambda(lambda im: im[..., None]),
+                    T.Transpose()])
+    train = MNIST(mode="train", transform=tf)
+    test = MNIST(mode="test", transform=tf)
+    loader = io.DataLoader(train, batch_size=128, shuffle=True,
+                           num_workers=2, use_native=False)
+
+    pt.seed(0)
+    model = LeNet(num_classes=10)
+    o = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    def step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    cstep = jit.to_static(step, models=[model], optimizers=[o])
+    for epoch in range(2):
+        for i, (xb, yb) in enumerate(loader):
+            loss = cstep(pt.to_tensor(xb.astype("f4")),
+                         pt.to_tensor(yb.astype("i4")))
+            if i % 50 == 0:
+                print(f"epoch {epoch} step {i}: "
+                      f"loss={float(loss.numpy()):.4f}")
+
+    model.eval()
+    xs = np.stack([test[i][0] for i in range(512)]).astype("f4")
+    ys = np.asarray([test[i][1] for i in range(512)], "i4")
+    pred = model(pt.to_tensor(xs)).numpy().argmax(-1)
+    print(f"test accuracy (512 samples): {(pred == ys).mean():.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -19,9 +19,7 @@ def resize_short(im, size):
         nh, nw = size, max(1, int(round(w * size / h)))
     else:
         nh, nw = max(1, int(round(h * size / w))), size
-    ys = (np.arange(nh) * (h / nh)).astype(np.int64).clip(0, h - 1)
-    xs = (np.arange(nw) * (w / nw)).astype(np.int64).clip(0, w - 1)
-    return im[ys][:, xs]
+    return resize_exact(im, nh, nw)
 
 
 def to_chw(im, order=(2, 0, 1)):

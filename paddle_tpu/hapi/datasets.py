@@ -22,7 +22,9 @@ IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".webp",
 
 class MNIST(Dataset):
     """reference: datasets/mnist.py — mode 'train'|'test', optional
-    transform(img) -> img."""
+    transform(img) -> img. Images are 28x28 float32 ALREADY normalized
+    to [-1, 1] (the reference mnist reader's (px/127.5)-1 semantics) —
+    do not renormalize by 255."""
 
     def __init__(self, mode="train", transform=None, return_label=True):
         from ..dataset import mnist as _mnist

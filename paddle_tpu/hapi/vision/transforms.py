@@ -191,11 +191,15 @@ class BrightnessTransform:
 
     def __call__(self, img):
         img = np.asarray(img)
-        # value range follows DTYPE, not data (a dark uint8 frame must
-        # not get clipped to [0, 1])
-        ceil = 255.0 if img.dtype == np.uint8 else 1.0
         alpha = 1.0 + self.rng.uniform(-self.value, self.value)
-        return np.clip(img.astype("float32") * alpha, 0.0, ceil)
+        out = img.astype("float32") * alpha
+        # value range follows DTYPE: uint8 clips at [0, 255]; float
+        # images carry arbitrary ranges ([-1,1] MNIST, 0-255 decoded
+        # floats) and are NOT clipped — the caller's Normalize defines
+        # their range
+        if img.dtype == np.uint8:
+            out = np.clip(out, 0.0, 255.0)
+        return out
 
 
 class Lambda:

@@ -48,15 +48,25 @@ class Predictor:
     signature is lowered + compiled once (AOT) and cached."""
 
     def __init__(self, model_or_config, config=None):
+        caller_owns_model = False
         if isinstance(model_or_config, Config):
             config = model_or_config
             from . import io as pio
             model = pio.load_inference_model(config.model_path)
         else:
             model = model_or_config
+            caller_owns_model = True
         self.config = config or Config()
         if self.config.precision == "int8":
             from .quantization import convert, quant_post_static
+            if caller_owns_model:
+                # quantize a COPY: convert/quant_post_static rewrap
+                # layers in place, and the caller's model must stay
+                # float (they may build other Predictors from it or keep
+                # training it). A path-loaded model is already private —
+                # no copy, no doubled peak memory.
+                import copy
+                model = copy.deepcopy(model)
             cal = getattr(self.config, "calibration_data", None)
             if cal is not None:
                 model = quant_post_static(model, cal)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+import time
 import queue as _queue
 import warnings
 
@@ -289,6 +290,11 @@ def _mp_worker_loop(dataset, collate_fn, index_q, data_q):
     jax: datasets/collate for num_workers>0 must return numpy, not device
     arrays (same rule as the reference's worker processes, which must not
     touch CUDA)."""
+    # forked children inherit the parent's numpy RNG state: without a
+    # per-worker reseed every worker would draw IDENTICAL augmentation
+    # streams (and every epoch would replay them)
+    np.random.seed((os.getpid() * 1000003 + int(
+        time.time() * 1e6)) % (2 ** 32))
     while True:
         job = index_q.get()
         if job is None:

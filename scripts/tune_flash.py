@@ -1,0 +1,72 @@
+"""Flash-attention block-size sweep on the real chip: block_q x block_k
+over BERT-base-shaped attention at seq 128 / 512 / 2048, fwd+bwd.
+Prints one line per config; the best (block_q, block_k) per seq length
+feeds flash_attention's defaults (and the flash_min_seq crossover comes
+from comparing against the sdpa row). Run:
+    python -u scripts/tune_flash.py
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def bench_attention(seq, block_q, block_k, use_flash, batch=8, heads=12,
+                    head_dim=64, steps=10):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import _flash
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(batch, heads, seq, head_dim),
+                    jnp.bfloat16)
+    seed = jnp.zeros((2,), jnp.int32)
+
+    if use_flash:
+        def f(q):
+            out = _flash(q, q, q, None, None, seed, False, None,
+                         block_q, block_k, 0.0)
+            return out.astype(jnp.float32).sum()
+    else:
+        def f(q):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(head_dim)
+            p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), q)
+            return out.astype(jnp.float32).sum()
+
+    g = jax.jit(jax.grad(f))
+    g(q).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = g(q)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / steps
+    # attention fwd+bwd ~ 4x the 2*B*H*S^2*D fwd matmul FLOPs
+    flops = 4 * 2 * batch * heads * seq * seq * head_dim
+    return dt * 1e3, flops / dt / 1e12
+
+
+def main():
+    for seq in (128, 512, 2048):
+        ms, tf = bench_attention(seq, 0, 0, use_flash=False)
+        print(f"seq={seq:5d} sdpa:              {ms:8.2f} ms  "
+              f"{tf:6.2f} TF/s", flush=True)
+        for bq in (256, 512, 1024):
+            for bk in (256, 512, 1024):
+                if bq > seq * 2 or bk > seq * 2:
+                    continue
+                try:
+                    ms, tf = bench_attention(seq, bq, bk, use_flash=True)
+                    print(f"seq={seq:5d} flash bq={bq:4d} bk={bk:4d}: "
+                          f"{ms:8.2f} ms  {tf:6.2f} TF/s", flush=True)
+                except Exception as e:
+                    print(f"seq={seq:5d} flash bq={bq:4d} bk={bk:4d}: "
+                          f"FAIL {type(e).__name__}: {e}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

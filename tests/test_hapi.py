@@ -299,11 +299,16 @@ def test_vision_transforms_edge_semantics():
         T.CenterCrop(16)(small)
     with pytest.raises(ValueError, match="smaller than the crop"):
         T.RandomCrop(16)(small)
-    # brightness range follows dtype: dark uint8 scales, not clips to 1
-    dark = np.ones((4, 4, 3), "u1")
-    r = np.random.RandomState(0)
-    out = T.BrightnessTransform(0.0, rng=r)(dark)
-    np.testing.assert_allclose(out, 1.0)
-    out2 = np.clip(dark.astype("f4") * 1.4, 0, 255)
-    got = T.BrightnessTransform(0.0, rng=r)(dark) * 1.4
-    np.testing.assert_allclose(got, out2)
+    # brightness range follows DTYPE inside the transform
+    class AlphaUp:  # deterministic rng: alpha = 1.4
+        @staticmethod
+        def uniform(lo, hi):
+            return 0.4
+
+    bt = T.BrightnessTransform(0.4, rng=AlphaUp())
+    dark = np.ones((4, 4, 3), "u1")          # max pixel 1
+    np.testing.assert_allclose(bt(dark), 1.4)  # NOT clipped to 1.0
+    bright = np.full((2, 2, 3), 200, "u1")
+    np.testing.assert_allclose(bt(bright), 255.0)  # uint8 ceiling
+    signed = np.array([[-1.0, 1.0]], "f4")   # float: no clipping
+    np.testing.assert_allclose(bt(signed), [[-1.4, 1.4]], rtol=1e-6)

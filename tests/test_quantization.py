@@ -256,3 +256,17 @@ def test_int8_gate_follows_loaded_state_dict():
     out2 = fresh(x)
     assert not fresh[0]._int8_compute
     assert np.abs(out2.numpy()).max() < 1e3
+
+
+def test_predictor_int8_does_not_mutate_callers_model():
+    """enable_int8 must quantize a COPY — a later float Predictor from
+    the same model object has to produce float results."""
+    from paddle_tpu.inference import Config, Predictor
+    pt.seed(6)
+    m = nn.Sequential(nn.Linear(8, 4))
+    x = np.random.RandomState(6).randn(3, 8).astype("f4")
+    ref = Predictor(m, Config()).run(x)
+    _ = Predictor(m, Config().enable_int8([pt.to_tensor(x)]))
+    assert isinstance(m[0], nn.Linear)  # caller's layer untouched
+    again = Predictor(m, Config()).run(x)
+    np.testing.assert_array_equal(ref, again)
