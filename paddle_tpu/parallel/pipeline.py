@@ -186,11 +186,38 @@ class PipelineSchedule:
     def n_ticks(self):
         return self.table.shape[0]
 
-    def bubble_fraction(self):
-        """Idle slots / total timeline slots (fwd = bwd = 1 unit)."""
-        busy = int((self.table[:, :, 0] != _IDLE).sum())
-        total = self.n_ticks * self.n_ranks
-        return 1.0 - busy / total
+    def bubble_fraction(self, bwd_cost=1.0):
+        """Idle fraction of the timeline. bwd_cost weights backward ops
+        (Megatron's accounting uses ~2.0: bwd is two matmul passes);
+        each tick's duration is the COSTLIEST op running in it (lockstep
+        SPMD: every rank waits for the slowest)."""
+        ops = self.table[:, :, 0]
+        cost = {_IDLE: 0.0, _FWD: 1.0, _BWD: float(bwd_cost)}
+        tick_len = np.array([max(cost[int(o)] for o in row)
+                             for row in ops])
+        busy = sum(cost[int(o)] for row in ops for o in row)
+        total = float(tick_len.sum()) * self.n_ranks
+        return 1.0 - busy / total if total else 0.0
+
+    def render(self):
+        """ASCII timeline (ranks x ticks): F3/B3 = fwd/bwd of microbatch
+        3; for interleaved, chunk c shows as c:F3. Debugging aid."""
+        # one fixed cell width keeps tick columns vertically aligned
+        width = 1 + len(str(self.n_micro - 1)) + (
+            2 if self.n_chunks > 1 else 0)
+        lines = []
+        for r in range(self.n_ranks):
+            cells = []
+            for t in range(self.n_ticks):
+                op, mb, c = self.table[t, r]
+                if op == _IDLE:
+                    cells.append(".".center(width))
+                else:
+                    tag = "F" if op == _FWD else "B"
+                    pre = f"{c}:" if self.n_chunks > 1 else ""
+                    cells.append(f"{pre}{tag}{mb}".rjust(width))
+            lines.append(f"rank{r}: " + " ".join(cells))
+        return "\n".join(lines)
 
     def peak_live_activations(self):
         """Max over (rank, chunk) of simultaneously-saved fwd activations
