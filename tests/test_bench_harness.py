@@ -1,0 +1,92 @@
+"""The benchmark's robustness machinery (VERDICT r3 #1a: 'make the perf
+number un-losable') — unit-locked so a refactor can't silently lose the
+always-parseable-JSON or partial-credit behavior the r4 tunnel outage
+proved out."""
+import contextlib
+import importlib.util
+import io as _io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod._RESULTS.clear()
+    return mod
+
+
+def _capture_json(fn, *args):
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        fn(*args)
+    lines = [l for l in buf.getvalue().splitlines() if l.strip()]
+    assert len(lines) == 1, "exactly ONE line, and it must be JSON"
+    return json.loads(lines[0])
+
+
+def test_fail_json_zero_schema(bench):
+    d = _capture_json(bench._fail_json, "boom")
+    for key in ("metric", "value", "unit", "vs_baseline",
+                "resnet50_images_per_sec", "resnet50_vs_baseline"):
+        assert key in d
+    assert d["value"] == 0.0 and d["error"] == "boom"
+
+
+def test_fail_json_partial_credit(bench):
+    bench._RESULTS.update(value=123.4, vs_baseline=4.936,
+                          bert_seq2048_tokens_per_sec=9.0)
+    d = _capture_json(bench._fail_json, "tunnel died mid-run")
+    assert d["value"] == 123.4                       # real, banked
+    assert d["bert_seq2048_tokens_per_sec"] == 9.0
+    assert d["resnet50_images_per_sec"] == 0.0       # never reached
+    assert "tunnel died" in d["error"]
+
+
+def test_subprocess_probe_ok_on_cpu(bench, monkeypatch):
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+    ok, msg = bench._subprocess_probe(timeout_s=240)
+    assert ok, msg
+    assert "PROBE_OK" in msg
+
+
+def test_subprocess_probe_times_out_on_hang(bench, monkeypatch):
+    """A wedged backend = uninterruptible block; the probe must come back
+    anyway (that is its whole reason to exist)."""
+    real_exe = sys.executable
+    # simulate the wedge: the probe command sleeps forever
+    import subprocess as sp
+    real_run = sp.run
+
+    def fake_run(cmd, **kw):
+        return real_run([real_exe, "-c", "import time; time.sleep(60)"],
+                        **kw)
+
+    monkeypatch.setattr(sp, "run", fake_run)
+    ok, msg = bench._subprocess_probe(timeout_s=1)
+    assert not ok and "no backend response" in msg
+
+
+def test_init_retry_gives_fail_json_when_probe_never_succeeds(
+        bench, monkeypatch):
+    monkeypatch.setattr(bench, "_subprocess_probe",
+                        lambda timeout_s=300: (False, "still wedged"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    buf = _io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        ok = bench._init_backend_with_retry(attempts=3, backoff=0)
+    assert not ok
+    lines = [l for l in buf.getvalue().splitlines()
+             if l.startswith("{")]
+    d = json.loads(lines[-1])
+    assert "still wedged" in d["error"] and d["value"] == 0.0
