@@ -45,8 +45,10 @@ def _probe_pallas_kernels():
         jax.grad(f)(q).block_until_ready()
 
     def layer_norm():
+        # 8192 rows f32 = the seq-2048 bench's worst case (r4 VMEM OOM
+        # was f32-and-shape-dependent; a small bf16 probe missed it)
         from paddle_tpu.ops.pallas.layer_norm import _layer_norm2
-        x = jnp.ones((256, 768), jnp.bfloat16)
+        x = jnp.ones((8192, 768), jnp.float32)
         w = jnp.ones((768,), jnp.float32)
         b = jnp.zeros((768,), jnp.float32)
 
@@ -63,9 +65,11 @@ def _probe_pallas_kernels():
         new_p.block_until_ready()
 
     def softmax_xent():
+        # 4096 rows = the real bench shape (batch 32 × seq 128): the r4
+        # VMEM blow-up was shape-dependent and a 256-row probe missed it
         from paddle_tpu.ops.pallas.softmax_xent import _softmax_xent2
-        x = jnp.ones((256, 30522), jnp.float32)
-        lab = jnp.zeros((256, 1), jnp.int32)
+        x = jnp.ones((4096, 30522), jnp.float32)
+        lab = jnp.zeros((4096, 1), jnp.int32)
 
         def f(x):
             return _softmax_xent2(x, lab).sum()

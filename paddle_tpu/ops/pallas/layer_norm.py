@@ -18,13 +18,17 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _block_rows(d):
-    # keep the (BR, D) block well under VMEM: the bwd kernel holds 3 such
-    # blocks double-buffered, so 512K f32 elements (2MB) each stays under
-    # the ~16MB scoped-VMEM limit even in full fp32
-    target = 1 << 19
+def _block_rows(d, target=1 << 19):
     br = max(8, min(1024, target // max(d, 1)))
     return int(8 * max(1, br // 8))
+
+
+# The bwd kernel holds 3 (BR, D) blocks double-buffered PLUS ~4 f32
+# stack temporaries (x, g, xhat, dxhat); at 512K-element blocks that
+# sits right at the 16MB scoped-VMEM edge — bf16 inputs fit, f32 inputs
+# blew it on hardware at (8192, 768). 256K-element blocks (1MB f32)
+# keep the worst case near ~10MB.
+_BWD_TARGET = 1 << 18
 
 
 def _fwd_kernel(x_ref, w_ref, b_ref, o_ref, mu_ref, rstd_ref, *, eps, d):
@@ -113,7 +117,7 @@ def _ln_bwd(eps, res, g):
     from . import interpret_mode
     x2, w, mu, rstd = res
     n, d = x2.shape
-    br = _block_rows(d)
+    br = _block_rows(d, _BWD_TARGET)
     nblocks = pl.cdiv(n, br)
     dx, dw_part, db_part = pl.pallas_call(
         functools.partial(_bwd_kernel, d=d, n=n, br=br),

@@ -22,11 +22,16 @@ def _block_rows(v):
     return int(8 * max(1, br // 8))
 
 
-def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, v, eps):
+def _fwd_kernel(logits_ref, labels_ref, loss_ref, lse_ref, *, v, eps):
     """eps>0 = uniform label smoothing folded into the same pass
     (reference: label_smooth + the soft path of
     softmax_with_cross_entropy_op, without materializing the (N, V)
-    smoothed one-hot): loss = lse − (1−eps)·picked − (eps/V)·Σx."""
+    smoothed one-hot): loss = lse − (1−eps)·picked − (eps/V)·Σx.
+
+    Also emits the per-row lse as a residual: with it, the backward pass
+    is purely elementwise (p = exp(x − lse)), so it tiles over BOTH rows
+    and vocab instead of holding whole 30k-wide rows in VMEM (which blew
+    the 16MB scoped-VMEM limit at BERT shapes)."""
     x = logits_ref[:].astype(jnp.float32)
     m = jnp.max(x, axis=1, keepdims=True)
     e = jnp.exp(x - m)
@@ -40,66 +45,88 @@ def _fwd_kernel(logits_ref, labels_ref, loss_ref, *, v, eps):
                        (eps / v) * jnp.sum(x, axis=1, keepdims=True))
     else:
         loss_ref[:] = (lse - picked)
+    lse_ref[:] = lse
 
 
-def _bwd_kernel(logits_ref, labels_ref, g_ref, dx_ref, *, v, eps):
+def _bwd_kernel(logits_ref, labels_ref, lse_ref, g_ref, dx_ref, *, v, eps,
+                bv):
+    """Elementwise given the forward's lse: dx = (exp(x−lse) − target)·g.
+    Grid is (row-blocks, vocab-blocks); each block sees only a (br, bv)
+    logits tile, so VMEM stays bounded for any vocab size."""
+    j = pl.program_id(1)
     x = logits_ref[:].astype(jnp.float32)
-    m = jnp.max(x, axis=1, keepdims=True)
-    e = jnp.exp(x - m)
-    p = e / jnp.sum(e, axis=1, keepdims=True)
-    labels = labels_ref[:]
-    cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
-    onehot = (cols == labels).astype(jnp.float32)
+    p = jnp.exp(x - lse_ref[:])
+    cols = j * bv + jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = (cols == labels_ref[:]).astype(jnp.float32)
     if eps:
         target = (1.0 - eps) * onehot + (eps / v)
     else:
         target = onehot
-    dx_ref[:] = ((p - target) * g_ref[:]).astype(dx_ref.dtype)
+    valid = (cols < v).astype(jnp.float32)  # vocab-tail padding → 0
+    dx_ref[:] = ((p - target) * g_ref[:] * valid).astype(dx_ref.dtype)
 
 
-def _run(kernel, logits2, labels2, eps, extra=None, out_shape=None):
+def _run_fwd(logits2, labels2, eps):
     from . import interpret_mode
     n, v = logits2.shape
     br = _block_rows(v)
     grid = (pl.cdiv(n, br),)
-    in_specs = [
-        pl.BlockSpec((br, v), lambda i: (i, 0), memory_space=pltpu.VMEM),
-        pl.BlockSpec((br, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
-    ]
-    args = [logits2, labels2]
-    if extra is not None:
-        in_specs.append(pl.BlockSpec((br, 1), lambda i: (i, 0),
-                                     memory_space=pltpu.VMEM))
-        args.append(extra)
-    wide = out_shape[1] == v
+    narrow = pl.BlockSpec((br, 1), lambda i: (i, 0),
+                          memory_space=pltpu.VMEM)
     return pl.pallas_call(
-        functools.partial(kernel, v=v, eps=eps),
+        functools.partial(_fwd_kernel, v=v, eps=eps),
         grid=grid,
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((br, v) if wide else (br, 1),
-                               lambda i: (i, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(
-            out_shape, logits2.dtype if wide else jnp.float32),
+        in_specs=[
+            pl.BlockSpec((br, v), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            narrow,
+        ],
+        out_specs=(narrow, narrow),
+        out_shape=(jax.ShapeDtypeStruct((n, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((n, 1), jnp.float32)),
         interpret=interpret_mode(),
-    )(*args)
+    )(logits2, labels2)
+
+
+def _run_bwd(logits2, labels2, lse, g, eps):
+    from . import interpret_mode
+    n, v = logits2.shape
+    bv = min(v, 2048)
+    # 128×2048 f32 = 1MB tiles: in+out double-buffered plus ~4 stack
+    # temps ≈ 8MB — half the scoped-VMEM limit (the 2MB-tile variant
+    # also passed on hardware, but with zero headroom)
+    br = max(8, min(128, _block_rows(bv)))
+    grid = (pl.cdiv(n, br), pl.cdiv(v, bv))
+    narrow = pl.BlockSpec((br, 1), lambda i, j: (i, 0),
+                          memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_bwd_kernel, v=v, eps=eps, bv=bv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((br, bv), lambda i, j: (i, j),
+                         memory_space=pltpu.VMEM),
+            narrow, narrow, narrow,
+        ],
+        out_specs=pl.BlockSpec((br, bv), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((n, v), logits2.dtype),
+        interpret=interpret_mode(),
+    )(logits2, labels2, lse, g)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _softmax_xent2(logits2, labels2, eps=0.0):
-    n, v = logits2.shape
-    return _run(_fwd_kernel, logits2, labels2, eps, out_shape=(n, 1))
+    return _run_fwd(logits2, labels2, eps)[0]
 
 
 def _fwd(logits2, labels2, eps):
-    loss = _softmax_xent2(logits2, labels2, eps)
-    return loss, (logits2, labels2)
+    loss, lse = _run_fwd(logits2, labels2, eps)
+    return loss, (logits2, labels2, lse)
 
 
 def _bwd(eps, res, g):
-    logits2, labels2 = res
-    n, v = logits2.shape
-    dx = _run(_bwd_kernel, logits2, labels2, eps,
-              extra=g.astype(jnp.float32), out_shape=(n, v))
+    logits2, labels2, lse = res
+    dx = _run_bwd(logits2, labels2, lse, g.astype(jnp.float32), eps)
     return dx, None
 
 

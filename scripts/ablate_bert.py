@@ -10,7 +10,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def bench(batch, seq, flash, pallas_ln, fused_adam, steps=16, inner=4):
+def bench(batch, seq, flash, pallas_ln, fused_adam, xent, steps=16,
+          inner=4):
     """`inner` real optimizer steps per compiled call (same amortization
     as bench.py): the tunnel's 30-45 ms per-dispatch overhead would
     otherwise drown the per-kernel deltas this ablation exists to
@@ -22,7 +23,7 @@ def bench(batch, seq, flash, pallas_ln, fused_adam, steps=16, inner=4):
 
     pt.seed(0)
     P.configure(flash_attention=flash, layer_norm=pallas_ln,
-                fused_adam=fused_adam)
+                fused_adam=fused_adam, softmax_xent=xent)
     cfg = BertConfig.base(use_flash_attention=flash)
     model = BertForPretraining(cfg)
     o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
@@ -67,27 +68,30 @@ def bench(batch, seq, flash, pallas_ln, fused_adam, steps=16, inner=4):
 
 
 CONFIGS = [
-    # (batch, flash, pallas_ln, fused_adam)
-    (32, 0, 0, 0),
-    (32, 1, 0, 0),
-    (32, 0, 1, 0),
-    (32, 0, 0, 1),
-    (32, 1, 1, 1),
-    (64, 0, 0, 0),
-    (64, 1, 1, 1),
+    # (batch, flash, pallas_ln, fused_adam, softmax_xent)
+    (32, 0, 0, 0, 0),
+    (32, 1, 0, 0, 0),
+    (32, 0, 1, 0, 0),
+    (32, 0, 0, 1, 0),
+    (32, 0, 0, 0, 1),
+    (32, 1, 1, 1, 1),
+    (64, 0, 0, 0, 0),
+    (64, 1, 1, 1, 1),
 ]
 
 
 def main():
-    for batch, flash, ln, fa in CONFIGS:
+    for batch, flash, ln, fa, xe in CONFIGS:
         try:
-            tps, loss = bench(batch, 128, bool(flash), bool(ln), bool(fa))
+            tps, loss = bench(batch, 128, bool(flash), bool(ln),
+                              bool(fa), bool(xe))
             print(f"batch={batch} flash={flash} ln={ln} "
-                  f"adam={fa}: {tps:,.0f} tok/s loss={loss:.4f}",
-                  flush=True)
+                  f"adam={fa} xent={xe}: {tps:,.0f} tok/s "
+                  f"loss={loss:.4f}", flush=True)
         except Exception as e:
             print(f"batch={batch} flash={flash} ln={ln} "
-                  f"adam={fa}: FAIL {type(e).__name__}: {e}", flush=True)
+                  f"adam={fa} xent={xe}: FAIL {type(e).__name__}: {e}",
+                  flush=True)
 
 
 if __name__ == "__main__":
