@@ -34,13 +34,16 @@ def _probe_pallas_kernels():
         return  # kernels default off; interpret-mode probes prove nothing
 
     def flash():
+        # seq 2048 with the production default blocks (512, 1024): the
+        # only bench stage that reaches the flash kernel is the seq-2048
+        # one (the seq gate routes seq 128 to sdpa), so probe THAT shape
         from paddle_tpu.ops.pallas.flash_attention import _flash
-        q = jnp.ones((1, 2, 128, 64), jnp.bfloat16)
+        q = jnp.ones((1, 2, 2048, 64), jnp.bfloat16)
         seed = jnp.zeros((2,), jnp.int32)
 
         def f(q):
             return _flash(q, q, q, None, None, seed, False, None, 512,
-                          512, 0.1).astype(jnp.float32).sum()
+                          1024, 0.1).astype(jnp.float32).sum()
 
         jax.grad(f)(q).block_until_ready()
 
@@ -65,11 +68,11 @@ def _probe_pallas_kernels():
         new_p.block_until_ready()
 
     def softmax_xent():
-        # 4096 rows = the real bench shape (batch 32 × seq 128): the r4
+        # 8192 rows = the real bench shape (batch 64 × seq 128): the r4
         # VMEM blow-up was shape-dependent and a 256-row probe missed it
         from paddle_tpu.ops.pallas.softmax_xent import _softmax_xent2
-        x = jnp.ones((4096, 30522), jnp.float32)
-        lab = jnp.zeros((4096, 1), jnp.int32)
+        x = jnp.ones((8192, 30522), jnp.float32)
+        lab = jnp.zeros((8192, 1), jnp.int32)
 
         def f(x):
             return _softmax_xent2(x, lab).sum()
@@ -88,7 +91,7 @@ def _probe_pallas_kernels():
             P.configure(**{name: False})
 
 
-def bench_bert(batch=32, seq=128, steps=20, inner=4, **cfg_kw):
+def bench_bert(batch=64, seq=128, steps=32, inner=8, **cfg_kw):
     """`inner` REAL optimizer steps (distinct resident batches) run per
     compiled call — one dispatch covers `inner` steps, so the tunnel /
     host-dispatch round-trip amortizes instead of flooring the step

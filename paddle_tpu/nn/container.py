@@ -56,7 +56,7 @@ class LayerList(Layer):
         return list(self._sub_layers.values())[idx]
 
     def __setitem__(self, idx, layer):
-        self._sub_layers[str(idx)] = layer
+        self.add_sublayer(str(idx), layer)
 
     def __len__(self):
         return len(self._sub_layers)
@@ -78,7 +78,7 @@ class LayerList(Layer):
         items.insert(index, layer)
         self._sub_layers.clear()
         for i, l in enumerate(items):
-            self._sub_layers[str(i)] = l
+            self.add_sublayer(str(i), l)
 
 
 class ParameterList(Layer):

@@ -23,18 +23,32 @@ def on_tpu():
     return not interpret_mode()
 
 
-# Per-kernel default overrides: None = auto (on on TPU, off elsewhere).
-# bench.py probes each kernel on the live device and disables just the
-# ones that fail to compile, instead of losing the whole run.
+# Per-kernel default overrides: None = auto. bench.py probes each kernel
+# on the live device and disables just the ones that fail to compile,
+# instead of losing the whole run.
 _overrides = {}
 _KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent")
 
+# Measured auto defaults (v5e, BERT-base ablation, docs/perf_r04.md):
+# layer_norm is the only unconditional win (+0.4%); fused_adam loses
+# 13.6% to XLA's own update fusion (a separate pallas dispatch per param
+# tensor vs one fused backward+update program); softmax_xent loses 1.7%
+# at seq-128 shapes (its value is the O(N·V) HBM saving, opt-in);
+# flash_attention wins only once S^2 scores dominate — seq-gated via
+# _flash_min_seq below. configure(kernel=True/False) still forces any
+# of them either way.
+_AUTO_ON = {"layer_norm": True, "flash_attention": True,
+            "fused_adam": False, "softmax_xent": False}
+
 
 # flash is an O(S^2)-score win: below some sequence length the XLA sdpa
-# (one fused attention) can beat the blocked kernel's overheads — the
-# crossover is measured by scripts/ablate_bert.py and set here (0 = flash
-# whenever enabled)
-_flash_min_seq = 0
+# (one fused attention) beats the blocked kernel's overheads. Measured
+# on v5e (scripts/tune_flash.py + ablate_bert.py, docs/perf_r04.md):
+# seq 128 flash loses 11% full-model; seq 512 is a wash (flash ahead
+# ~5% kernel-only, and O(S) memory tiebreaks); seq 2048 flash wins
+# 1.53x kernel-only. Crossover set at 512; 0 = flash whenever enabled.
+_FLASH_MIN_SEQ_DEFAULT = 512
+_flash_min_seq = _FLASH_MIN_SEQ_DEFAULT
 _UNSET = object()
 
 
@@ -43,17 +57,17 @@ def configure(flash_min_seq=_UNSET, **kernels):
     auto default for named kernels ('layer_norm', 'fused_adam',
     'flash_attention', 'softmax_xent'). None restores auto.
     flash_min_seq=N routes sequences shorter than N to XLA sdpa even
-    with the flash kernel enabled (the ablation-tuned crossover);
-    flash_min_seq=None restores the no-threshold default, matching the
-    kernel knobs' None-resets semantics.
+    with the flash kernel enabled (N=0 disables the gate);
+    flash_min_seq=None restores the measured default crossover,
+    matching the kernel knobs' None-resets semantics.
 
     The flag is read when an op traces, so call configure() BEFORE the
     first jitted step — a step already compiled keeps the kernel choice
     it was traced with."""
     global _flash_min_seq
     if flash_min_seq is not _UNSET:
-        _flash_min_seq = 0 if flash_min_seq is None \
-            else int(flash_min_seq)
+        _flash_min_seq = _FLASH_MIN_SEQ_DEFAULT \
+            if flash_min_seq is None else int(flash_min_seq)
     for k, v in kernels.items():
         if k not in _KERNELS:
             raise ValueError(
@@ -68,7 +82,7 @@ def enabled(kernel, seq_len=None):
     """Effective default for one kernel, honoring configure() overrides
     (and the flash seq-length crossover when seq_len is given)."""
     v = _overrides.get(kernel)
-    on = on_tpu() if v is None else v
+    on = (on_tpu() and _AUTO_ON[kernel]) if v is None else v
     if on and kernel == "flash_attention" and seq_len is not None and \
             seq_len < _flash_min_seq:
         return False

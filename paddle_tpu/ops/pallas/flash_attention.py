@@ -539,8 +539,8 @@ _flash.defvjp(_fwd, _bwd)
 
 
 def flash_attention(q, k, v, attn_mask=None, causal=False, scale=None,
-                    block_q=512, block_k=512, dropout_p=0.0, training=False,
-                    force=False, name=None):
+                    block_q=512, block_k=1024, dropout_p=0.0,
+                    training=False, force=False, name=None):
     """Framework op: flash attention over (B, H, S, D). The additive (or
     bool) attn_mask and attention-probability dropout are fused into the
     kernels; mask shapes the kernel can't tile (non-broadcastable to

@@ -1,10 +1,13 @@
 """Fused softmax + cross-entropy Pallas kernel (reference: the fused CUDA
 softmax_with_cross_entropy_op.cu).
 
-One VMEM pass per row-block: row max, exp-sum, and the picked logit produce
-the loss directly — the [N, V] softmax matrix is never materialized in HBM
-on the forward pass. Backward recomputes softmax in-kernel and writes
-(p - onehot) * g, again one pass.
+Forward: one VMEM pass per row-block — row max, exp-sum, and the picked
+logit produce the loss directly; the [N, V] softmax matrix is never
+materialized in HBM. The per-row lse is saved as a residual, which makes
+the backward purely elementwise (dx = (exp(x − lse) − target)·g): it
+tiles over BOTH rows and vocab, so no kernel ever holds a full-width row
+block in VMEM (the full-width variant blew the 16MB scoped-VMEM limit at
+BERT shapes).
 """
 from __future__ import annotations
 

@@ -162,9 +162,9 @@ class QuantedConv2D(_QuantedBase):
 def _wrap(layer, config):
     for name, child in list(layer._sub_layers.items()):
         if isinstance(child, nn.Linear):
-            layer._sub_layers[name] = QuantedLinear(child, config)
+            layer.add_sublayer(name, QuantedLinear(child, config))
         elif isinstance(child, nn.Conv2D):
-            layer._sub_layers[name] = QuantedConv2D(child, config)
+            layer.add_sublayer(name, QuantedConv2D(child, config))
         else:
             _wrap(child, config)
     return layer
@@ -339,17 +339,17 @@ def convert(model, bits=8):
     def _conv(layer):
         for name, child in list(layer._sub_layers.items()):
             if isinstance(child, QuantedLinear):
-                layer._sub_layers[name] = QuantizedLinear(
+                layer.add_sublayer(name, QuantizedLinear(
                     child.inner, bits, act_scale=child.act_scale,
-                    act_bits=child._cfg.activation_bits)
+                    act_bits=child._cfg.activation_bits))
             elif isinstance(child, QuantedConv2D):
-                layer._sub_layers[name] = QuantizedConv2D(
+                layer.add_sublayer(name, QuantizedConv2D(
                     child.inner, bits, act_scale=child.act_scale,
-                    act_bits=child._cfg.activation_bits)
+                    act_bits=child._cfg.activation_bits))
             elif isinstance(child, nn.Linear):
-                layer._sub_layers[name] = QuantizedLinear(child, bits)
+                layer.add_sublayer(name, QuantizedLinear(child, bits))
             elif isinstance(child, nn.Conv2D):
-                layer._sub_layers[name] = QuantizedConv2D(child, bits)
+                layer.add_sublayer(name, QuantizedConv2D(child, bits))
             else:
                 _conv(child)
         return layer

@@ -402,7 +402,11 @@ def test_pallas_configure_overrides():
         np.testing.assert_allclose(out_forced, out_xla, atol=1e-5)
     finally:
         P.configure(layer_norm=None, fused_adam=None)
-        assert P.enabled("fused_adam") == P.on_tpu()
+        # None restores the measured auto defaults: layer_norm is
+        # auto-on on TPU, fused_adam auto-off everywhere (it loses to
+        # XLA's own update fusion — docs/perf_r04.md)
+        assert P.enabled("layer_norm") == P.on_tpu()
+        assert P.enabled("fused_adam") is False
 
 
 def test_softmax_xent_gated_in_loss_op():
