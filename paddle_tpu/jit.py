@@ -139,16 +139,18 @@ class StaticFunction:
         ResNet-50) only matters when structure actually changed. Cache
         validity = the global Layer structure version + per-optimizer
         accumulator-slot counts (slots are created lazily on first
-        step)."""
+        step) + each param's stop_gradient flag (unfreezing must force
+        a re-collect so _ensure_all_slots builds the new slots)."""
         from .nn.layer import struct_version
 
-        def vkey():
+        def vkey(params):
             return (struct_version(),
                     tuple(sum(len(s) for s in o._accumulators.values())
-                          for o in optimizers))
+                          for o in optimizers),
+                    tuple(p.stop_gradient for p in params))
 
         if self._state_cache is not None and self._state_cache[0] == \
-                vkey():
+                vkey(self._state_cache[3]):
             return self._state_cache[1], self._state_cache[2], \
                 self._state_cache[3]
         holders = _collect_state(models, optimizers, scalers)
@@ -156,7 +158,8 @@ class StaticFunction:
         all_params = [p for m in models for p in m.parameters()]
         # _ensure_all_slots() inside _collect_state may have created
         # slots — snapshot the validity key AFTER collection
-        self._state_cache = (vkey(), holders, state_names, all_params)
+        self._state_cache = (vkey(all_params), holders, state_names,
+                             all_params)
         return holders, state_names, all_params
 
     def __call__(self, *args, **kwargs):

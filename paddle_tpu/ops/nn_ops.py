@@ -449,9 +449,25 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             shape = (1,) * (x.ndim - 1) + (-1,)
         if training:
             # stats in f32 (bf16 accumulation over N*H*W loses precision),
-            # running stats stay in the buffer dtype
-            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            # running stats stay in the buffer dtype. One-pass moments so
+            # XLA's multi-output fusion reads x ONCE for both — jnp.mean
+            # + jnp.var is two sequential passes over the activation (the
+            # HBM-bound cost that dominates ResNet steps). Raw
+            # E[x^2]-E[x]^2 cancels catastrophically for large-mean
+            # inputs, so shift by one per-channel sample first (variance
+            # is shift-invariant, and d var/d c == 0 exactly, so the
+            # stop_gradient is mathematically free): both accumulators
+            # then stay O(sigma^2)-scaled.
+            xf = x.astype(jnp.float32)
+            n = np.prod([x.shape[a] for a in axes])
+            c = lax.stop_gradient(xf[tuple(
+                slice(0, 1) if a in axes else slice(None)
+                for a in range(x.ndim))])
+            xs = xf - c
+            m_s = jnp.sum(xs, axis=axes) / n
+            mean = m_s + jnp.squeeze(c, axis=axes)
+            var = jnp.maximum(jnp.sum(jnp.square(xs), axis=axes) / n -
+                              jnp.square(m_s), 0.0)
             new_rm = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
             new_rv = momentum * rv + (1 - momentum) * var.astype(rv.dtype)
         else:

@@ -22,8 +22,11 @@ def bench(batch, seq, flash, pallas_ln, fused_adam, xent, steps=16,
     from paddle_tpu.ops import pallas as P
 
     pt.seed(0)
+    # flash_min_seq=0: the ablation exists to measure BOTH sides of the
+    # crossover, so the seq gate must not silently reroute flash=1 rows
+    # to sdpa at seq 128
     P.configure(flash_attention=flash, layer_norm=pallas_ln,
-                fused_adam=fused_adam, softmax_xent=xent)
+                fused_adam=fused_adam, softmax_xent=xent, flash_min_seq=0)
     cfg = BertConfig.base(use_flash_attention=flash)
     model = BertForPretraining(cfg)
     o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())

@@ -229,3 +229,37 @@ def test_bert_recompute_matches_plain():
     f3 = mk(m3)
     c = [float(f3(*args).numpy()) for _ in range(3)]
     assert c[-1] < c[0]
+
+
+def test_state_cache_sees_unfreeze():
+    """Unfreezing a parameter AFTER a compiled step must invalidate the
+    cached state map (stop_gradient is part of the validity key): the
+    optimizer lazily creates slots for newly-trainable params inside
+    _collect_state, so a stale cache would silently never train them."""
+    import numpy as np
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer as opt, jit
+
+    pt.seed(0)
+    m = nn.Linear(4, 4)
+    m.weight.stop_gradient = True
+    o = opt.Adam(learning_rate=0.1, parameters=m.parameters())
+    x = pt.to_tensor(np.random.RandomState(0).randn(8, 4).astype("f4"))
+
+    def step(x):
+        loss = (m(x) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return loss
+
+    fn = jit.to_static(step, models=[m], optimizers=[o])
+    fn(x)
+    frozen = m.weight.numpy().copy()
+    fn(x)
+    np.testing.assert_array_equal(frozen, m.weight.numpy())
+
+    m.weight.stop_gradient = False
+    fn(x)
+    assert not np.allclose(frozen, m.weight.numpy()), \
+        "unfrozen weight never trained: stale jit state cache"

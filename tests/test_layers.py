@@ -225,3 +225,17 @@ def test_hsigmoid_trains_class_apart():
     true_l = hs(pt.to_tensor(x), pt.to_tensor(lab)).numpy().mean()
     wrong = hs(pt.to_tensor(x), pt.to_tensor(3 - lab)).numpy().mean()
     assert true_l < wrong
+
+
+def test_batch_norm_large_mean_numerics():
+    """One-pass BN moments must not cancel catastrophically on
+    large-mean inputs (raw E[x^2]-E[x]^2 in f32 loses the entire
+    variance at mean ~1e3, std ~1; the sample-shifted form keeps it)."""
+    rng = np.random.RandomState(0)
+    x = (rng.randn(64, 8).astype("f4") + 1000.0)
+    bn = nn.BatchNorm1D(8)
+    bn.train()
+    out = bn(pt.to_tensor(x)).numpy()
+    # normalized output of a ~N(1000, 1) batch must be ~N(0, 1)
+    assert abs(out.mean()) < 0.1
+    assert 0.8 < out.std() < 1.2, f"BN variance cancelled: std={out.std()}"
