@@ -487,6 +487,26 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             shift.astype(x.dtype).reshape(shape)
         return out, new_rm, new_rv
 
+    from . import pallas as P
+    chan_last = not (data_format in ("NCHW", "NCL", "NCDHW") and
+                     getattr(x, "ndim", 2) > 2)
+    if training and weight is not None and chan_last and \
+            P.enabled("batch_norm"):
+        # fused Pallas path (channels-last only — a transpose around the
+        # kernel would cost the pass it saves); running stats fold on top
+        # of the kernel's (out, mean, var)
+        from .pallas.batch_norm import bn_channels_last
+
+        def impl_pl(x, rm, rv, w, b):
+            out2, mean, var = bn_channels_last(x, w, b, epsilon)
+            new_rm = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
+            new_rv = momentum * rv + (1 - momentum) * var.astype(rv.dtype)
+            return out2, new_rm, new_rv
+
+        return apply(impl_pl,
+                     (x, running_mean, running_var, weight, bias),
+                     n_out=3, name="pallas_batch_norm")
+
     args = (x, running_mean, running_var)
     if weight is not None:
         args = args + (weight, bias)

@@ -27,7 +27,8 @@ def on_tpu():
 # on the live device and disables just the ones that fail to compile,
 # instead of losing the whole run.
 _overrides = {}
-_KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent")
+_KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent",
+            "batch_norm")
 
 # Measured auto defaults (v5e, BERT-base ablation, docs/perf_r04.md):
 # layer_norm is the only unconditional win (+0.4%); fused_adam loses
@@ -37,8 +38,12 @@ _KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent")
 # flash_attention wins only once S^2 scores dominate — seq-gated via
 # _flash_min_seq below. configure(kernel=True/False) still forces any
 # of them either way.
+# batch_norm: built to attack the ResNet trace's BN-bound 70% (see
+# docs/perf_r04.md), auto-off until scripts/bench_pallas_bn.py proves it
+# beats the (already once-fixed) XLA schedule on the chip.
 _AUTO_ON = {"layer_norm": True, "flash_attention": True,
-            "fused_adam": False, "softmax_xent": False}
+            "fused_adam": False, "softmax_xent": False,
+            "batch_norm": False}
 
 
 # flash is an O(S^2)-score win: below some sequence length the XLA sdpa
@@ -55,7 +60,8 @@ _UNSET = object()
 def configure(flash_min_seq=_UNSET, **kernels):
     """configure(layer_norm=False, fused_adam=None, ...) — override the
     auto default for named kernels ('layer_norm', 'fused_adam',
-    'flash_attention', 'softmax_xent'). None restores auto.
+    'flash_attention', 'softmax_xent', 'batch_norm'). None restores
+    auto.
     flash_min_seq=N routes sequences shorter than N to XLA sdpa even
     with the flash kernel enabled (N=0 disables the gate);
     flash_min_seq=None restores the measured default crossover,
@@ -93,8 +99,10 @@ from . import layer_norm as layer_norm_mod
 from . import softmax_xent as softmax_xent_mod
 from . import flash_attention as flash_attention_mod
 from . import fused_adam as fused_adam_mod
+from . import batch_norm as batch_norm_mod
 
 from .layer_norm import layer_norm
 from .softmax_xent import softmax_cross_entropy
 from .flash_attention import flash_attention
 from .fused_adam import fused_adam_update
+from .batch_norm import fused_batch_norm_train

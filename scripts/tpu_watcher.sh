@@ -25,6 +25,7 @@ while true; do
             echo "$(date -Is) running battery" >> "$OUT/status.log"
             python bench.py > "$OUT/bench.log" 2>&1
             python scripts/bench_int8.py > "$OUT/int8.log" 2>&1
+            python -u scripts/bench_pallas_bn.py > "$OUT/pallas_bn.log" 2>&1
             ran_battery=1
             echo "$(date -Is) battery done" >> "$OUT/status.log"
         fi

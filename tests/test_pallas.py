@@ -567,3 +567,96 @@ def test_flash_min_seq_gate():
                                    atol=1e-6)
     finally:
         P.configure(flash_attention=None, flash_min_seq=None)
+
+
+def test_fused_batch_norm_parity_and_grads():
+    """Pallas fused BN (interpret mode) vs the XLA batch_norm path:
+    forward, batch stats, running-stat update, and grads w.r.t.
+    x/weight/bias must match. M=200 deliberately not a multiple of the
+    row block so the masked tail is exercised."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.batch_norm import _batch_norm2
+
+    rng = np.random.RandomState(0)
+    m, c = 200, 24
+    x = jnp.asarray(rng.randn(m, c).astype("f4") * 2 + 3)
+    w = jnp.asarray(rng.rand(c).astype("f4") + 0.5)
+    b = jnp.asarray(rng.randn(c).astype("f4"))
+    g = jnp.asarray(rng.randn(m, c).astype("f4"))
+
+    def ref(x, w, b, eps=1e-5):
+        mean = jnp.mean(x, axis=0)
+        var = jnp.var(x, axis=0)
+        inv = jax.lax.rsqrt(var + eps)
+        return (x - mean) * inv * w + b, mean, var
+
+    out, mean, var = _batch_norm2(x, w, b, 1e-5)
+    r_out, r_mean, r_var = ref(x, w, b)
+    np.testing.assert_allclose(out, r_out, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(mean).ravel(), r_mean, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(var).ravel(), r_var, atol=2e-3)
+
+    # grads through out only (the usual training path)
+    g1 = jax.grad(lambda *a: jnp.sum(_batch_norm2(*a, 1e-5)[0] * g),
+                  argnums=(0, 1, 2))(x, w, b)
+    g2 = jax.grad(lambda *a: jnp.sum(ref(*a)[0] * g),
+                  argnums=(0, 1, 2))(x, w, b)
+    for a, r in zip(g1, g2):
+        np.testing.assert_allclose(a, r, atol=3e-4)
+
+    # grads through the DIRECT mean/var outputs stay exact too
+    gm = jnp.asarray(rng.randn(c).astype("f4"))
+    gv = jnp.asarray(rng.randn(c).astype("f4"))
+
+    def take_stats(f):
+        def inner(x):
+            _, mean, var = f(x, w, b) if f is not _batch_norm2 else \
+                f(x, w, b, 1e-5)
+            return jnp.sum(mean * gm) + jnp.sum(var * gv)
+        return inner
+
+    ga = jax.grad(take_stats(_batch_norm2))(x)
+    gr = jax.grad(take_stats(ref))(x)
+    np.testing.assert_allclose(ga, gr, atol=3e-4)
+
+    # large-mean regime: the sample-shifted accumulators must keep the
+    # variance (raw E[x^2]-E[x]^2 loses it entirely at mean ~1e3)
+    xl = jnp.asarray(rng.randn(m, c).astype("f4") + 1000.0)
+    out_l, _, var_l = _batch_norm2(xl, w, b, 1e-5)
+    np.testing.assert_allclose(np.asarray(var_l).ravel(),
+                               jnp.var(xl, axis=0), rtol=0.05)
+    assert abs(float(jnp.mean((out_l - b) / w))) < 0.1
+    assert 0.8 < float(jnp.std((out_l - b) / w)) < 1.2
+
+
+def test_fused_batch_norm_gated_in_layer():
+    """configure(batch_norm=True) routes a channels-last BatchNorm1D
+    through the Pallas kernel; training numerics (incl. running-stat
+    carry) must match the XLA path, and NCHW inputs must keep the XLA
+    path (no silent transpose)."""
+    from paddle_tpu.ops import pallas as P
+    from paddle_tpu import nn
+
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 12).astype("f4")
+
+    def run(use):
+        import paddle_tpu as pt
+        pt.seed(0)
+        P.configure(batch_norm=use)
+        try:
+            bn = nn.BatchNorm1D(12, data_format="NLC")
+            bn.train()
+            out = bn(pt.to_tensor(x))
+            loss = (out ** 2).mean()
+            loss.backward()
+            return (out.numpy(), bn._mean.numpy(), bn._variance.numpy(),
+                    np.asarray(bn.weight.grad))
+        finally:
+            P.configure(batch_norm=None)
+
+    o1 = run(True)
+    o2 = run(False)
+    for a, b_ in zip(o1, o2):
+        np.testing.assert_allclose(a, b_, atol=3e-4)
