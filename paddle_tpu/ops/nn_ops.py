@@ -488,6 +488,11 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         return out, new_rm, new_rv
 
     from . import pallas as P
+    if weight is not None and bias is None:
+        # bias_attr=False layers: affine with weight only — substitute
+        # zeros so both branches below keep their two-or-none contract
+        w_arr = as_tensor(weight).data
+        bias = jnp.zeros(w_arr.shape, w_arr.dtype)
     chan_last = not (data_format in ("NCHW", "NCL", "NCDHW") and
                      getattr(x, "ndim", 2) > 2)
     if training and weight is not None and chan_last and \

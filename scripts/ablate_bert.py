@@ -95,6 +95,17 @@ def main():
             print(f"batch={batch} flash={flash} ln={ln} "
                   f"adam={fa} xent={xe}: FAIL {type(e).__name__}: {e}",
                   flush=True)
+    # full-model check of the flash_min_seq=512 crossover (the sweep's
+    # kernel-only verdict at 512 was a wash; this decides it in situ)
+    for flash in (0, 1):
+        try:
+            tps, _ = bench(16, 512, bool(flash), True, False, False,
+                           steps=8, inner=2)
+            print(f"seq=512 batch=16 flash={flash}: {tps:,.0f} tok/s",
+                  flush=True)
+        except Exception as e:
+            print(f"seq=512 batch=16 flash={flash}: FAIL "
+                  f"{type(e).__name__}: {e}", flush=True)
 
 
 if __name__ == "__main__":

@@ -70,6 +70,17 @@ def full_resnet(use_pallas, batch=128, inner=8):
     from paddle_tpu.ops import pallas as P
 
     P.configure(batch_norm=use_pallas)
+    try:
+        return _full_resnet_body(batch, inner)
+    finally:
+        P.configure(batch_norm=None)
+
+
+def _full_resnet_body(batch, inner):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer as opt, jit, amp
+    from paddle_tpu.models.resnet import resnet50
+
     pt.seed(0)
     model = resnet50(data_format="NHWC")
     o = opt.Momentum(learning_rate=0.1, momentum=0.9,
@@ -102,7 +113,6 @@ def full_resnet(use_pallas, batch=128, inner=8):
         loss = fn(tx, ty)
     loss.numpy()
     dt = (time.perf_counter() - t0) / (2 * inner)
-    P.configure(batch_norm=None)
     return batch / dt, float(loss.numpy())
 
 

@@ -10,7 +10,7 @@ mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
 
 probe() {
-    timeout 240 python -c "
+    timeout -k 10 240 python -c "
 import jax, jax.numpy as jnp
 jnp.zeros((8,), jnp.float32).block_until_ready()
 print('PROBE_OK', jax.devices()[0].platform)

@@ -239,3 +239,27 @@ def test_batch_norm_large_mean_numerics():
     # normalized output of a ~N(1000, 1) batch must be ~N(0, 1)
     assert abs(out.mean()) < 0.1
     assert 0.8 < out.std() < 1.2, f"BN variance cancelled: std={out.std()}"
+
+
+def test_batch_norm_no_bias():
+    """bias_attr=False BN (weight-only affine) must work in training on
+    both the XLA and Pallas paths (zeros substituted for the bias)."""
+    from paddle_tpu.ops import pallas as P
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(16, 6).astype("f4")
+    for use in (False, True):
+        P.configure(batch_norm=use)
+        try:
+            pt.seed(0)
+            bn = nn.BatchNorm1D(6, bias_attr=False, data_format="NLC")
+            bn.train()
+            out = bn(pt.to_tensor(x))
+            loss = (out ** 2).mean()
+            loss.backward()
+            assert bn.bias is None
+            assert bn.weight.grad is not None
+            np.testing.assert_allclose(out.numpy().mean(axis=0), 0.0,
+                                       atol=1e-4)
+        finally:
+            P.configure(batch_norm=None)
