@@ -434,6 +434,26 @@ def pool2d(x, pool_size=-1, pool_type="max", pool_stride=1, pool_padding=0,
 # normalization (reference: batch_norm_op.cc, layer_norm_op.cu fused kernel,
 # group_norm_op, instance_norm_op)
 
+def _one_pass_moments(x, axes, keepdims=False):
+    """(mean, var) over `axes` reading x ONCE: sum and sum-of-squares
+    land in the same XLA multi-output fusion, vs jnp.mean + jnp.var's
+    two sequential passes (the HBM-bound cost that dominates norm-heavy
+    conv nets). Accumulates in f32, shifted by a stop_gradient sample
+    (variance is shift-invariant) so large-mean inputs don't cancel."""
+    xf = x.astype(jnp.float32)
+    n = np.prod([x.shape[a] for a in axes])
+    c = lax.stop_gradient(xf[tuple(
+        slice(0, 1) if a in axes else slice(None)
+        for a in range(x.ndim))])
+    xs = xf - c
+    m_s = jnp.sum(xs, axis=axes, keepdims=keepdims) / n
+    mean = m_s + (c if keepdims else jnp.squeeze(c, axis=axes))
+    var = jnp.maximum(
+        jnp.sum(jnp.square(xs), axis=axes, keepdims=keepdims) / n -
+        jnp.square(m_s), 0.0)
+    return mean, var
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
                data_format="NCHW", name=None):
@@ -448,26 +468,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             axes = tuple(range(x.ndim - 1))
             shape = (1,) * (x.ndim - 1) + (-1,)
         if training:
-            # stats in f32 (bf16 accumulation over N*H*W loses precision),
-            # running stats stay in the buffer dtype. One-pass moments so
-            # XLA's multi-output fusion reads x ONCE for both — jnp.mean
-            # + jnp.var is two sequential passes over the activation (the
-            # HBM-bound cost that dominates ResNet steps). Raw
-            # E[x^2]-E[x]^2 cancels catastrophically for large-mean
-            # inputs, so shift by one per-channel sample first (variance
-            # is shift-invariant, and d var/d c == 0 exactly, so the
-            # stop_gradient is mathematically free): both accumulators
-            # then stay O(sigma^2)-scaled.
-            xf = x.astype(jnp.float32)
-            n = np.prod([x.shape[a] for a in axes])
-            c = lax.stop_gradient(xf[tuple(
-                slice(0, 1) if a in axes else slice(None)
-                for a in range(x.ndim))])
-            xs = xf - c
-            m_s = jnp.sum(xs, axis=axes) / n
-            mean = m_s + jnp.squeeze(c, axis=axes)
-            var = jnp.maximum(jnp.sum(jnp.square(xs), axis=axes) / n -
-                              jnp.square(m_s), 0.0)
+            # batch stats in f32 via the shared one-pass moments (see
+            # _one_pass_moments: single read, cancellation-guarded);
+            # running stats stay in the buffer dtype
+            mean, var = _one_pass_moments(x, axes)
             new_rm = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
             new_rv = momentum * rv + (1 - momentum) * var.astype(rv.dtype)
         else:
@@ -493,6 +497,11 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         # zeros so both branches below keep their two-or-none contract
         w_arr = as_tensor(weight).data
         bias = jnp.zeros(w_arr.shape, w_arr.dtype)
+    elif weight is None and bias is not None:
+        # weight_attr=False: the symmetric case — ones for the scale,
+        # else the real bias parameter would be silently dropped
+        b_arr = as_tensor(bias).data
+        weight = jnp.ones(b_arr.shape, b_arr.dtype)
     chan_last = not (data_format in ("NCHW", "NCL", "NCDHW") and
                      getattr(x, "ndim", 2) > 2)
     if training and weight is not None and chan_last and \
@@ -554,9 +563,9 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
         sp = x.shape[2:]
         xg = x.reshape(n, num_groups, c // num_groups, *sp)
         axes = tuple(range(2, xg.ndim))
-        mean = jnp.mean(xg, axis=axes, keepdims=True)
-        var = jnp.var(xg, axis=axes, keepdims=True)
-        out = ((xg - mean) * lax.rsqrt(var + epsilon)).reshape(x.shape)
+        mean, var = _one_pass_moments(xg, axes, keepdims=True)
+        out = ((xg - mean) * lax.rsqrt(var + epsilon)).astype(
+            x.dtype).reshape(x.shape)
         if wb:
             w, b = wb
             shape = (1, c) + (1,) * len(sp)
@@ -572,9 +581,8 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
 def instance_norm(x, weight=None, bias=None, epsilon=1e-5, name=None):
     def impl(x, *wb, epsilon):
         axes = tuple(range(2, x.ndim))
-        mean = jnp.mean(x, axis=axes, keepdims=True)
-        var = jnp.var(x, axis=axes, keepdims=True)
-        out = (x - mean) * lax.rsqrt(var + epsilon)
+        mean, var = _one_pass_moments(x, axes, keepdims=True)
+        out = ((x - mean) * lax.rsqrt(var + epsilon)).astype(x.dtype)
         if wb:
             w, b = wb
             shape = (1, -1) + (1,) * (x.ndim - 2)

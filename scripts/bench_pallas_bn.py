@@ -64,9 +64,6 @@ def micro(use_pallas, m=128 * 28 * 28, c=256, iters=12):
 
 
 def full_resnet(use_pallas, batch=128, inner=8):
-    import paddle_tpu as pt
-    from paddle_tpu import optimizer as opt, jit, amp
-    from paddle_tpu.models.resnet import resnet50
     from paddle_tpu.ops import pallas as P
 
     P.configure(batch_norm=use_pallas)

@@ -263,3 +263,25 @@ def test_batch_norm_no_bias():
                                        atol=1e-4)
         finally:
             P.configure(batch_norm=None)
+
+
+def test_batch_norm_no_weight():
+    """weight_attr=False BN: the real bias parameter must still be
+    applied and trained (ones substituted for the scale)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(16, 6).astype("f4")
+    pt.seed(0)
+    bn = nn.BatchNorm1D(6, weight_attr=False, data_format="NLC")
+    bn.train()
+    out = bn(pt.to_tensor(x))
+    loss = ((out - 1.0) ** 2).mean()
+    loss.backward()
+    assert bn.weight is None
+    assert bn.bias.grad is not None
+    # bias starts at 0 so normalized output has ~0 mean, and the bias
+    # actually reaches the output: shift it and the output follows
+    bn2 = nn.BatchNorm1D(6, weight_attr=False, data_format="NLC")
+    bn2.train()
+    bn2.bias.set_value(np.full((6,), 5.0, "f4"))
+    out2 = bn2(pt.to_tensor(x))
+    np.testing.assert_allclose(out2.numpy().mean(axis=0), 5.0, atol=1e-3)
