@@ -67,3 +67,56 @@ def print_stats():
 
 def reset_profiler():
     _records.clear()
+
+
+def summarize_trace(trace_dir, top=20, steps=1):
+    """Aggregate DEVICE op time from a jax.profiler trace directory
+    (the Chrome-format .trace.json.gz jax writes) into per-op-family
+    totals — "where does my step go?" without leaving the terminal.
+
+    Returns a list of (family, total_ms / steps) sorted descending;
+    also prints a table. `steps` divides totals by the number of steps
+    captured inside the trace window. Host-side python frames, jit
+    wrappers and transfer bookkeeping are excluded; op names are
+    grouped by their XLA fusion family (e.g. every `multiply_reduce
+    _fusion.N` variant aggregates into `multiply_reduce_fusion`).
+
+    This is the tool the round-4 ResNet diagnosis used to find batch
+    norm's reduce chains at ~70% of step time while convs ran at peak
+    (docs/perf_r04.md)."""
+    import collections
+    import glob
+    import gzip
+    import json
+    import os
+
+    files = sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not files:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {trace_dir!r} — pass the "
+            "directory given to start_profiler()/jax.profiler.trace")
+    if len(files) > 1:
+        print(f"[summarize_trace] {len(files)} trace files found; "
+              f"reading newest: {files[-1]}")
+    skip = ("$", "jit_", "PjitFunction", "np.asarray", "trace",
+            "ArrayImpl", "ParseArguments", "PythonRefManager",
+            "PJRT_", "copy-start", "slice-start")
+    tot = collections.Counter()
+    with gzip.open(files[-1]) as fh:
+        data = json.load(fh)
+    for e in data.get("traceEvents", []):
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        n = e.get("name", "?")
+        if any(s in n for s in skip) or n.isdigit():
+            continue
+        tot[n.split(".")[0]] += e["dur"]
+    fams = [(name, d / 1e3 / max(steps, 1))
+            for name, d in tot.most_common(top)]
+    total = sum(d for _, d in fams)
+    print(f"{'op family':<44}{'ms/step':>10}")
+    for name, ms in fams:
+        print(f"{name[:43]:<44}{ms:>10.2f}")
+    print(f"{'TOTAL (top ' + str(top) + ')':<44}{total:>10.2f}")
+    return fams

@@ -26,6 +26,8 @@ while true; do
             python bench.py > "$OUT/bench.log" 2>&1
             python scripts/bench_int8.py > "$OUT/int8.log" 2>&1
             python -u scripts/bench_pallas_bn.py > "$OUT/pallas_bn.log" 2>&1
+            python -u scripts/profile_resnet.py > "$OUT/profile_resnet.log" 2>&1
+            python -u scripts/ablate_bert.py > "$OUT/ablate.log" 2>&1
             ran_battery=1
             echo "$(date -Is) battery done" >> "$OUT/status.log"
         fi

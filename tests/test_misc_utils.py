@@ -95,3 +95,30 @@ def test_distributed_batch_reader_shards(monkeypatch):
 
     got = list(distributed_batch_reader(reader)())
     assert got == [1, 3, 5]
+
+
+def test_profiler_summarize_trace(tmp_path):
+    """summarize_trace aggregates device-op families from a Chrome-format
+    trace, excluding host frames and jit wrappers."""
+    import gzip
+    import json
+    from paddle_tpu.utils import profiler
+
+    d = tmp_path / "plugins" / "profile" / "2026"
+    d.mkdir(parents=True)
+    ev = [
+        {"ph": "X", "dur": 4000, "name": "multiply_reduce_fusion.2"},
+        {"ph": "X", "dur": 2000, "name": "multiply_reduce_fusion.7"},
+        {"ph": "X", "dur": 3000, "name": "fusion.1"},
+        {"ph": "X", "dur": 9999, "name": "$jit.py:134 __call__"},
+        {"ph": "X", "dur": 9999, "name": "jit_traced(123)"},
+        {"ph": "X", "dur": 9999, "name": "0"},
+        {"ph": "M", "name": "meta-no-dur"},
+    ]
+    with gzip.open(d / "vm.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": ev}, f)
+    fams = profiler.summarize_trace(str(tmp_path), steps=2)
+    d_ = dict(fams)
+    assert d_["multiply_reduce_fusion"] == 3.0  # (4000+2000)us / 2 steps
+    assert d_["fusion"] == 1.5
+    assert len(fams) == 2
