@@ -48,6 +48,8 @@ from . import inference
 from . import fluid
 from . import reader
 from .reader import batch
+from . import compat
+from . import sysconfig
 from . import distribution
 from . import quantization
 from . import slim

@@ -1,0 +1,31 @@
+"""paddle_tpu.common_ops_import — the op-author import aggregator.
+
+Reference: python/paddle/common_ops_import.py, an internal shim that
+re-exported the names op-definition modules need (LayerHelper,
+ParamAttr, Variable, dygraph-mode checks, ...). Provided for source
+compatibility with reference op code being ported onto this framework.
+
+Intentionally absent (their mechanism has no TPU-side equivalent —
+ops here are jax functions, not OpDesc emissions): OpProtoHolder,
+LayerHelper, _varbase_creator, _dygraph_tracer. Porting guidance:
+where reference op code used LayerHelper.create_variable_for_type_
+inference + append_op, write the computation directly with jax/lax and
+register it via paddle_tpu.dispatch.apply (see docs/porting_guide.md).
+"""
+from functools import reduce  # noqa: F401  (reference used six.moves)
+
+from .fluid.framework import (  # noqa: F401
+    Variable, in_dygraph_mode, default_main_program, device_guard,
+)
+from .fluid.param_attr import ParamAttr  # noqa: F401
+from .initializer import Constant  # noqa: F401
+from . import fluid  # noqa: F401
+from .fluid import layers  # noqa: F401
+from .tensor import convert_dtype as convert_np_dtype_to_dtype_  # noqa: F401
+import numpy as np  # noqa: F401
+
+__all__ = [
+    "reduce", "Variable", "in_dygraph_mode", "default_main_program",
+    "device_guard", "ParamAttr", "Constant", "fluid", "layers",
+    "convert_np_dtype_to_dtype_", "np",
+]

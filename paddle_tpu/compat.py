@@ -1,0 +1,70 @@
+"""paddle_tpu.compat — python 2/3 compatibility helpers.
+
+Reference: python/paddle/compat.py. The reference bridged py2/py3 string
+and arithmetic semantics; on py3-only this reduces to thin, faithful
+implementations of the same API (kept because user code and the fluid
+data pipelines call them).
+"""
+import math
+
+__all__ = [
+    "long_type", "to_text", "to_bytes", "round", "floor_division",
+    "get_exception_message",
+]
+
+long_type = int
+
+
+def _convert(obj, conv, inplace):
+    if obj is None:
+        return obj
+    if isinstance(obj, (list, set)):
+        if inplace:
+            items = [_convert(o, conv, False) for o in obj]
+            obj.clear()
+            if isinstance(obj, list):
+                obj.extend(items)
+            else:
+                obj.update(items)
+            return obj
+        return type(obj)(_convert(o, conv, False) for o in obj)
+    return conv(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Convert str/bytes (or a list/set of them) to text. Reference:
+    compat.to_text."""
+    def conv(o):
+        return o.decode(encoding) if isinstance(o, bytes) else str(o)
+    return _convert(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Convert str/bytes (or a list/set of them) to bytes. Reference:
+    compat.to_bytes."""
+    def conv(o):
+        return o.encode(encoding) if isinstance(o, str) else bytes(o)
+    return _convert(obj, conv, inplace)
+
+
+def round(x, d=0):
+    """Python-2-style round (half away from zero). Reference:
+    compat.round — py3's banker's rounding differs at .5 boundaries."""
+    if x is None:
+        return None
+    p = 10 ** d
+    if x >= 0:
+        return float(math.floor((x * p) + 0.5)) / p
+    return float(math.ceil((x * p) - 0.5)) / p
+
+
+def floor_division(x, y):
+    """reference: compat.floor_division — explicit // for mixed py2/py3
+    call sites."""
+    return x // y
+
+
+def get_exception_message(exc):
+    """reference: compat.get_exception_message."""
+    assert exc is not None
+    return str(exc)

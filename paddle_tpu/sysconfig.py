@@ -1,0 +1,22 @@
+"""paddle_tpu.sysconfig — installation paths.
+
+Reference: python/paddle/sysconfig.py (get_include/get_lib for building
+C++ extensions against the installed package). Here the native surface
+is the csrc host runtime; get_lib points at its build output.
+"""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory containing the package's native headers (csrc)."""
+    return os.path.join(_PKG, "csrc")
+
+
+def get_lib():
+    """Directory containing the built native library (libpaddle_tpu
+    host runtime, built via csrc/Makefile)."""
+    return os.path.join(_PKG, "csrc", "build")

@@ -195,3 +195,29 @@ def test_fluid_incubate_fleet_import_paths():
     assert fleet is ps_fleet  # one singleton, collective-backed
     assert TrainStatus(3) == TrainStatus(3)
     assert callable(CollectiveOptimizer)
+
+
+def test_top_level_module_tail():
+    """compat/sysconfig/common_ops_import exist with the reference
+    semantics (python/paddle/{compat,sysconfig,common_ops_import}.py)."""
+    import os
+    import paddle_tpu
+    from paddle_tpu import compat, sysconfig
+    from paddle_tpu import common_ops_import as coi
+
+    assert compat.to_text(b"ab") == "ab"
+    assert compat.to_text(["a", b"b"]) == ["a", "b"]
+    assert compat.to_bytes("ab") == b"ab"
+    # py2-style half-away-from-zero rounding, not banker's
+    assert compat.round(0.5) == 1.0
+    assert compat.round(-0.5) == -1.0
+    assert compat.round(1.5) == 2.0
+    assert compat.long_type is int
+    assert compat.get_exception_message(ValueError("boom")) == "boom"
+    assert os.path.isdir(sysconfig.get_include())
+    assert isinstance(sysconfig.get_lib(), str)
+    for name in ("Variable", "ParamAttr", "Constant",
+                 "convert_np_dtype_to_dtype_", "in_dygraph_mode"):
+        assert hasattr(coi, name), name
+    assert hasattr(paddle_tpu, "compat")
+    assert hasattr(paddle_tpu, "sysconfig")
