@@ -32,18 +32,25 @@ def _convert(obj, conv, inplace):
 
 
 def to_text(obj, encoding="utf-8", inplace=False):
-    """Convert str/bytes (or a list/set of them) to text. Reference:
-    compat.to_text."""
+    """Convert bytes (or a list/set of mixed values) to text; values
+    that are neither str nor bytes pass through unchanged. Reference:
+    compat._to_text."""
     def conv(o):
-        return o.decode(encoding) if isinstance(o, bytes) else str(o)
+        return o.decode(encoding) if isinstance(o, bytes) else o
     return _convert(obj, conv, inplace)
 
 
 def to_bytes(obj, encoding="utf-8", inplace=False):
-    """Convert str/bytes (or a list/set of them) to bytes. Reference:
-    compat.to_bytes."""
+    """Convert str (or a list/set of them) to bytes; bytes pass
+    through; anything else raises like the reference's six.b path —
+    silently NUL-filling via bytes(int) would corrupt data."""
     def conv(o):
-        return o.encode(encoding) if isinstance(o, str) else bytes(o)
+        if isinstance(o, str):
+            return o.encode(encoding)
+        if isinstance(o, bytes):
+            return o
+        raise TypeError(
+            f"to_bytes expects str/bytes, got {type(o).__name__}")
     return _convert(obj, conv, inplace)
 
 

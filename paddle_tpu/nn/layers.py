@@ -236,20 +236,42 @@ class SyncBatchNorm(BatchNorm):
                 tuple(range(x.ndim - 1))
             shape = ((1, -1) + (1,) * (x.ndim - 2)) if chan_first else \
                 ((1,) * (x.ndim - 1) + (-1,))
-            s = lax.psum(jnp.sum(x, axis=axes), axis_name)
-            sq = lax.psum(jnp.sum(jnp.square(x), axis=axes), axis_name)
+            # shift accumulators by the running mean: it is REPLICATED
+            # state (identical on every dp shard, unlike a local data
+            # sample) so the psum'd moments stay consistent, and once rm
+            # tracks the data mean both accumulators are O(sigma^2) —
+            # the same cancellation guard as _one_pass_moments
+            c = lax.stop_gradient(rm.astype(jnp.float32))
+            xs = x.astype(jnp.float32) - c.reshape(shape)
+            s = lax.psum(jnp.sum(xs, axis=axes), axis_name)
+            sq = lax.psum(jnp.sum(jnp.square(xs), axis=axes), axis_name)
             cnt = lax.psum(jnp.asarray(
                 np.prod([x.shape[a] for a in axes]), jnp.float32), axis_name)
-            mean = s / cnt
-            var = sq / cnt - jnp.square(mean)
-            new_rm = momentum * rm + (1 - momentum) * mean
-            new_rv = momentum * rv + (1 - momentum) * var
-            out = (x - mean.reshape(shape)) * lax.rsqrt(var + eps)
-            out = out * w.reshape(shape) + b.reshape(shape)
+            m_s = s / cnt
+            mean = m_s + c
+            var = jnp.maximum(sq / cnt - jnp.square(m_s), 0.0)
+            new_rm = (momentum * rm + (1 - momentum) * mean).astype(
+                rm.dtype)
+            new_rv = (momentum * rv + (1 - momentum) * var).astype(
+                rv.dtype)
+            # fold into one per-channel scale+shift applied in x's
+            # compute dtype (keeps the elementwise chain bf16 under amp,
+            # matching F.batch_norm's folding)
+            inv = lax.rsqrt(var + eps)
+            scale = inv * w.astype(jnp.float32)
+            shift = b.astype(jnp.float32) - mean * scale
+            out = x * scale.astype(x.dtype).reshape(shape) + \
+                shift.astype(x.dtype).reshape(shape)
             return out, new_rm, new_rv
 
+        # weight_attr/bias_attr=False make the params None — substitute
+        # identity affine (mirrors F.batch_norm's guard)
+        w = self.weight if self.weight is not None else \
+            Tensor(jnp.ones((self._num_features,), jnp.float32))
+        b = self.bias if self.bias is not None else \
+            Tensor(jnp.zeros((self._num_features,), jnp.float32))
         out, new_mean, new_var = _apply(
-            impl, (x, self._mean, self._variance, self.weight, self.bias),
+            impl, (x, self._mean, self._variance, w, b),
             n_out=3, name="sync_batch_norm")
         self._mean.data = new_mean.data
         self._variance.data = new_var.data

@@ -207,7 +207,12 @@ def test_top_level_module_tail():
 
     assert compat.to_text(b"ab") == "ab"
     assert compat.to_text(["a", b"b"]) == ["a", "b"]
+    assert compat.to_text(3.5) == 3.5  # non-string passes through (ref)
     assert compat.to_bytes("ab") == b"ab"
+    assert compat.to_bytes(b"ab") == b"ab"
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        compat.to_bytes(5)  # six.b semantics: no silent NUL-fill
     # py2-style half-away-from-zero rounding, not banker's
     assert compat.round(0.5) == 1.0
     assert compat.round(-0.5) == -1.0

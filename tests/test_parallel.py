@@ -259,3 +259,51 @@ def test_megatron_fused_adam_matches_fallback():
             np.asarray(jax.device_get(s_fused["params"][k])),
             np.asarray(jax.device_get(s_plain["params"][k])),
             atol=2e-5, err_msg=f"param {k}")
+
+
+def test_sync_batch_norm_matches_global_batch():
+    """SyncBatchNorm inside a dp=4 shard_map: per-shard batches of 4
+    normalize with GLOBAL (16-sample) statistics — output and updated
+    running stats must equal ordinary BatchNorm over the full batch on
+    one device. Outside SPMD it degrades to ordinary BN (same layer)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rng = np.random.RandomState(0)
+    x = (rng.randn(16, 6, 4, 4) * 2 + 1).astype("f4")
+
+    # reference: plain BN over the whole batch
+    pt.seed(0)
+    bn_ref = nn.BatchNorm2D(6)
+    bn_ref.train()
+    out_ref = bn_ref(pt.to_tensor(x)).numpy()
+
+    pt.seed(0)
+    sbn = nn.SyncBatchNorm(6, axis_name="dp")
+    sbn.train()
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("dp",))
+
+    def shard_fn(xs):
+        out = sbn(pt.to_tensor(xs))
+        return out.data, sbn._mean.data, sbn._variance.data
+
+    f = jax.jit(jax.shard_map(
+        shard_fn, mesh=mesh,
+        in_specs=P("dp", None, None, None),
+        out_specs=(P("dp", None, None, None), P(None), P(None)),
+        check_vma=False))
+    out, rm, rv = f(x)
+    np.testing.assert_allclose(np.asarray(out), out_ref, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(rm), bn_ref._mean.numpy(),
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(rv), bn_ref._variance.numpy(),
+                               rtol=1e-2, atol=1e-3)
+
+    # outside SPMD: behaves as ordinary BN on the local batch
+    pt.seed(0)
+    sbn2 = nn.SyncBatchNorm(6)
+    sbn2.train()
+    out_local = sbn2(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out_local, out_ref, atol=2e-4)
