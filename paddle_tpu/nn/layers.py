@@ -254,14 +254,7 @@ class SyncBatchNorm(BatchNorm):
                 rm.dtype)
             new_rv = (momentum * rv + (1 - momentum) * var).astype(
                 rv.dtype)
-            # fold into one per-channel scale+shift applied in x's
-            # compute dtype (keeps the elementwise chain bf16 under amp,
-            # matching F.batch_norm's folding)
-            inv = lax.rsqrt(var + eps)
-            scale = inv * w.astype(jnp.float32)
-            shift = b.astype(jnp.float32) - mean * scale
-            out = x * scale.astype(x.dtype).reshape(shape) + \
-                shift.astype(x.dtype).reshape(shape)
+            out = F._fold_scale_shift(x, mean, var, w, b, eps, shape)
             return out, new_rm, new_rv
 
         # weight_attr/bias_attr=False make the params None — substitute

@@ -454,6 +454,23 @@ def _one_pass_moments(x, axes, keepdims=False):
     return mean, var
 
 
+def _fold_scale_shift(x, mean, var, w, b, epsilon, shape):
+    """Fold (mean, var, w, b) into ONE per-channel scale+shift applied
+    in x's compute dtype: under amp the whole elementwise chain (and
+    the residual adds downstream) stays bf16 instead of promoting to
+    f32, halving HBM traffic on the BN→relu→add path. w/b may be None
+    (no-affine). Shared by batch_norm and SyncBatchNorm so the
+    amp-sensitive folding can't drift between the SPMD and local
+    paths."""
+    inv = lax.rsqrt(var.astype(jnp.float32) + epsilon)
+    scale, shift = inv, -mean.astype(jnp.float32) * inv
+    if w is not None:
+        scale = inv * w.astype(jnp.float32)
+        shift = b.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+    return x * scale.astype(x.dtype).reshape(shape) + \
+        shift.astype(x.dtype).reshape(shape)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
                data_format="NCHW", name=None):
@@ -477,18 +494,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         else:
             mean, var = rm, rv
             new_rm, new_rv = rm, rv
-        # fold (mean, var, w, b) into one per-channel scale+shift applied in
-        # x's compute dtype: under amp the whole elementwise chain (and the
-        # residual adds downstream) stays bf16 instead of promoting to f32,
-        # halving HBM traffic on the BN→relu→add path
-        inv = lax.rsqrt(var.astype(jnp.float32) + epsilon)
-        scale, shift = inv, -mean.astype(jnp.float32) * inv
-        if wb:
-            w, b = wb
-            scale = inv * w.astype(jnp.float32)
-            shift = b.astype(jnp.float32) - mean.astype(jnp.float32) * scale
-        out = x * scale.astype(x.dtype).reshape(shape) + \
-            shift.astype(x.dtype).reshape(shape)
+        w, b = wb if wb else (None, None)
+        out = _fold_scale_shift(x, mean, var, w, b, epsilon, shape)
         return out, new_rm, new_rv
 
     from . import pallas as P
