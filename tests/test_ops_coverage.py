@@ -1,0 +1,177 @@
+"""Per-op numeric tests vs numpy references for public ops nothing else
+exercised (SURVEY §4's test_*_op.py style — found by grepping op names
+against tests/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops import math as M, manip, creation
+
+
+def _t(a, **kw):
+    return pt.to_tensor(np.asarray(a), **kw)
+
+
+X = np.random.RandomState(0).randn(4, 6).astype("f4") * 2
+
+
+@pytest.mark.parametrize("fn,ref", [
+    (F.relu6, lambda x: np.clip(x, 0, 6)),
+    (F.leaky_relu, lambda x: np.where(x >= 0, x, 0.01 * x)),
+    (F.elu, lambda x: np.where(x > 0, x, np.expm1(x))),
+    (F.selu, lambda x: 1.0507009873554805 * np.where(
+        x > 0, x, 1.6732632423543772 * np.expm1(x))),
+    (F.gelu, lambda x: x * 0.5 * (1 + np.vectorize(__import__("math").erf)(
+        x / np.sqrt(2)))),
+    (F.log_sigmoid, lambda x: -np.log1p(np.exp(-np.abs(x))) +
+        np.minimum(x, 0)),
+    (F.hard_sigmoid, lambda x: np.clip(0.2 * x + 0.5, 0, 1)),
+    (F.hard_swish, lambda x: x * np.clip(x + 3, 0, 6) / 6),
+    (F.swish, lambda x: x / (1 + np.exp(-x))),
+    (F.mish, lambda x: x * np.tanh(np.log1p(np.exp(x)))),
+    (F.softplus, lambda x: np.where(x > 20, x, np.log1p(np.exp(
+        np.minimum(x, 20))))),
+    (F.softsign, lambda x: x / (1 + np.abs(x))),
+    (F.softshrink, lambda x: np.where(x > 0.5, x - 0.5,
+                                      np.where(x < -0.5, x + 0.5, 0))),
+    (F.hard_shrink, lambda x: np.where(np.abs(x) > 0.5, x, 0)),
+])
+def test_activation_matches_numpy(fn, ref):
+    out = fn(_t(X))
+    np.testing.assert_allclose(out.numpy(), ref(X).astype("f4"),
+                               atol=2e-5)
+
+
+def test_activation_grads_finite():
+    for fn in (F.relu6, F.leaky_relu, F.elu, F.selu, F.gelu, F.swish,
+               F.mish, F.softplus, F.softsign):
+        t = _t(X, stop_gradient=False)
+        fn(t).sum().backward()
+        assert np.isfinite(np.asarray(t.grad)).all(), fn
+
+
+def test_prelu_shapes():
+    x = np.random.RandomState(1).randn(2, 3, 4, 4).astype("f4")
+    # single alpha
+    out = F.prelu(_t(x), _t(np.asarray([0.25], "f4")))
+    np.testing.assert_allclose(out.numpy(),
+                               np.where(x >= 0, x, 0.25 * x), atol=1e-6)
+    # per-channel alpha (NCHW)
+    a = np.asarray([0.1, 0.2, 0.3], "f4")
+    out = F.prelu(_t(x), _t(a))
+    ref = np.where(x >= 0, x, a.reshape(1, 3, 1, 1) * x)
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-6)
+
+
+def test_math_tail():
+    rng = np.random.RandomState(2)
+    a = rng.randn(3, 4).astype("f4")
+    b = rng.randn(2, 4, 5).astype("f4")
+    ab = rng.randn(2, 3, 4).astype("f4")
+
+    assert M.cast(_t(a), "int32").numpy().dtype == np.int32
+    np.testing.assert_allclose(M.cumprod(_t(a), dim=1).numpy(),
+                               np.cumprod(a, axis=1), rtol=1e-5)
+    np.testing.assert_array_equal(M.argmin(_t(a), axis=1).numpy(),
+                                  np.argmin(a, axis=1))
+    np.testing.assert_allclose(M.bmm(_t(ab), _t(b)).numpy(),
+                               ab @ b, atol=1e-5)
+    inp = rng.randn(3, 5).astype("f4")
+    x2 = rng.randn(3, 4).astype("f4")
+    y2 = rng.randn(4, 5).astype("f4")
+    np.testing.assert_allclose(
+        M.addmm(_t(inp), _t(x2), _t(y2), beta=0.5, alpha=2.0).numpy(),
+        0.5 * inp + 2.0 * (x2 @ y2), atol=1e-5)
+    np.testing.assert_allclose(M.maximum_(_t(a), _t(a * 0)).numpy(),
+                               np.maximum(a, 0), atol=1e-6)
+    np.testing.assert_allclose(M.increment(_t(a)).numpy(), a + 1.0,
+                               atol=1e-6)
+    pred = np.eye(4, 5, dtype="f4")
+    lab = np.asarray([0, 1, 2, 0], "i4")
+    assert abs(float(M.accuracy_top1(_t(pred), _t(lab)).numpy()) -
+               0.75) < 1e-6
+    np.testing.assert_allclose(
+        M.elementwise_sum([_t(a), _t(a), _t(a)]).numpy(), 3 * a,
+        atol=1e-6)
+    np.testing.assert_array_equal(
+        M.elementwise_equal(_t(lab), _t(lab)).numpy(),
+        np.ones(4, bool))
+
+
+def test_manip_tail():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 4).astype("f4")
+
+    # paddle.flatten default start_axis=0, stop_axis=-1: full flatten
+    np.testing.assert_allclose(manip.flatten(_t(x)).numpy(),
+                               x.reshape(-1), atol=0)
+    parts = manip.unstack(_t(x), axis=1)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].numpy(), x[:, 1], atol=0)
+    np.testing.assert_allclose(
+        manip.squeeze(_t(x[:1]), axis=0).numpy(), x[0], atol=0)
+    small = rng.randn(1, 4).astype("f4")
+    np.testing.assert_allclose(
+        manip.expand_as(_t(small), _t(x[:, 0, :])).numpy(),
+        np.broadcast_to(small, (2, 4)), atol=0)
+    np.testing.assert_allclose(
+        manip.strided_slice(_t(x), axes=[2], starts=[0], ends=[4],
+                            strides=[2]).numpy(), x[:, :, ::2], atol=0)
+    pts = rng.randn(5, 3).astype("f4")
+    idx2 = np.asarray([[0], [2]], "i4")
+    np.testing.assert_allclose(manip.gather_nd(_t(pts), _t(idx2)).numpy(),
+                               pts[[0, 2]], atol=0)
+    np.testing.assert_allclose(
+        manip.index_select(_t(pts), _t(np.asarray([2, 0], "i4"))).numpy(),
+        pts[[2, 0]], atol=0)
+    upd = np.full((2, 3), 9.0, "f4")
+    out = manip.scatter(_t(pts), _t(np.asarray([1, 3], "i4")), _t(upd))
+    ref = pts.copy()
+    ref[[1, 3]] = 9.0
+    np.testing.assert_allclose(out.numpy(), ref, atol=0)
+    out = manip.scatter_nd_add(_t(pts), _t(np.asarray([[1], [1]], "i4")),
+                               _t(np.ones((2, 3), "f4")))
+    ref = pts.copy()
+    ref[1] += 2.0
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-6)
+    idx = np.zeros((5, 1), "i8")
+    out = manip.put_along_axis(_t(pts), _t(idx), _t(np.zeros((5, 1), "f4")),
+                               axis=1)
+    ref = pts.copy()
+    ref[:, 0] = 0.0
+    np.testing.assert_allclose(out.numpy(), ref, atol=0)
+    mask = pts > 0
+    np.testing.assert_allclose(
+        manip.masked_select(_t(pts), _t(mask)).numpy(), pts[mask], atol=0)
+    sq = rng.randn(4, 4).astype("f4")
+    np.testing.assert_allclose(manip.triu(_t(sq)).numpy(), np.triu(sq),
+                               atol=0)
+    g = manip.meshgrid(_t(np.arange(2, dtype="f4")),
+                       _t(np.arange(3, dtype="f4")))
+    r0, r1 = np.meshgrid(np.arange(2), np.arange(3), indexing="ij")
+    np.testing.assert_allclose(g[0].numpy(), r0, atol=0)
+    np.testing.assert_allclose(g[1].numpy(), r1, atol=0)
+    cks = manip.chunk(_t(x), 3, axis=1)
+    assert len(cks) == 3 and tuple(cks[0].shape) == (2, 1, 4)
+    ids = np.asarray([0, 3, 7, 11], "i8")
+    out = manip.shard_index(_t(ids), index_num=12, nshards=3, shard_id=1)
+    np.testing.assert_array_equal(out.numpy(), [-1, -1, 3, -1])
+
+
+def test_creation_tail():
+    pt.seed(7)
+    x = np.random.RandomState(4).randn(3, 4).astype("f4")
+    np.testing.assert_allclose(creation.ones_like(_t(x)).numpy(),
+                               np.ones_like(x), atol=0)
+    np.testing.assert_allclose(creation.full_like(_t(x), 2.5).numpy(),
+                               np.full_like(x, 2.5), atol=0)
+    n = creation.normal(mean=3.0, std=0.5, shape=[2000])
+    assert abs(float(n.numpy().mean()) - 3.0) < 0.1
+    assert abs(float(n.numpy().std()) - 0.5) < 0.05
+    p = creation.randperm(16)
+    np.testing.assert_array_equal(np.sort(p.numpy()), np.arange(16))
+    probs = np.full((2000,), 0.3, "f4")
+    b = creation.bernoulli(_t(probs))
+    assert set(np.unique(b.numpy())) <= {0.0, 1.0}
+    assert abs(float(b.numpy().mean()) - 0.3) < 0.08
