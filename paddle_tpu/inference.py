@@ -87,6 +87,18 @@ class Predictor:
     def run(self, *inputs):
         """Run inference; inputs are numpy arrays / Tensors. Returns
         numpy outputs (list when the model returns several)."""
+        out = self.run_device(*inputs)
+        if isinstance(out, (tuple, list)):
+            return [np.asarray(jax.device_get(o)) for o in out]
+        return np.asarray(jax.device_get(out))
+
+    def run_device(self, *inputs):
+        """Like run() but returns DEVICE arrays (jax.Array) without the
+        device→host copy: for pipelined serving, feeding one predictor's
+        output to another, or batched scoring loops where only the final
+        result (or a reduction) leaves the device. Inputs may be numpy,
+        Tensors, or device arrays — device inputs skip the host→device
+        copy too."""
         arrays = []
         for x in inputs:
             if isinstance(x, Tensor):
@@ -95,10 +107,7 @@ class Predictor:
         key = self._signature(arrays)
         if key not in self._compiled:
             self._compiled[key] = self._build(arrays)
-        out = self._compiled[key](self.state, *arrays)
-        if isinstance(out, (tuple, list)):
-            return [np.asarray(jax.device_get(o)) for o in out]
-        return np.asarray(jax.device_get(out))
+        return self._compiled[key](self.state, *arrays)
 
     def _build(self, arrays):
         model = self.model

@@ -270,3 +270,22 @@ def test_predictor_int8_does_not_mutate_callers_model():
     assert isinstance(m[0], nn.Linear)  # caller's layer untouched
     again = Predictor(m, Config()).run(x)
     np.testing.assert_array_equal(ref, again)
+
+
+def test_predictor_run_device_chain():
+    """run_device returns device arrays (no D2H) and chains: output of
+    one call feeds the next; run() still returns numpy."""
+    import jax
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.inference import Config, Predictor
+
+    pt.seed(0)
+    m = nn.Sequential(nn.Linear(8, 8), nn.ReLU())
+    x = (np.random.RandomState(0).randn(2, 8) * 0.1).astype("f4")
+    p = Predictor(m, Config().enable_int8([pt.to_tensor(x)]))
+    y = p.run_device(x)
+    assert isinstance(y, jax.Array)
+    y2 = p.run_device(y)
+    assert np.isfinite(np.asarray(y2)).all()
+    assert isinstance(p.run(x), np.ndarray)
