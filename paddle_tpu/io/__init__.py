@@ -328,6 +328,33 @@ class DataLoader:
                  batch_sampler=None, return_list=True, feed_list=None,
                  places=None, use_native=True, seed=None):
         self.dataset = dataset
+        # stream-style datasets (reference: dataloader_iter's
+        # _DataLoaderIterForIterableDataset): no sampler/len — batches
+        # are cut from the iterator in order
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            if batch_sampler is not None:
+                raise ValueError(
+                    "batch_sampler is incompatible with IterableDataset")
+            if shuffle:
+                raise ValueError(
+                    "shuffle=True is incompatible with IterableDataset "
+                    "(the stream defines its own order; shuffle inside "
+                    "the dataset, e.g. via reader.shuffle)")
+            if num_workers > 0:
+                warnings.warn(
+                    "num_workers is ignored for IterableDataset (process "
+                    "workers would need per-worker stream sharding); "
+                    "running single-stream with threaded prefetch")
+            self._batch_size = batch_size
+            self._drop_last = drop_last
+            self.batch_sampler = None
+            self.collate_fn = collate_fn or default_collate_fn
+            self.prefetch = max(1, prefetch_factor)
+            self.num_workers = 0
+            self._native = None
+            self._native_epoch = None
+            return
         self.batch_sampler = batch_sampler or BatchSampler(
             dataset, shuffle=shuffle, batch_size=batch_size,
             drop_last=drop_last, seed=seed)
@@ -350,7 +377,19 @@ class DataLoader:
                 self._native = None
 
     def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset loader has no length")
         return len(self.batch_sampler)
+
+    def _iter_stream(self):
+        buf = []
+        for item in self.dataset:
+            buf.append(item)
+            if len(buf) == self._batch_size:
+                yield self.collate_fn(buf)
+                buf = []
+        if buf and not self._drop_last:
+            yield self.collate_fn(buf)
 
     def _produce(self, q):
         try:
@@ -363,22 +402,38 @@ class DataLoader:
         except BaseException as e:  # surface worker errors to the consumer
             q.put(_WorkerError(e))
 
+    def _produce_stream(self, q):
+        try:
+            for batch in self._iter_stream():
+                q.put(batch)
+            q.put(_SENTINEL)
+        except BaseException as e:  # surface generator errors
+            q.put(_WorkerError(e))
+
     def __iter__(self):
-        if self.num_workers > 0 and self._native_epoch is None:
-            yield from self._iter_multiprocess()
-            return
-        if self._native_epoch is not None:
-            yield from self._native_epoch
-            return
-        if self.num_workers == 0 and self.prefetch <= 1:
-            for idx in self.batch_sampler:
-                if self._native is not None:
-                    yield self._native.gather(idx)
-                else:
-                    yield self.collate_fn([self.dataset[i] for i in idx])
-            return
+        if self._iterable:
+            if self.prefetch <= 1:
+                yield from self._iter_stream()
+                return
+            producer = self._produce_stream
+        else:
+            if self.num_workers > 0 and self._native_epoch is None:
+                yield from self._iter_multiprocess()
+                return
+            if self._native_epoch is not None:
+                yield from self._native_epoch
+                return
+            if self.num_workers == 0 and self.prefetch <= 1:
+                for idx in self.batch_sampler:
+                    if self._native is not None:
+                        yield self._native.gather(idx)
+                    else:
+                        yield self.collate_fn(
+                            [self.dataset[i] for i in idx])
+                return
+            producer = self._produce
         q = _queue.Queue(maxsize=self.prefetch)
-        t = threading.Thread(target=self._produce, args=(q,), daemon=True)
+        t = threading.Thread(target=producer, args=(q,), daemon=True)
         t.start()
         while True:
             item = q.get()
