@@ -84,3 +84,55 @@ def test_clone_for_test_drops_optimizer():
     prog = static.default_main_program()
     test_prog = prog.clone(for_test=True)
     assert prog.optimizers and not test_prog.optimizers
+
+
+def test_static_aux_surface():
+    """InputSpec, append_backward marking, Scope/global_scope,
+    name_scope, and the Build/Execution strategy facades (reference
+    static-mode aux names)."""
+    from paddle_tpu import static
+
+    spec = static.InputSpec([None, 8], "float32", name="x")
+    assert spec.shape == (None, 8) and spec.name == "x"
+
+    # static mode is already on via this file's autouse fixture
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", (None, 4), "float32")
+        with static.name_scope("blk"):
+            h = pt.fluid.layers.fc(x, size=2)
+        loss = pt.fluid.layers.reduce_mean(h * h)
+        grads = static.append_backward(loss)
+        assert grads == []
+        assert prog._loss_name == loss.name
+
+    sc = static.global_scope()
+    assert static.global_scope() is sc
+    sc.vars["tmp"] = 1
+    assert sc.find_var("tmp") == 1
+    del sc.vars["tmp"]
+
+    bs = static.BuildStrategy()
+    es = static.ExecutionStrategy()
+    assert bs is not None and es is not None
+
+
+def test_create_predictor_factory(tmp_path):
+    """paddle-inference-style factory: save_inference_model then
+    create_predictor(Config(path)) serves the restored model."""
+    import os
+    from paddle_tpu import io, nn
+    from paddle_tpu import inference
+
+    pt.disable_static()  # this file's autouse fixture enables static
+    pt.seed(0)
+    m = nn.Sequential(nn.Linear(4, 2))
+    path = os.path.join(str(tmp_path), "model")
+    io.save_inference_model(path, m)
+
+    pred = inference.create_predictor(inference.Config(path))
+    xin = np.random.RandomState(0).randn(3, 4).astype("f4")
+    out = pred.run(xin)
+    assert np.asarray(out).shape == (3, 2)
+    ref = m(pt.to_tensor(xin)).numpy()
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5)
