@@ -17,24 +17,19 @@ print('PROBE_OK', jax.devices()[0].platform)
 " 2>/dev/null | grep -q PROBE_OK
 }
 
-ran_battery=0
 while true; do
     if probe; then
         echo "$(date -Is) tunnel ALIVE" >> "$OUT/status.log"
-        if [ "$ran_battery" = 0 ]; then
-            echo "$(date -Is) running battery" >> "$OUT/status.log"
-            python bench.py > "$OUT/bench.log" 2>&1
-            python scripts/bench_int8.py > "$OUT/int8.log" 2>&1
-            python -u scripts/bench_pallas_bn.py > "$OUT/pallas_bn.log" 2>&1
-            python -u scripts/profile_resnet.py > "$OUT/profile_resnet.log" 2>&1
-            python -u scripts/ablate_bert.py > "$OUT/ablate.log" 2>&1
-            ran_battery=1
-            echo "$(date -Is) battery done" >> "$OUT/status.log"
-        fi
-        sleep 1800
-        # re-probe and re-run battery hourly-ish keeps cache warm after
-        # any tunnel restart invalidates server-side state
-        ran_battery=0
+        echo "$(date -Is) running battery" >> "$OUT/status.log"
+        python bench.py > "$OUT/bench.log" 2>&1
+        python scripts/bench_int8.py > "$OUT/int8.log" 2>&1
+        python -u scripts/bench_pallas_bn.py > "$OUT/pallas_bn.log" 2>&1
+        python -u scripts/profile_resnet.py > "$OUT/profile_resnet.log" 2>&1
+        python -u scripts/ablate_bert.py > "$OUT/ablate.log" 2>&1
+        echo "$(date -Is) battery done; exiting (single-shot: a looping" \
+             "watcher could hold the chip when the driver's recorded" \
+             "bench runs)" >> "$OUT/status.log"
+        exit 0
     else
         echo "$(date -Is) tunnel DEAD" >> "$OUT/status.log"
         sleep 600
