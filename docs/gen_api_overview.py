@@ -53,7 +53,9 @@ SECTIONS = [
                         "paddle_tpu.fluid.dygraph",
                         "paddle_tpu.fluid.contrib",
                         "paddle_tpu.framework", "paddle_tpu.imperative",
-                        "paddle_tpu.incubate"]),
+                        "paddle_tpu.incubate", "paddle_tpu.compat",
+                        "paddle_tpu.sysconfig",
+                        "paddle_tpu.common_ops_import"]),
 ]
 
 
