@@ -1,0 +1,14 @@
+"""Single source of truth for the TPU liveness probe (invoked by both
+scripts/tpu_watcher.sh and bench.py's _subprocess_probe, so timeout
+tuning or hang-handling fixes land in one place).
+
+Prints 'PROBE_OK <platform>' and exits 0 iff the backend answers a real
+device computation. Run it under an external timeout: a wedged tunnel
+blocks uninterruptibly in C on first contact (observed r4), so only a
+kill from outside can reap it.
+"""
+import jax
+import jax.numpy as jnp
+
+jnp.zeros((8,), jnp.float32).block_until_ready()
+print("PROBE_OK", jax.devices()[0].platform)

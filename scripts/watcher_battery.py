@@ -1,0 +1,123 @@
+"""One bench battery, run by scripts/tpu_watcher.sh whenever the TPU
+tunnel answers.
+
+Runs bench.py first (the headline metrics) and, if it produced a real
+number, atomically refreshes ``docs/bench_latest_measured.json`` — the
+committed, timestamped record of the most recent successful on-chip
+measurement (VERDICT r4 task 1a). Then runs the secondary measurement
+scripts (per-kernel ablation, Pallas BN sweep, int8 table, roofline
+profile), teeing each log into ``docs/watcher_logs/`` so the evidence is
+committed even if the tunnel wedges again before a human looks at /tmp.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOGS = os.path.join(REPO, "docs", "watcher_logs")
+LATEST = os.path.join(REPO, "docs", "bench_latest_measured.json")
+# Global deadline: the whole battery finishes inside this budget, by
+# skipping/trimming extras — NOT by being SIGKILLed mid-stage (the
+# watcher's outer timeout is this +300s slack). Keeps one battery from
+# holding the chip for hours when every stage runs long.
+DEADLINE = time.time() + int(os.environ.get("BATTERY_BUDGET_S", "7200"))
+
+
+def _git_rev():
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=30).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def _run(cmd, log_name, timeout_s):
+    os.makedirs(LOGS, exist_ok=True)
+    path = os.path.join(LOGS, log_name)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                              text=True, timeout=timeout_s)
+        out = proc.stdout + ("\n--- stderr ---\n" + proc.stderr
+                             if proc.stderr else "")
+        rc = proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or "") + f"\n--- TIMEOUT after {timeout_s}s ---\n"
+        rc = -1
+    header = (f"# cmd: {' '.join(cmd)}\n# rc: {rc}"
+              f"  wall: {time.time() - t0:.0f}s"
+              f"  at: {time.strftime('%Y-%m-%dT%H:%M:%S')}"
+              f"  rev: {_git_rev()}\n")
+    with open(path, "w") as f:
+        f.write(header + out)
+    print(f"[battery] {log_name}: rc={rc} wall={time.time() - t0:.0f}s",
+          flush=True)
+    return rc, out
+
+
+def _last_json_line(text):
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    # 3420 > bench.py's own 3300s watchdog: a wedged bench gets killed
+    # by ITS watchdog first, which emits the partial-credit fail-JSON
+    # carrying any stages that did finish — so a real bert number from a
+    # run that wedged at the resnet stage still refreshes LATEST.
+    rc, out = _run([sys.executable, "bench.py"], "bench.log", 3420)
+    parsed = _last_json_line(out)
+    if parsed and parsed.get("value", 0) > 0:
+        record = {
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "git_rev": _git_rev(),
+            "source": "scripts/watcher_battery.py (on-chip, via "
+                      "scripts/tpu_watcher.sh)",
+            **parsed,
+        }
+        tmp = LATEST + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=2)
+            f.write("\n")
+        os.replace(tmp, LATEST)
+        print(f"[battery] refreshed {LATEST}: "
+              f"bert={parsed.get('value')} "
+              f"resnet={parsed.get('resnet50_images_per_sec')}",
+              flush=True)
+    else:
+        print("[battery] bench.py produced no positive headline number; "
+              "bench_latest_measured.json left untouched", flush=True)
+
+    # Secondary measurements — each independently time-boxed.
+    extras = [
+        (["scripts/ablate_bert.py"], "ablate.log", 1800),
+        (["scripts/bench_pallas_bn.py"], "pallas_bn.log", 1200),
+        (["scripts/bench_adam_multi.py"], "adam_multi.log", 900),
+        (["scripts/bench_nhwc_resnet.py"], "nhwc_resnet.log", 1800),
+        (["scripts/bench_int8.py"], "int8.log", 1200),
+        (["scripts/profile_resnet.py"], "profile_resnet.log", 1200),
+    ]
+    for cmd, log_name, budget in extras:
+        if not os.path.exists(os.path.join(REPO, cmd[0])):
+            print(f"[battery] skip {cmd[0]} (absent)", flush=True)
+            continue
+        remaining = DEADLINE - time.time()
+        if remaining < 120:
+            print(f"[battery] skip {cmd[0]} (deadline: {remaining:.0f}s "
+                  "left)", flush=True)
+            continue
+        _run([sys.executable, "-u"] + cmd, log_name,
+             min(budget, int(remaining - 60)))
+
+
+if __name__ == "__main__":
+    main()
