@@ -18,6 +18,17 @@ long_type = int
 def _convert(obj, conv, inplace):
     if obj is None:
         return obj
+    if isinstance(obj, dict):
+        # Reference converts both keys and values (compat.py dict
+        # branch); keys are always freshly converted (can't mutate in
+        # place), values honor inplace for the dict itself.
+        items = {_convert(k, conv, False): _convert(v, conv, False)
+                 for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(items)
+            return obj
+        return items
     if isinstance(obj, (list, set)):
         if inplace:
             items = [_convert(o, conv, False) for o in obj]

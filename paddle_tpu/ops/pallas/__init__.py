@@ -87,6 +87,9 @@ def configure(flash_min_seq=_UNSET, **kernels):
 def enabled(kernel, seq_len=None):
     """Effective default for one kernel, honoring configure() overrides
     (and the flash seq-length crossover when seq_len is given)."""
+    if kernel not in _KERNELS:
+        raise ValueError(
+            f"unknown pallas kernel {kernel!r}; known: {_KERNELS}")
     v = _overrides.get(kernel)
     on = (on_tpu() and _AUTO_ON[kernel]) if v is None else v
     if on and kernel == "flash_attention" and seq_len is not None and \

@@ -105,8 +105,25 @@ def summarize_trace(trace_dir, top=20, steps=1):
     tot = collections.Counter()
     with gzip.open(files[-1]) as fh:
         data = json.load(fh)
-    for e in data.get("traceEvents", []):
+    events = data.get("traceEvents", [])
+    # Identify the device lanes from the trace's process metadata (ph=M
+    # process_name events whose name carries the device identity, e.g.
+    # "/device:TPU:0 ..."), so host-side 'X' events can't inflate op
+    # totals regardless of their names (r4 advisor finding).
+    device_pids = {
+        e.get("pid") for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and any(tag in str(e.get("args", {}).get("name", ""))
+                for tag in ("/device:", "TPU", "GPU", "XLA"))
+    }
+    if not device_pids:
+        print("[summarize_trace] no device lanes in process metadata; "
+              "falling back to name-substring host filtering "
+              "(approximate)")
+    for e in events:
         if e.get("ph") != "X" or "dur" not in e:
+            continue
+        if device_pids and e.get("pid") not in device_pids:
             continue
         n = e.get("name", "?")
         if any(s in n for s in skip) or n.isdigit():

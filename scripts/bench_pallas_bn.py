@@ -32,15 +32,14 @@ def micro(use_pallas, m=128 * 28 * 28, c=256, iters=12):
     b = jnp.asarray(rng.randn(c), jnp.float32)
 
     def xla_bn(x2, w, b, eps=1e-5):
-        xf = x2.astype(jnp.float32)
-        n = x2.shape[0]
-        s = jnp.sum(xf, axis=0)
-        s2 = jnp.sum(jnp.square(xf), axis=0)
-        mean = s / n
-        var = jnp.maximum(s2 / n - jnp.square(mean), 0.0)
-        inv = jax.lax.rsqrt(var + 1e-5)
-        return (x2 * (inv * w).astype(x2.dtype) +
-                (b - mean * inv * w).astype(x2.dtype))
+        # Baseline = the PRODUCTION XLA path (shifted one-pass moments +
+        # folded scale/shift from nn_ops), not a hand-rolled variant:
+        # the auto-on decision must compare the kernel against the exact
+        # program it would replace (r4 advisor finding).
+        from paddle_tpu.ops.nn_ops import _fold_scale_shift, \
+            _one_pass_moments
+        mean, var = _one_pass_moments(x2, (0,))
+        return _fold_scale_shift(x2, mean, var, w, b, eps, (1, x2.shape[1]))
 
     bn = (lambda x: _batch_norm2(x, w, b, 1e-5)[0]) if use_pallas \
         else (lambda x: xla_bn(x, w, b))

@@ -272,3 +272,57 @@ def test_hsigmoid_no_bias():
     out = hs(x, lbl)
     assert tuple(out.shape) == (4, 1)
     assert np.isfinite(out.numpy()).all()
+
+
+# ---- round-4 ADVICE.md findings ----
+
+def test_compat_dict_conversion():
+    """low: to_text/to_bytes convert dict keys AND values like the
+    reference compat.py; inplace honors the dict identity."""
+    from paddle_tpu import compat
+    d = {b"k": b"v", "s": [b"a", "b"], "n": 3}
+    out = compat.to_text(d)
+    assert out == {"k": "v", "s": ["a", "b"], "n": 3}
+    assert d[b"k"] == b"v"  # not mutated
+
+    back = compat.to_bytes({"k": "v", "nest": {"a": "b"}})
+    assert back == {b"k": b"v", b"nest": {b"a": b"b"}}
+
+    d2 = {b"x": b"y"}
+    same = compat.to_text(d2, inplace=True)
+    assert same is d2 and d2 == {"x": "y"}
+
+    with pytest.raises(TypeError):
+        compat.to_bytes({"k": 1.5})
+
+
+def test_pallas_enabled_unknown_kernel_raises_valueerror():
+    """low: enabled() on an unknown kernel name raises the same
+    ValueError configure() does, not a bare KeyError."""
+    from paddle_tpu.ops import pallas as P
+    with pytest.raises(ValueError, match="unknown pallas kernel"):
+        P.enabled("not_a_kernel")
+
+
+def test_summarize_trace_filters_host_lanes_by_pid(tmp_path):
+    """low: summarize_trace aggregates only device-lane pids when the
+    trace names them, so host 'X' events can't inflate op totals."""
+    import gzip
+    import json
+    from paddle_tpu.utils.profiler import summarize_trace
+
+    trace = {"traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "/host:CPU python"}},
+        {"ph": "M", "name": "process_name", "pid": 2,
+         "args": {"name": "/device:TPU:0 (pid 2)"}},
+        {"ph": "X", "pid": 1, "name": "fusion", "dur": 9000},
+        {"ph": "X", "pid": 2, "name": "fusion.1", "dur": 500},
+        {"ph": "X", "pid": 2, "name": "convolution", "dur": 250},
+    ]}
+    p = tmp_path / "t" / "x.trace.json.gz"
+    p.parent.mkdir()
+    with gzip.open(p, "wt") as fh:
+        json.dump(trace, fh)
+    fams = dict(summarize_trace(str(tmp_path)))
+    assert fams == {"fusion": 0.5, "convolution": 0.25}
