@@ -33,6 +33,16 @@ class TPUPlace(Place):
         super().__init__(devs[idx % len(devs)])
 
 
+class CUDAPinnedPlace(Place):
+    """reference place.h:CUDAPinnedPlace — page-locked host staging
+    memory. Host-side staging here is the csrc arena; the place object
+    exists so device-placement code ports, and resolves to host CPU."""
+
+    def __init__(self):
+        super().__init__(jax.devices("cpu")[0]
+                         if _has_platform("cpu") else jax.devices()[0])
+
+
 # parity alias: code written against the reference uses CUDAPlace for the
 # accelerator
 CUDAPlace = TPUPlace

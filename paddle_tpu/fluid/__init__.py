@@ -51,12 +51,31 @@ from . import data_feed_desc
 from .trainer_desc import (TrainerDesc, MultiTrainer,  # noqa: F401
                            DistMultiTrainer, TrainerFactory, Communicator)
 from .data_feed_desc import DataFeedDesc  # noqa: F401
-# device_worker / trainer_factory / communicator share trainer_desc.py's
-# redesign (one module; the reference splits them only for protobuf
-# codegen reasons)
-device_worker = trainer_desc
-trainer_factory = trainer_desc
-communicator = trainer_desc
+communicator = trainer_desc  # Communicator shares trainer_desc's module
+from . import device_worker  # noqa: E402 (facade over trainer_desc)
+from . import trainer_factory  # noqa: E402 (adds FetchHandler pair)
+from . import annotations  # noqa: E402
+from . import average  # noqa: E402
+from . import dataset  # noqa: E402
+from . import default_scope_funcs  # noqa: E402
+from . import input  # noqa: E402
+from . import lod_tensor  # noqa: E402
+from . import log_helper  # noqa: E402
+from . import reader  # noqa: E402
+from . import wrapped_decorator  # noqa: E402
+from . import learning_rate_decay  # noqa: E402
+from .input import one_hot, embedding  # noqa: F401,E402
+from .dygraph import enable_dygraph, disable_dygraph  # noqa: F401,E402
+from .lod_tensor import (_LoDTensor as LoDTensor,  # noqa: F401,E402
+                         create_lod_tensor, create_random_int_lodtensor)
+from ..ops.imperative_flow import (  # noqa: F401,E402
+    TensorArray as LoDTensorArray)
+from ..device import CUDAPinnedPlace  # noqa: F401,E402
+from ..static import Scope  # noqa: F401,E402
+from .io import save, load  # noqa: F401,E402
+from .dataset import DatasetFactory  # noqa: F401,E402
+
+VarBase = Tensor  # the dygraph-era C++ tensor class name
 
 
 class Variable(Tensor):
@@ -81,12 +100,7 @@ def release_memory(program=None, **kw):
     pass
 
 
-def set_flags(flags):
-    """reference: fluid.set_flags (FLAGS_*) — map the known ones."""
-    import jax
-    for k, v in (flags or {}).items():
-        if k == "FLAGS_check_nan_inf":
-            jax.config.update("jax_debug_nans", bool(v))
+from .framework import set_flags, get_flags  # noqa: F401,E402
 
 
 def is_compiled_with_cuda():

@@ -1,0 +1,26 @@
+"""fluid.annotations (reference: fluid/annotations.py)."""
+import functools
+import sys
+
+__all__ = ["deprecated"]
+
+
+def deprecated(since, instead, extra_message=""):
+    """reference annotations.py:deprecated — marks an API deprecated,
+    printing one warning per call site to stderr."""
+    def decorator(func):
+        err_msg = f"API {func.__name__} is deprecated since {since}. " \
+                  f"Please use {instead} instead."
+        if extra_message:
+            full = err_msg + " " + extra_message
+        else:
+            full = err_msg
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            print(full, file=sys.stderr)
+            return func(*args, **kwargs)
+
+        wrapper.__doc__ = (full + "\n\n") + (func.__doc__ or "")
+        return wrapper
+    return decorator

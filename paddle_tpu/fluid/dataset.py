@@ -1,0 +1,215 @@
+"""fluid.dataset (reference: fluid/dataset.py) — the PS/CTR-era file
+datasets: DatasetFactory creating QueueDataset / InMemoryDataset over a
+filelist in the MultiSlot text format.
+
+Reference architecture: a C++ DataFeed pipeline (pipe_command per file,
+background threads, global/local shuffle) feeding trainers directly.
+TPU redesign: files parse on the host into per-slot numpy batches (the
+MultiSlot format: per line, for each slot, a count then that many
+values), and `Executor.train_from_dataset` runs the compiled program
+over those batches — the device sees the same dense feed path every
+other feed uses. pipe_command still runs (subprocess per file) so
+existing preprocessing commands keep working.
+"""
+import subprocess
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "InMemoryDataset", "QueueDataset"]
+
+
+class DatasetFactory:
+    """reference dataset.py:DatasetFactory."""
+
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        try:
+            return globals()[datafeed_class]()
+        except KeyError:
+            raise ValueError(
+                f"datafeed class {datafeed_class} does not exist")
+
+
+class DatasetBase:
+    """reference dataset.py:DatasetBase — the set_* configuration
+    surface plus host-side batch assembly."""
+
+    def __init__(self):
+        self.proto_desc_pipe_command = "cat"
+        self.batch_size_ = 1
+        self.thread_num = 1
+        self.filelist = []
+        self.use_var_names = []
+        self.use_var_lod = []
+        self.use_var_int = []
+        self.hdfs_config = None
+        self.download_cmd = None
+
+    # -- configuration (reference set_* family) --
+    def set_pipe_command(self, pipe_command):
+        self.proto_desc_pipe_command = pipe_command
+
+    def set_batch_size(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_var_names = [getattr(v, "name", str(v)) for v in var_list]
+        self.use_var_lod = [bool(getattr(v, "lod_level", 0))
+                            for v in var_list]
+        # integer-dtype slots (embedding ids) must NOT pass through
+        # float32 — ids above 2^24 would silently collide
+        self.use_var_int = [
+            "int" in str(getattr(v, "dtype", "float32"))
+            for v in var_list]
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self.hdfs_config = (fs_name, fs_ugi)
+
+    def set_download_cmd(self, download_cmd):
+        self.download_cmd = download_cmd
+
+    def desc(self):
+        return (f"pipe_command: {self.proto_desc_pipe_command} "
+                f"batch: {self.batch_size_} thread: {self.thread_num} "
+                f"slots: {self.use_var_names}")
+
+    # -- host-side feed assembly --
+    def _read_lines(self):
+        for path in self.filelist:
+            if self.proto_desc_pipe_command not in (None, "", "cat"):
+                with open(path, "rb") as fh:
+                    out = subprocess.run(
+                        self.proto_desc_pipe_command, shell=True,
+                        stdin=fh, capture_output=True,
+                        check=True).stdout.decode()
+                for line in out.splitlines():
+                    if line.strip():
+                        yield line
+            else:
+                with open(path) as fh:
+                    for line in fh:
+                        if line.strip():
+                            yield line.rstrip("\n")
+
+    def _parse_line(self, line):
+        """MultiSlot text format: for each slot, an integer count then
+        that many values. Integer slots (per set_use_var dtype) parse
+        as python ints, never floats."""
+        toks = line.split()
+        is_int = self.use_var_int or [False] * len(self.use_var_names)
+        slots, i = [], 0
+        for si, _ in enumerate(self.use_var_names):
+            n = int(toks[i])
+            conv = int if is_int[si] else float
+            vals = [conv(v) for v in toks[i + 1:i + 1 + n]]
+            slots.append(vals)
+            i += 1 + n
+        return slots
+
+    def _records(self):
+        for line in self._read_lines():
+            yield self._parse_line(line)
+
+    def _batches(self, records=None):
+        """Yield dicts {var_name: np.ndarray} of batch_size records.
+        Fixed-count slots stack densely; variable-count (lod) slots pad
+        to the batch max (padded-dense is this framework's LoD
+        redesign)."""
+        buf = []
+        for rec in (records if records is not None else self._records()):
+            buf.append(rec)
+            if len(buf) == self.batch_size_:
+                yield self._assemble(buf)
+                buf = []
+        if buf:
+            yield self._assemble(buf)
+
+    def _assemble(self, recs):
+        out = {}
+        is_int = self.use_var_int or [False] * len(self.use_var_names)
+        for si, name in enumerate(self.use_var_names):
+            col = [r[si] for r in recs]
+            width = max(len(v) for v in col)
+            dtype = "int64" if is_int[si] else "float32"
+            arr = np.zeros((len(col), width), dtype=dtype)
+            for ri, vals in enumerate(col):
+                arr[ri, :len(vals)] = vals
+            out[name] = arr
+        return out
+
+
+class QueueDataset(DatasetBase):
+    """reference dataset.py:QueueDataset — streams straight from files
+    (no resident copy)."""
+
+    def __init__(self):
+        super().__init__()
+        self.proto_desc_name = "QueueDataset"
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset does not support local shuffle; use "
+            "InMemoryDataset (reference raises the same)")
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            "QueueDataset does not support global shuffle; use "
+            "InMemoryDataset (reference raises the same)")
+
+
+class InMemoryDataset(DatasetBase):
+    """reference dataset.py:InMemoryDataset — load_into_memory +
+    local/global shuffle before training."""
+
+    def __init__(self):
+        super().__init__()
+        self.proto_desc_name = "InMemoryDataset"
+        self._memory = None
+        self.queue_num = None
+        self.fleet_send_batch_size = None
+
+    def set_queue_num(self, queue_num):
+        self.queue_num = int(queue_num)
+
+    def set_fleet_send_batch_size(self, n=1024):
+        self.fleet_send_batch_size = int(n)
+
+    def load_into_memory(self):
+        self._memory = list(self._records())
+
+    def preload_into_memory(self, thread_num=None):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    def local_shuffle(self):
+        if self._memory is None:
+            raise RuntimeError("call load_into_memory() first")
+        from ..random import get_seed
+        np.random.RandomState(get_seed()).shuffle(self._memory)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        """Single-host: same permutation as local_shuffle (the reference
+        exchanges records across trainers; with one trainer the result
+        distribution is identical)."""
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._memory = None
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._memory or [])
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size(fleet)
+
+    def _batches(self, records=None):
+        if records is None and self._memory is not None:
+            records = self._memory
+        return super()._batches(records)

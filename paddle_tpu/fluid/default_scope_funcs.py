@@ -1,0 +1,68 @@
+"""fluid.default_scope_funcs (reference: fluid/default_scope_funcs.py)
+— a thread-local stack of Scopes with enter/leave helpers. The Scope
+here is the static module's name→Tensor dict (device residency is
+XLA's job)."""
+import threading
+
+from ..static import Scope, global_scope
+
+__all__ = [
+    "get_cur_scope", "enter_local_scope", "leave_local_scope", "var",
+    "find_var", "scoped_function",
+]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "scopes"):
+        _tls.scopes = [global_scope()]
+    return _tls.scopes
+
+
+def get_cur_scope():
+    """reference default_scope_funcs.py:get_cur_scope."""
+    return _stack()[-1]
+
+
+def enter_local_scope():
+    """Push a child scope (lookups fall back to the parent)."""
+    parent = get_cur_scope()
+    child = Scope()
+    child._parent = parent
+    _stack().append(child)
+    return child
+
+
+def leave_local_scope():
+    if len(_stack()) == 1:
+        raise RuntimeError("cannot leave the global scope")
+    _stack().pop()
+
+
+def var(name):
+    """Get-or-create a slot for `name` in the current scope."""
+    scope = get_cur_scope()
+    if name not in scope.vars:
+        scope.vars[name] = None
+    return scope.vars[name]
+
+
+def find_var(name):
+    """Find `name` walking parents (reference Scope::FindVar chain)."""
+    scope = get_cur_scope()
+    while scope is not None:
+        if name in scope.vars:
+            return scope.vars[name]
+        scope = getattr(scope, "_parent", None)
+    return None
+
+
+def scoped_function(func):
+    """reference default_scope_funcs.py:scoped_function — run func
+    inside a fresh local scope."""
+    enter_local_scope()
+    try:
+        return func()
+    finally:
+        leave_local_scope()

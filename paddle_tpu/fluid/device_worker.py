@@ -1,0 +1,8 @@
+"""fluid.device_worker facade (reference: fluid/device_worker.py) —
+the worker-desc generator classes live with TrainerDesc in
+trainer_desc.py here (one module owns the trainer/worker pairing)."""
+from .trainer_desc import (DeviceWorker, Hogwild, DownpourSGD,  # noqa
+                           DownpourSGDOPT, Section)
+
+__all__ = ["DeviceWorker", "Hogwild", "DownpourSGD", "DownpourSGDOPT",
+           "Section"]

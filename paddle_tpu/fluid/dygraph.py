@@ -15,10 +15,17 @@ from ..nn import (Layer, Sequential, LayerList, ParameterList, Linear,
                   Embedding, Dropout, PRelu, BilinearTensorProduct, GRUUnit)
 from ..autograd import no_grad
 from ..jit import to_static, TracedLayer
+from ..dygraph_to_static import ProgramTranslator  # noqa: F401
 from ..io import save_dygraph, load_dygraph
 from ..parallel import DataParallel
 from ..parallel.env import ParallelEnv, prepare_context
-from ..optimizer import lr as learning_rate_scheduler  # noqa: F401
+# The 1.x dygraph decay classes live in dygraph_lr (distinct protocol
+# from optimizer.lr's 2.x LRScheduler — see that module's docstring).
+from . import dygraph_lr as learning_rate_scheduler  # noqa: F401
+from .dygraph_lr import (LearningRateDecay, NoamDecay,  # noqa: F401
+                         PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+                         InverseTimeDecay, PolynomialDecay, CosineDecay,
+                         LinearLrWarmup)
 
 
 @contextlib.contextmanager
@@ -44,6 +51,15 @@ def to_variable(value, name=None, zero_copy=None):
 def enabled():
     from .. import static as _static
     return not _static.in_static_mode()
+
+
+class BackwardStrategy:
+    """reference dygraph/backward_strategy.py:BackwardStrategy —
+    sort_sum_gradient has no effect here (the tape sums in deterministic
+    order already). paddle_tpu.imperative re-exports this."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
 
 
 # --- remaining dygraph/nn.py + dygraph/base.py parity -----------------------
@@ -170,3 +186,167 @@ class SequenceConv(Layer):
             from ..nn import functional as F
             out = getattr(F, self._act)(out)
         return out
+
+
+# --- dygraph/rnn.py parity: legacy-signature cells ---------------------------
+
+class LSTMCell(Layer):
+    """reference dygraph/rnn.py:LSTMCell — the 1.x dygraph cell with
+    (hidden_size, input_size) argument order and a CUDNN-layout default
+    (separate ih/hh weights, i,f,c,o gate chunks) plus the basic
+    fused-weight variant (use_cudnn_impl=False, i,j,f,o chunks with
+    forget_bias). Distinct from paddle_tpu.nn.LSTMCell (2.x signature).
+    dtype follows TPU canonicalization (f64 requests run as f32)."""
+
+    def __init__(self, hidden_size, input_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, use_cudnn_impl=True, dtype="float32"):
+        super().__init__()
+        import jax.numpy as jnp
+        from ..nn import functional as F
+        from ..ops.math import tanh as _tanh
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._gate_activation = gate_activation or F.sigmoid
+        self._activation = activation or _tanh
+        self._use_cudnn_impl = use_cudnn_impl
+        if use_cudnn_impl:
+            self._weight_ih = self.create_parameter(
+                (4 * hidden_size, input_size), attr=param_attr, dtype=dtype)
+            self._weight_hh = self.create_parameter(
+                (4 * hidden_size, hidden_size), attr=param_attr, dtype=dtype)
+            self._bias_ih = self.create_parameter(
+                (4 * hidden_size,), attr=bias_attr, dtype=dtype, is_bias=True)
+            self._bias_hh = self.create_parameter(
+                (4 * hidden_size,), attr=bias_attr, dtype=dtype, is_bias=True)
+        else:
+            self._forget_bias = float(forget_bias)
+            self._weight = self.create_parameter(
+                (input_size + hidden_size, 4 * hidden_size),
+                attr=param_attr, dtype=dtype)
+            self._bias = self.create_parameter(
+                (4 * hidden_size,), attr=bias_attr, dtype=dtype,
+                is_bias=True)
+
+    def forward(self, input, pre_hidden, pre_cell):
+        # Tensor-level ops so custom gate activations (which take
+        # Tensors, like the reference's layer fns take Variables)
+        # compose and the tape differentiates through them.
+        import paddle_tpu as pt
+        from ..nn import functional as F
+        from ..ops.math import tanh
+        if self._use_cudnn_impl:
+            ig = pt.matmul(input, self._weight_ih, transpose_y=True) \
+                + self._bias_ih
+            hg = pt.matmul(pre_hidden, self._weight_hh, transpose_y=True) \
+                + self._bias_hh
+            ci = pt.split(ig, 4, axis=1)
+            ch = pt.split(hg, 4, axis=1)
+            i = self._gate_activation(ci[0] + ch[0])
+            f = self._gate_activation(ci[1] + ch[1])
+            g = self._activation(ci[2] + ch[2])
+            o = self._gate_activation(ci[3] + ch[3])
+            new_c = f * pre_cell + i * g
+            new_h = o * self._activation(new_c)
+        else:
+            gate = pt.matmul(pt.concat([input, pre_hidden], 1),
+                             self._weight) + self._bias
+            i, j, f, o = pt.split(gate, 4, axis=-1)
+            new_c = pre_cell * self._gate_activation(
+                f + self._forget_bias) + F.sigmoid(i) * tanh(j)
+            new_h = self._activation(new_c) * self._gate_activation(o)
+        return new_h, new_c
+
+
+class GRUCell(Layer):
+    """reference dygraph/rnn.py:GRUCell — 1.x dygraph cell,
+    (hidden_size, input_size) order; CUDNN layout by default (r,u,c
+    chunks with reset applied to the hh candidate chunk), or the
+    BasicGRUUnit fused-weight variant (use_cudnn_impl=False)."""
+
+    def __init__(self, hidden_size, input_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 use_cudnn_impl=True, dtype="float32"):
+        super().__init__()
+        from ..nn import functional as F
+        from ..ops.math import tanh as _tanh
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._gate_activation = gate_activation or F.sigmoid
+        self._activation = activation or _tanh
+        self._use_cudnn_impl = use_cudnn_impl
+        if use_cudnn_impl:
+            self._weight_ih = self.create_parameter(
+                (3 * hidden_size, input_size), attr=param_attr, dtype=dtype)
+            self._weight_hh = self.create_parameter(
+                (3 * hidden_size, hidden_size), attr=param_attr, dtype=dtype)
+            self._bias_ih = self.create_parameter(
+                (3 * hidden_size,), attr=bias_attr, dtype=dtype, is_bias=True)
+            self._bias_hh = self.create_parameter(
+                (3 * hidden_size,), attr=bias_attr, dtype=dtype, is_bias=True)
+        else:
+            self._gate_weight = self.create_parameter(
+                (input_size + hidden_size, 2 * hidden_size),
+                attr=param_attr, dtype=dtype)
+            self._candidate_weight = self.create_parameter(
+                (input_size + hidden_size, hidden_size),
+                attr=param_attr, dtype=dtype)
+            self._gate_bias = self.create_parameter(
+                (2 * hidden_size,), attr=bias_attr, dtype=dtype,
+                is_bias=True)
+            self._candidate_bias = self.create_parameter(
+                (hidden_size,), attr=bias_attr, dtype=dtype, is_bias=True)
+
+    def forward(self, input, pre_hidden):
+        import paddle_tpu as pt
+        if self._use_cudnn_impl:
+            ig = pt.matmul(input, self._weight_ih, transpose_y=True) \
+                + self._bias_ih
+            hg = pt.matmul(pre_hidden, self._weight_hh, transpose_y=True) \
+                + self._bias_hh
+            ir, iu, ic = pt.split(ig, 3, axis=1)
+            hr, hu, hc = pt.split(hg, 3, axis=1)
+            r = self._gate_activation(ir + hr)
+            u = self._gate_activation(iu + hu)
+            cand = self._activation(ic + r * hc)
+            new_h = (pre_hidden - cand) * u + cand
+        else:
+            gate = self._gate_activation(
+                pt.matmul(pt.concat([input, pre_hidden], 1),
+                          self._gate_weight) + self._gate_bias)
+            r, u = pt.split(gate, 2, axis=1)
+            cand = self._activation(
+                pt.matmul(pt.concat([input, r * pre_hidden], 1),
+                          self._candidate_weight) + self._candidate_bias)
+            new_h = u * pre_hidden + (1 - u) * cand
+        return new_h
+
+
+# --- dygraph/jit.py parity ---------------------------------------------------
+
+def declarative(function=None, input_spec=None):
+    """reference dygraph/jit.py:declarative — decorator converting a
+    dygraph function to a compiled static one (alias era of
+    jit.to_static)."""
+    return to_static(function, input_spec=input_spec)
+
+
+def dygraph_to_static_func(dygraph_func):
+    """reference dygraph/jit.py:dygraph_to_static_func — converts
+    imperative code for use while building a static Program. Here the
+    same AST conversion that backs to_static handles both uses."""
+    return to_static(dygraph_func)
+
+
+# --- dygraph/profiler.py parity ----------------------------------------------
+
+def start_gperf_profiler():
+    """reference dygraph/profiler.py:start_gperf_profiler (gperftools) —
+    mapped to the jax trace profiler."""
+    from ..utils.profiler import start_profiler
+    start_profiler()
+
+
+def stop_gperf_profiler():
+    from ..utils.profiler import stop_profiler
+    stop_profiler()

@@ -1,0 +1,207 @@
+"""fluid.dygraph learning-rate decay classes.
+
+Reference: python/paddle/fluid/dygraph/learning_rate_scheduler.py. These
+are the 1.x dygraph-era schedules: the object is passed as
+``learning_rate=`` to an optimizer, and each optimizer step CALLS it —
+computing the lr at the current ``step_num`` and then advancing
+``step_num`` by ``step_size``. They differ from ``optimizer.lr``'s 2.x
+``LRScheduler`` protocol (user-driven ``scheduler.step()`` per epoch),
+so they are distinct classes, not aliases.
+
+TPU-first redesign: the schedule is host-side float math (the reference
+built one-op LR sub-graphs returning Variables). The optimizer refreshes
+its device-resident lr tensor from the float before each update, so
+compiled steps still read lr as input state — no retrace per decay step.
+"""
+import math
+
+__all__ = [
+    "NoamDecay", "PiecewiseDecay", "NaturalExpDecay", "ExponentialDecay",
+    "InverseTimeDecay", "PolynomialDecay", "CosineDecay", "LinearLrWarmup",
+]
+
+
+class LearningRateDecay:
+    """Base class (reference learning_rate_scheduler.py:LearningRateDecay):
+    __call__ = compute lr at step_num, then advance."""
+
+    def __init__(self, begin=0, step=1, dtype="float32"):
+        self.step_num = begin
+        self.step_size = step
+        self.dtype = dtype
+
+    def __call__(self):
+        lr = self.step()
+        self.step_num += self.step_size
+        return float(lr)
+
+    def create_lr_var(self, lr):
+        # The reference materialized a [1] Variable; host float math
+        # keeps the schedule out of the compiled graph here.
+        return float(lr)
+
+    def step(self):
+        raise NotImplementedError
+
+
+class PiecewiseDecay(LearningRateDecay):
+    """reference learning_rate_scheduler.py:PiecewiseDecay."""
+
+    def __init__(self, boundaries, values, begin, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.boundaries = list(boundaries)
+        self.values = list(values)
+
+    def step(self):
+        for i, b in enumerate(self.boundaries):
+            if self.step_num < b:
+                return self.values[i]
+        return self.values[len(self.boundaries)]
+
+
+class NaturalExpDecay(LearningRateDecay):
+    """reference learning_rate_scheduler.py:NaturalExpDecay."""
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        div = self.step_num / self.decay_steps
+        if self.staircase:
+            div = math.floor(div)
+        return self.learning_rate * math.exp(-self.decay_rate * div)
+
+
+class ExponentialDecay(LearningRateDecay):
+    """reference learning_rate_scheduler.py:ExponentialDecay."""
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        div = self.step_num / self.decay_steps
+        if self.staircase:
+            div = math.floor(div)
+        return self.learning_rate * (self.decay_rate ** div)
+
+
+class InverseTimeDecay(LearningRateDecay):
+    """reference learning_rate_scheduler.py:InverseTimeDecay."""
+
+    def __init__(self, learning_rate, decay_steps, decay_rate,
+                 staircase=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def step(self):
+        div = self.step_num / self.decay_steps
+        if self.staircase:
+            div = math.floor(div)
+        return self.learning_rate / (1 + self.decay_rate * div)
+
+
+class PolynomialDecay(LearningRateDecay):
+    """reference learning_rate_scheduler.py:PolynomialDecay (incl. the
+    cycle branch's div_res=1 special case at step 0)."""
+
+    def __init__(self, learning_rate, decay_steps, end_learning_rate=1e-4,
+                 power=1.0, cycle=False, begin=0, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.decay_steps = decay_steps
+        self.end_learning_rate = end_learning_rate
+        self.power = power
+        self.cycle = cycle
+
+    def step(self):
+        step_num = self.step_num
+        decay_steps = self.decay_steps
+        if self.cycle:
+            div = math.ceil(step_num / float(decay_steps))
+            if step_num == 0:
+                div = 1.0
+            decay_steps = decay_steps * div
+        else:
+            step_num = min(step_num, decay_steps)
+        return ((self.learning_rate - self.end_learning_rate) *
+                (1 - step_num / decay_steps) ** self.power +
+                self.end_learning_rate)
+
+
+class CosineDecay(LearningRateDecay):
+    """reference learning_rate_scheduler.py:CosineDecay — epoch-granular:
+    lr = base * 0.5 * (cos(cur_epoch*pi/epochs) + 1) with
+    cur_epoch = floor(step_num / step_each_epoch). NOT the same curve as
+    optimizer.lr.CosineAnnealingDecay (continuous T_max schedule)."""
+
+    def __init__(self, learning_rate, step_each_epoch, epochs, begin=0,
+                 step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.step_each_epoch = step_each_epoch
+        self.epochs = epochs
+
+    def step(self):
+        cur_epoch = math.floor(self.step_num / self.step_each_epoch)
+        return self.learning_rate * 0.5 * (
+            math.cos(cur_epoch * math.pi / self.epochs) + 1)
+
+
+class NoamDecay(LearningRateDecay):
+    """reference learning_rate_scheduler.py:NoamDecay."""
+
+    def __init__(self, d_model, warmup_steps, begin=1, step=1,
+                 dtype="float32", learning_rate=1.0):
+        super().__init__(begin, step, dtype)
+        self.learning_rate = learning_rate
+        self.d_model = d_model
+        self.warmup_steps = warmup_steps
+
+    def step(self):
+        a = self.step_num ** -0.5
+        b = (self.warmup_steps ** -1.5) * self.step_num
+        return self.learning_rate * (self.d_model ** -0.5) * min(a, b)
+
+
+class LinearLrWarmup(LearningRateDecay):
+    """reference learning_rate_scheduler.py:LinearLrWarmup. Matches the
+    reference CODE during warmup (lr = ratio * step_num, i.e. a ramp
+    from ~0 — its docstring's `start_lr +` term is not in its code);
+    after warmup returns the wrapped schedule/float."""
+
+    def __init__(self, learning_rate, warmup_steps, start_lr, end_lr,
+                 begin=1, step=1, dtype="float32"):
+        super().__init__(begin, step, dtype)
+        if not isinstance(learning_rate, (int, float, LearningRateDecay)):
+            raise TypeError(
+                "the type of learning_rate should be [int, float or "
+                "LearningRateDecay], the current type is "
+                f"{type(learning_rate)}")
+        self.learning_rate = learning_rate
+        self.warmup_steps = warmup_steps
+        if not end_lr > start_lr:
+            raise AssertionError(
+                f"end_lr {end_lr} must be greater than start_lr {start_lr}")
+        self.lr_ratio_before_warmup = (
+            float(end_lr) - float(start_lr)) / float(warmup_steps)
+
+    def step(self):
+        base_lr = self.learning_rate
+        if isinstance(self.learning_rate, LearningRateDecay):
+            base_lr = base_lr()
+        if self.step_num < self.warmup_steps:
+            return self.lr_ratio_before_warmup * self.step_num
+        return base_lr

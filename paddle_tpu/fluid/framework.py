@@ -47,6 +47,60 @@ def require_version(min_version, max_version=None):
     framework replaces the versioned C++ core)."""
 
 
+# --- FLAGS registry (reference framework.py:set_flags/get_flags) ------------
+# The reference's FLAGS_* are gflags read by the C++ core. Here a python
+# registry holds the values; flags with a live analogue apply a mapping
+# (everything else is stored + readable, so config code round-trips).
+_FLAGS = {
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_use_mkldnn": False,
+}
+
+
+def set_flags(flags):
+    """reference framework.py:set_flags."""
+    if not isinstance(flags, dict):
+        raise TypeError("flags in set_flags should be a dict")
+    for k, v in flags.items():
+        _FLAGS[k] = v
+        if k == "FLAGS_check_nan_inf":
+            import jax as _jax
+            _jax.config.update("jax_debug_nans", bool(v))
+
+
+def get_flags(flags):
+    """reference framework.py:get_flags — accepts a name or a
+    list/tuple of names; returns {name: value}."""
+    if isinstance(flags, str):
+        flags = [flags]
+    if not isinstance(flags, (list, tuple)):
+        raise TypeError(
+            "Flags in get_flags should be a list, tuple or string.")
+    out = {}
+    for k in flags:
+        if k not in _FLAGS:
+            raise ValueError(
+                f"Flag {k} cannot get its value through this function.")
+        out[k] = _FLAGS[k]
+    return out
+
+
+def load_op_library(lib_filename):
+    """reference framework.py:load_op_library — loads a custom-op .so
+    built against the CUDA/C++ core. That ABI does not exist here;
+    custom ops are jax-traceable python (paddle_tpu.dispatch.apply) or
+    Pallas kernels (paddle_tpu.ops.pallas), so loading a CUDA op
+    library is an explicit error, not a silent no-op."""
+    raise RuntimeError(
+        f"load_op_library({lib_filename!r}): CUDA custom-op libraries "
+        "target the reference's C++ core. Register custom ops as "
+        "jax-traceable functions (paddle_tpu.dispatch.apply) or Pallas "
+        "kernels (paddle_tpu.ops.pallas) instead.")
+
+
 # structural aliases: the Program redesign keeps Block/Operator as the
 # graph-node classes inside static/__init__.py
 from ..static import Block  # noqa: F401,E402

@@ -1,0 +1,29 @@
+"""fluid.input (reference: fluid/input.py) — the 1.6-era non-LoD
+one_hot/embedding entry points (same kernels as fluid.layers, new-style
+argument names)."""
+__all__ = ["one_hot", "embedding"]
+
+
+def one_hot(input, depth, allow_out_of_range=False):
+    """reference input.py:one_hot — ids → one-hot along a NEW last axis.
+    With allow_out_of_range, out-of-range ids produce all-zero rows
+    (jax's one_hot semantics natively); otherwise they are a user error
+    the reference checks at runtime — XLA cannot, so they also produce
+    zero rows rather than UB."""
+    from ..ops.manip import one_hot as _one_hot
+    out = _one_hot(input, depth)
+    # The reference appends depth after the trailing [..., 1] axis is
+    # squeezed; manip.one_hot already matches that contract.
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """reference input.py:embedding (v2 signature; the layers.embedding
+    twin keeps the LoD-era contract). is_sparse/is_distributed are
+    storage strategies of the reference's PS path — lookup semantics are
+    identical here (sharded storage is parallel/embedding.py's job)."""
+    from .layers import embedding as _embedding
+    return _embedding(input, size, is_sparse=is_sparse,
+                      padding_idx=padding_idx, param_attr=param_attr,
+                      dtype=dtype)

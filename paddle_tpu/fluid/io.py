@@ -1,2 +1,70 @@
-"""fluid.io facade (reference: fluid/io.py save/load surface)."""
+"""fluid.io facade (reference: fluid/io.py save/load surface, plus its
+`batch`/`shuffle` reader-decorator aliases of paddle.reader)."""
 from ..io import *  # noqa: F401,F403
+from ..reader import batch, shuffle  # noqa: F401
+from .data_feeder import PyReader  # noqa: F401
+
+
+def save(program, model_path):
+    """reference fluid/io.py:save — persist a Program's parameters
+    (".pdparams") and optimizer slot state (".pdopt", only when any
+    exists). The ".pdmodel" network description has no serialized-proto
+    analogue here: programs re-trace from python (jit/to_static), which
+    is the deployment path (inference.py Predictor)."""
+    import numpy as np
+    if not model_path or model_path.rsplit("/", 1)[-1] == "":
+        raise ValueError(f"model_path MUST be format of dirname/filename "
+                         f"[dirname\\filename in Windows system], but "
+                         f"received model_path is empty string: "
+                         f"{model_path!r}")
+    params = {n: np.asarray(v.numpy())
+              for n, v in program.param_vars.items()}
+    # write to the exact reference suffix: np.savez(str) would append
+    # .npz and break load's path arithmetic; a file object does not
+    with open(model_path + ".pdparams", "wb") as fh:
+        np.savez(fh, **params)
+    opt_state = {}
+    for oi, (opt, _) in enumerate(getattr(program, "optimizers", [])):
+        for sd_key, val in opt.state_dict().items():
+            if hasattr(val, "numpy"):
+                opt_state[f"opt{oi}@{sd_key}"] = np.asarray(val.numpy())
+    if opt_state:
+        with open(model_path + ".pdopt", "wb") as fh:
+            np.savez(fh, **opt_state)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """reference fluid/io.py:load — restore parameters saved by
+    fluid.save into the program's param holders, shape/dtype checked."""
+    import os
+    import numpy as np
+    path = model_path + ".pdparams"
+    if not os.path.exists(path):
+        path = model_path if os.path.exists(model_path) else path
+    with np.load(path) as data:
+        names = set(data.files)
+        targets = (
+            {getattr(v, "name", str(v)) for v in var_list}
+            if var_list is not None else None)
+        for n, holder in program.param_vars.items():
+            if targets is not None and n not in targets:
+                continue
+            if n not in names:
+                raise RuntimeError(f"parameter {n!r} not found in "
+                                   f"{path}")
+            arr = data[n]
+            if tuple(arr.shape) != tuple(holder.data.shape):
+                raise RuntimeError(
+                    f"shape mismatch for {n!r}: checkpoint "
+                    f"{arr.shape} vs program {tuple(holder.data.shape)}")
+            holder.set_value(arr)
+    opt_path = model_path + ".pdopt"
+    if os.path.exists(opt_path):
+        with np.load(opt_path) as data:
+            for oi, (opt, _) in enumerate(
+                    getattr(program, "optimizers", [])):
+                prefix = f"opt{oi}@"
+                state = {k[len(prefix):]: data[k] for k in data.files
+                         if k.startswith(prefix)}
+                if state:
+                    opt.set_state_dict(state)

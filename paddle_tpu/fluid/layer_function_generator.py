@@ -1,0 +1,76 @@
+"""fluid.layers.layer_function_generator (reference:
+fluid/layers/layer_function_generator.py).
+
+The reference generates layer functions from registered C++ OpProtos
+(one LayerHelper append_op wrapper per proto). Ops here are plain
+python functions lowering to jax, so "generation" is a registry lookup
+that attaches the same doc conventions."""
+import functools
+import warnings
+
+__all__ = [
+    "deprecated", "generate_layer_fn", "generate_activation_fn",
+    "autodoc", "templatedoc",
+]
+
+
+def _find_op(op_type):
+    import importlib
+    for modname in ("paddle_tpu.ops.math", "paddle_tpu.ops.nn_ops",
+                    "paddle_tpu.ops.manip", "paddle_tpu.ops.loss",
+                    "paddle_tpu.fluid.layers"):
+        mod = importlib.import_module(modname)
+        if hasattr(mod, op_type):
+            return getattr(mod, op_type)
+    return None
+
+
+def generate_layer_fn(op_type):
+    """reference layer_function_generator.py:generate_layer_fn — return
+    the layer function for a registered op type."""
+    fn = _find_op(op_type)
+    if fn is None:
+        raise ValueError(
+            f"no op named {op_type!r} is registered (ops are python "
+            "functions in paddle_tpu.ops.* / fluid.layers)")
+    return fn
+
+
+def generate_activation_fn(op_type):
+    """reference layer_function_generator.py:generate_activation_fn."""
+    from ..nn import functional as F
+    if hasattr(F, op_type):
+        return getattr(F, op_type)
+    return generate_layer_fn(op_type)
+
+
+def deprecated(func_or_class):
+    """reference layer_function_generator.py:deprecated — one-shot
+    DeprecationWarning wrapper."""
+    @functools.wraps(func_or_class)
+    def func_wrapper(*args, **kwargs):
+        warnings.warn(
+            f"API {func_or_class.__name__} is deprecated since 2.0.0",
+            DeprecationWarning, stacklevel=2)
+        return func_or_class(*args, **kwargs)
+    return func_wrapper
+
+
+def autodoc(comment=""):
+    """reference layer_function_generator.py:autodoc — prepend a
+    comment to the function's docstring."""
+    def __impl__(func):
+        func.__doc__ = comment + (func.__doc__ or "")
+        return func
+    return __impl__
+
+
+def templatedoc(op_type=None):
+    """reference layer_function_generator.py:templatedoc — the
+    reference substitutes ${comment} placeholders from the OpProto;
+    without protos this strips the placeholders so docs render clean."""
+    def __impl__(func):
+        doc = func.__doc__ or ""
+        func.__doc__ = doc.replace("${comment}", "").strip()
+        return func
+    return __impl__

@@ -18,6 +18,10 @@ from ..ops import loss as L
 from .. import initializer as I
 from ..param_attr import ParamAttr
 from ..static import data  # noqa: F401 (fluid.layers.data parity)
+from . import layer_function_generator  # noqa: F401
+from .layer_function_generator import (generate_layer_fn,  # noqa: F401
+                                       generate_activation_fn, autodoc,
+                                       templatedoc)
 from ..ops.control_flow import cond, while_loop, case, switch_case  # noqa
 from ..ops.imperative_flow import (IfElse, Switch, DynamicRNN,  # noqa: F401
                                    TensorArray, create_array, array_write,

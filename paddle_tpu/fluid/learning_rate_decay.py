@@ -1,0 +1,14 @@
+"""fluid.learning_rate_decay (reference: fluid/__init__.py re-exports
+layers/learning_rate_scheduler.py under this name) — the functional
+decay builders."""
+from ..optimizer.lr import (noam_decay, exponential_decay,  # noqa: F401
+                            piecewise_decay, cosine_decay,
+                            polynomial_decay, linear_lr_warmup)
+from .layers_extra2 import (natural_exp_decay,  # noqa: F401
+                            inverse_time_decay)
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]

@@ -1,0 +1,62 @@
+"""fluid.lod_tensor (reference: fluid/lod_tensor.py).
+
+LoD redesign note: this framework represents variable-length data as
+padded dense arrays + explicit lengths (TPU-friendly static shapes; see
+fluid/layers_rnn.py). These constructors keep the reference's API for
+code that builds LoDTensors directly: the result is a Tensor carrying
+the dense data plus a `.recursive_sequence_lengths()` accessor."""
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+class _LoDTensor(Tensor):
+    """Tensor + recursive sequence lengths (reference LoDTensor)."""
+
+    def set_recursive_sequence_lengths(self, lens):
+        self._recursive_seq_lens = [list(l) for l in lens]
+
+    def recursive_sequence_lengths(self):
+        return getattr(self, "_recursive_seq_lens", [])
+
+    def has_valid_recursive_sequence_lengths(self):
+        lens = self.recursive_sequence_lengths()
+        if not lens:
+            return False
+        # innermost level must sum to the outer dim of the data
+        return sum(lens[-1]) == int(self.shape[0])
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """reference lod_tensor.py:create_lod_tensor — build from a numpy
+    array / list / Tensor plus level-of-detail lengths."""
+    if isinstance(data, Tensor):
+        arr = data.numpy()
+    elif isinstance(data, list):
+        # list-of-lists: each sublist is one sequence step group
+        flat = np.concatenate(
+            [np.asarray(x).reshape(len(x), -1) for x in data])
+        new_lens = [len(x) for x in data]
+        if recursive_seq_lens and recursive_seq_lens[-1] != new_lens:
+            raise AssertionError(
+                "data and recursive_seq_lens do not match")
+        arr = flat
+    else:
+        arr = np.asarray(data)
+    t = _LoDTensor(arr, stop_gradient=True)
+    t.set_recursive_sequence_lengths(recursive_seq_lens)
+    if not t.has_valid_recursive_sequence_lengths():
+        raise AssertionError(
+            f"the provided recursive_seq_lens {recursive_seq_lens} is "
+            f"invalid for data of outer dim {t.shape[0]}")
+    return t
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place,
+                                low, high):
+    """reference lod_tensor.py:create_random_int_lodtensor."""
+    overall = [sum(recursive_seq_lens[-1])] + list(base_shape)
+    data = np.random.randint(low, high + 1, overall).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

@@ -1,0 +1,19 @@
+"""fluid.log_helper (reference: fluid/log_helper.py)."""
+import logging
+
+__all__ = ["get_logger"]
+
+
+def get_logger(name, level, fmt=None):
+    """reference log_helper.py:get_logger — named logger with its own
+    stream handler (does not propagate to root, so repeated calls don't
+    duplicate lines)."""
+    logger = logging.getLogger(name)
+    logger.setLevel(level)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        if fmt:
+            handler.setFormatter(logging.Formatter(fmt=fmt))
+        logger.addHandler(handler)
+    logger.propagate = False
+    return logger

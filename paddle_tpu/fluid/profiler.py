@@ -1,3 +1,18 @@
 """fluid.profiler facade (reference: fluid/profiler.py)."""
+import contextlib
+
 from ..utils.profiler import (profiler, start_profiler,  # noqa: F401
                               stop_profiler, reset_profiler, print_stats)
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """reference profiler.py:cuda_profiler — drives nvprof via the CUDA
+    runtime, which has no TPU analogue. Kept as an explicit error so
+    ported code fails with direction instead of AttributeError."""
+    raise RuntimeError(
+        "cuda_profiler drives nvprof (CUDA-only). Use "
+        "fluid.profiler.profiler(...) or "
+        "paddle_tpu.utils.profiler.start_profiler for the XLA trace "
+        "profiler, and summarize_trace for per-op device time.")
+    yield  # pragma: no cover

@@ -1,0 +1,8 @@
+"""fluid.reader (reference: fluid/reader.py) — PyReader and DataLoader
+entry points. The real implementations live in fluid.data_feeder
+(PyReader: queue + feed dicts) and paddle_tpu.io (DataLoader: the
+prefetching loader over the C++ native batcher)."""
+from .data_feeder import PyReader  # noqa: F401
+from ..io import DataLoader  # noqa: F401
+
+__all__ = ["PyReader", "DataLoader"]

@@ -103,6 +103,13 @@ class DownpourSGD(DeviceWorker):
     running geo-async staleness is not."""
 
 
+class DownpourSGDOPT(DeviceWorker):
+    """reference: device_worker.py:DownpourSGDOPT — DownpourSGD with the
+    unified accessor/optimizer config path. Same TPU redesign note as
+    DownpourSGD: sharded-embedding collective dp stands in for the PS
+    push/pull loop."""
+
+
 class Section(DeviceWorker):
     """reference: device_worker.py:Section — pipeline section worker;
     maps to parallel/pipeline.py stage programs."""
@@ -119,6 +126,7 @@ class TrainerFactory:
     _WORKERS = {
         "Hogwild": Hogwild,
         "DownpourSGD": DownpourSGD,
+        "DownpourSGDOPT": DownpourSGDOPT,
         "Section": Section,
     }
 

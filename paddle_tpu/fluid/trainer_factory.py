@@ -1,0 +1,87 @@
+"""fluid.trainer_factory (reference: fluid/trainer_factory.py) —
+TrainerFactory plus the FetchHandler monitoring pair. The factory
+itself lives in trainer_desc.py (one module owns the trainer/worker
+pairing); this module adds the periodic-fetch monitor."""
+import threading
+import time
+
+from .trainer_desc import TrainerFactory  # noqa: F401
+
+__all__ = ["TrainerFactory", "FetchHandler", "FetchHandlerMonitor"]
+
+
+class FetchHandler:
+    """reference trainer_factory.py:FetchHandler — subclass and override
+    handler(); the monitor calls it every period_secs with a dict of
+    fetched values."""
+
+    def __init__(self, var_dict=None, period_secs=60):
+        if var_dict is None:
+            raise ValueError("var_dict cannot be None")
+        self.var_dict = var_dict
+        self.period_secs = period_secs
+
+    def handler(self, res_dict):
+        for key in res_dict:
+            if isinstance(res_dict[key], list):
+                print(f"{key}[0]: {res_dict[key][0]}")
+
+    @staticmethod
+    def help():
+        print("""
+class FetchHandlerExample(FetchHandler):
+    def handler(self, res_dict):
+        print(res_dict["auc"])
+        print("auc: {}, {}".format(res_dict["auc"], time.ctime()))
+
+auc = Variable()
+var_dict = {"auc": auc}
+handler = FetchHandlerExample(var_dict=var_dict)
+""")
+
+
+class FetchHandlerMonitor:
+    """reference trainer_factory.py:FetchHandlerMonitor — a daemon
+    thread that periodically reads the handler's variables out of a
+    scope and calls handler(). Variables resolve through the scope's
+    name→Tensor dict (static.Scope)."""
+
+    def __init__(self, scope, handler):
+        self.fetch_instance = handler
+        self.fetch_thread = threading.Thread(
+            target=self.handler_launch_func,
+            args=(scope, handler), daemon=True)
+        self.running_lock = threading.Lock()
+        self.running = False
+
+    def handler_launch_func(self, scope, handler):
+        period = handler.period_secs
+        elapsed = 0.0
+        while True:
+            with self.running_lock:
+                if not self.running:
+                    break
+            if elapsed < period:
+                time.sleep(1)
+                elapsed += 1
+                continue
+            elapsed = 0.0
+            res = {}
+            for key, var in handler.var_dict.items():
+                name = getattr(var, "name", str(var))
+                found = scope.find_var(name) if scope is not None else None
+                if found is None:
+                    res[key] = None
+                else:
+                    res[key] = found.numpy() if hasattr(found, "numpy") \
+                        else found
+            handler.handler(res)
+
+    def start(self):
+        with self.running_lock:
+            self.running = True
+        self.fetch_thread.start()
+
+    def stop(self):
+        with self.running_lock:
+            self.running = False

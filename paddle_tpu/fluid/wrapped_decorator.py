@@ -1,0 +1,28 @@
+"""fluid.wrapped_decorator (reference: fluid/wrapped_decorator.py) —
+signature-preserving decorator helpers used across the fluid surface."""
+import contextlib
+import functools
+
+__all__ = ["wrap_decorator", "signature_safe_contextmanager"]
+
+
+def wrap_decorator(decorator_func):
+    """reference wrapped_decorator.py:wrap_decorator — returns a
+    decorator whose wrapped function keeps the original's metadata
+    (the reference used the `decorator` package; functools.wraps gives
+    the py3-native equivalent)."""
+    @functools.wraps(decorator_func)
+    def __impl__(func):
+        wrapped = decorator_func(func)
+        if callable(wrapped):
+            try:
+                functools.update_wrapper(wrapped, func)
+            except (AttributeError, TypeError):
+                pass
+        return wrapped
+    return __impl__
+
+
+def signature_safe_contextmanager(func):
+    """reference wrapped_decorator.py:signature_safe_contextmanager."""
+    return functools.wraps(func)(contextlib.contextmanager(func))

@@ -1,7 +1,7 @@
 """paddle.imperative parity package (reference:
 python/paddle/imperative/__init__.py)."""
 from .fluid.dygraph import (enabled, guard, to_variable,  # noqa: F401
-                            TracedLayer)
+                            TracedLayer, BackwardStrategy)
 from .autograd import no_grad, grad  # noqa: F401
 from .nn import LayerList, ParameterList, Sequential  # noqa: F401
 from .io import save_dygraph as save  # noqa: F401
@@ -9,9 +9,3 @@ from .io import load_dygraph as load  # noqa: F401
 from .parallel.env import prepare_context  # noqa: F401
 
 
-class BackwardStrategy:
-    """reference imperative:BackwardStrategy — sort_sum_gradient has no
-    effect here (the tape sums in deterministic order already)."""
-
-    def __init__(self):
-        self.sort_sum_gradient = False

@@ -47,10 +47,20 @@ class Optimizer:
             wd = L2Decay(float(wd))
         self._regularization = wd
         self._lr_scheduler = None
+        self._lr_decay = None
+        from ..fluid.dygraph_lr import LearningRateDecay
         if isinstance(learning_rate, LRScheduler):
             self._lr_scheduler = learning_rate
             learning_rate._owner = self
             lr_value = learning_rate.last_lr
+        elif isinstance(learning_rate, LearningRateDecay):
+            # 1.x dygraph decay protocol: the OPTIMIZER calls the decay
+            # each step (reference optimizer.py dygraph minimize path),
+            # vs LRScheduler's user-driven scheduler.step().
+            self._lr_decay = learning_rate
+            # current-step value WITHOUT advancing (step() computes,
+            # __call__ advances), so get_lr() is right before training
+            lr_value = float(learning_rate.step())
         else:
             lr_value = float(learning_rate)
         # lr lives on device so compiled steps treat it as input state
@@ -103,6 +113,10 @@ class Optimizer:
     def step(self):
         """Apply one update from accumulated .grad (reference: dygraph
         minimize path in optimizer.py:Optimizer.apply_gradients)."""
+        if self._lr_decay is not None:
+            # host-side schedule: advance + refresh the device lr tensor
+            # (under jit the tensor is input state, so no retrace)
+            self._set_lr_value(self._lr_decay())
         params_grads = [(p, p._grad) for p in self._params()
                         if not (p.stop_gradient or p._grad is None)]
         # reference order (optimizer.py:apply_gradients): clip raw grads
@@ -166,6 +180,15 @@ class Optimizer:
         records this optimizer instead (see paddle_tpu.static)."""
         from ..dispatch import in_static_mode
         if in_static_mode():
+            if self._lr_decay is not None:
+                # the static Executor never calls step(), so the decay
+                # would silently pin lr at its first value — the
+                # reference raises for this lr type in static graphs too
+                raise TypeError(
+                    "1.x dygraph LearningRateDecay objects are "
+                    "dygraph-only; in static mode use the functional "
+                    "decays (fluid.layers.exponential_decay, ...) or an "
+                    "optimizer.lr.LRScheduler")
             from ..static import record_optimizer
             return record_optimizer(self, loss)
         if loss is not None and loss._tape_node is not None and all(
@@ -193,6 +216,8 @@ class Optimizer:
         out["__aux__"] = dict(self._aux_state)
         if self._lr_scheduler is not None:
             out["__lr_sched__"] = self._lr_scheduler.state_dict()
+        if self._lr_decay is not None:
+            out["__lr_decay__"] = {"step_num": self._lr_decay.step_num}
         return out
 
     def set_state_dict(self, state):
@@ -215,6 +240,8 @@ class Optimizer:
             self._aux_state.update(state["__aux__"])
         if "__lr_sched__" in state and self._lr_scheduler is not None:
             self._lr_scheduler.set_state_dict(state["__lr_sched__"])
+        if "__lr_decay__" in state and self._lr_decay is not None:
+            self._lr_decay.step_num = state["__lr_decay__"]["step_num"]
 
 
 # ---------------------------------------------------------------------------

@@ -454,6 +454,36 @@ class Executor:
             return [np.asarray(jax.device_get(f)) for f in fetches]
         return [Tensor(f) for f in fetches]
 
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference executor.py:train_from_dataset — run the program
+        over every batch a fluid.dataset yields. The reference spawns
+        C++ DataFeed threads; here each host-assembled MultiSlot batch
+        goes through the same compiled run() path as any feed (one
+        executable, cached per feed signature)."""
+        if dataset is None:
+            raise RuntimeError("dataset is required for train_from_dataset")
+        fetch_list = fetch_list or []
+        fetch_info = fetch_info or [getattr(v, "name", str(v))
+                                    for v in fetch_list]
+        for i, batch in enumerate(dataset._batches()):
+            outs = self.run(program, feed=batch, fetch_list=fetch_list,
+                            scope=scope)
+            if debug and fetch_list and i % max(print_period, 1) == 0:
+                msg = ", ".join(f"{n}={np.asarray(o).ravel()[:1]}"
+                                for n, o in zip(fetch_info, outs))
+                print(f"batch {i}: {msg}", flush=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """reference executor.py:infer_from_dataset — same loop; the
+        program carries no optimizer ops so run() only evaluates."""
+        return self.train_from_dataset(program, dataset, scope, thread,
+                                       debug, fetch_list, fetch_info,
+                                       print_period)
+
     def _compile(self, program, fetch_names, feed_order, param_names,
                  slot_names):
         ops = list(program.global_block().ops)

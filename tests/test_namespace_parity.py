@@ -9,37 +9,108 @@ import paddle_tpu as pt
 from paddle_tpu import nn, optimizer, metric, static, fluid
 
 
-def test_all_reference_names_resolve():
-    import ast
-    import jax
+# Names that deliberately do NOT resolve, each with the reason. Keep
+# this list short and honest — everything else in every reference
+# __all__ must resolve (mechanical sweep below).
+_PARITY_ALLOWLIST = {
+    # none currently: CUDA-only surfaces (cuda_profiler,
+    # load_op_library) resolve as explicit-error stubs that explain
+    # their TPU replacement rather than being absent.
+}
 
-    def get_all(path):
-        tree = ast.parse(open(path).read())
-        for node in ast.walk(tree):
-            if isinstance(node, ast.Assign):
-                for t in node.targets:
-                    if isinstance(t, ast.Name) and t.id == "__all__":
-                        try:
-                            return [ast.literal_eval(e)
-                                    for e in node.value.elts]
-                        except Exception:
-                            return []
+
+def _reference_all_names(path):
+    """Every string literal inside list literals assigned/augmented to
+    __all__ (covers `__all__ = [...]`, `__all__ = a.__all__ + [...]`,
+    and `__all__ += [...]` — the dynamic `x.__all__` parts are covered
+    by sweeping each submodule's own file)."""
+    import ast
+    try:
+        tree = ast.parse(open(path, encoding="utf-8",
+                              errors="replace").read())
+    except SyntaxError:
         return []
+    names = []
+
+    def literals(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.List):
+                for e in sub.elts:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str):
+                        names.append(e.value)
+
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "__all__":
+                    target = node.value
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Name) and \
+                    node.target.id == "__all__":
+                target = node.value
+        if target is not None:
+            literals(target)
+    return names
+
+
+def _resolve(dotted):
+    """Import the longest importable prefix, then walk attributes."""
+    import importlib
+    parts = dotted.split(".")
+    for k in range(len(parts), 1, -1):
+        try:
+            obj = importlib.import_module(".".join(parts[:k]))
+        except ImportError:
+            continue
+        try:
+            for p in parts[k:]:
+                obj = getattr(obj, p)
+            return obj
+        except AttributeError:
+            continue
+    return None
+
+
+def test_every_reference_fluid_all_name_resolves():
+    """Mechanical sweep (VERDICT r4 task 5): for EVERY module under
+    reference fluid/, fluid/dygraph/, and fluid/layers/, each __all__
+    name must resolve at the same module path in paddle_tpu — or at the
+    parent package level, which is where reference users consume
+    star-imported names (fluid.dygraph.nn.Conv2D is used as
+    fluid.dygraph.Conv2D)."""
+    import os
 
     ref_root = "/root/reference/python/paddle/fluid"
-    checks = [("optimizer.py", optimizer),
-              ("initializer.py", pt.initializer),
-              ("metrics.py", metric), ("clip.py", fluid.clip),
-              ("dygraph/nn.py", nn), ("backward.py", static),
-              ("regularizer.py", pt.regularizer)]
+    sweeps = [(ref_root, "paddle_tpu.fluid"),
+              (os.path.join(ref_root, "dygraph"),
+               "paddle_tpu.fluid.dygraph"),
+              (os.path.join(ref_root, "layers"),
+               "paddle_tpu.fluid.layers")]
     missing = []
-    for f, mod in checks:
-        try:
-            names = get_all(f"{ref_root}/{f}")
-        except FileNotFoundError:
-            continue
-        missing += [f"{f}:{n}" for n in names if not hasattr(mod, n)]
-    assert missing == [], missing
+    checked = 0
+    for base, target_pkg in sweeps:
+        for fname in sorted(os.listdir(base)):
+            if not fname.endswith(".py"):
+                continue
+            names = _reference_all_names(os.path.join(base, fname))
+            if not names:
+                continue
+            mod_path = target_pkg if fname == "__init__.py" else \
+                f"{target_pkg}.{fname[:-3]}"
+            mod = _resolve(mod_path)
+            parent = _resolve(target_pkg)
+            for n in names:
+                checked += 1
+                if n in _PARITY_ALLOWLIST:
+                    continue
+                if (mod is not None and hasattr(mod, n)) or \
+                        (parent is not None and hasattr(parent, n)):
+                    continue
+                missing.append(f"{mod_path}:{n}")
+    assert checked > 500, f"sweep only found {checked} names — broken?"
+    assert missing == [], f"{len(missing)} missing: {missing}"
 
 
 def test_conv3d_transpose_layer():
