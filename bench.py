@@ -69,6 +69,15 @@ def _probe_pallas_kernels():
                                         0.9, 0.999)
         new_p.block_until_ready()
 
+    def fused_adam_multi():
+        from paddle_tpu.ops.pallas.fused_adam import fused_adam_update_multi
+        ps = [jnp.ones((512, 768), jnp.float32),
+              jnp.ones((768,), jnp.float32)]
+        nps, _, _ = fused_adam_update_multi(
+            ps, [p * 0.01 for p in ps], [p * 0 for p in ps],
+            [p * 0 for p in ps], 1e-3, 0.9, 0.999)
+        nps[0].block_until_ready()
+
     def softmax_xent():
         # 8192 rows = the real bench shape (batch 64 × seq 128): the r4
         # VMEM blow-up was shape-dependent and a 256-row probe missed it
@@ -84,6 +93,7 @@ def _probe_pallas_kernels():
     for name, probe in (("flash_attention", flash),
                         ("layer_norm", layer_norm),
                         ("fused_adam", fused_adam),
+                        ("fused_adam_multi", fused_adam_multi),
                         ("softmax_xent", softmax_xent)):
         if not P.enabled(name):
             continue  # auto-off kernel: no bench stage can reach it

@@ -27,8 +27,8 @@ def on_tpu():
 # on the live device and disables just the ones that fail to compile,
 # instead of losing the whole run.
 _overrides = {}
-_KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent",
-            "batch_norm")
+_KERNELS = ("layer_norm", "fused_adam", "fused_adam_multi",
+            "flash_attention", "softmax_xent", "batch_norm")
 
 # Measured auto defaults (v5e, BERT-base ablation, docs/perf_r04.md):
 # layer_norm is the only unconditional win (+0.4%); fused_adam loses
@@ -41,9 +41,12 @@ _KERNELS = ("layer_norm", "fused_adam", "flash_attention", "softmax_xent",
 # batch_norm: built to attack the ResNet trace's BN-bound 70% (see
 # docs/perf_r04.md), auto-off until scripts/bench_pallas_bn.py proves it
 # beats the (already once-fixed) XLA schedule on the chip.
+# fused_adam_multi: ONE dispatch over concatenated buffers (r5; the
+# r4-measured -13.6% was the per-tensor dispatch) — auto-off until
+# scripts/bench_adam_multi.py proves it beats XLA's own update fusion.
 _AUTO_ON = {"layer_norm": True, "flash_attention": True,
-            "fused_adam": False, "softmax_xent": False,
-            "batch_norm": False}
+            "fused_adam": False, "fused_adam_multi": False,
+            "softmax_xent": False, "batch_norm": False}
 
 
 # flash is an O(S^2)-score win: below some sequence length the XLA sdpa

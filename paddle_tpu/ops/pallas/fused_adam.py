@@ -98,6 +98,92 @@ def fused_adam_update(p, g, m, v, lr, beta1_pow, beta2_pow, beta1=0.9,
             unflat(new_v, jnp.float32))
 
 
+def _adam_multi_kernel(scal_ref, p_ref, g_ref, m_ref, v_ref, po_ref,
+                       mo_ref, vo_ref, *, beta1, beta2, eps):
+    lr = scal_ref[0]
+    b1p = scal_ref[1]
+    b2p = scal_ref[2]
+    wd = scal_ref[3]
+    g = g_ref[:]
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    mhat = m / (1.0 - b1p)
+    vhat = v / (1.0 - b2p)
+    p = p_ref[:]
+    po_ref[:] = p - lr * mhat / (jnp.sqrt(vhat) + eps) - (lr * wd) * p
+    mo_ref[:] = m
+    vo_ref[:] = v
+
+
+def fused_adam_update_multi(ps, gs, ms, vs, lr, beta1_pow, beta2_pow,
+                            beta1=0.9, beta2=0.999, eps=1e-8,
+                            weight_decay=0.0):
+    """Multi-tensor fused update (reference: adam_op.cu's multi-tensor
+    FusedAdamKernel intent): ONE Pallas dispatch over every parameter,
+    via flattened+concatenated f32 buffers, instead of one dispatch per
+    tensor. Decoupled weight decay (AdamW) folds into the same pass.
+
+    Layout note: the concat offsets are python-side values derived from
+    static shapes, so they are "built once per trace" — jit.to_static's
+    structure-version cache already guarantees a retrace (and thus a
+    new layout) only when the param set changes.
+
+    Semantics note: beta-pow bias correction is SHARED across tensors
+    (the reference's multi-tensor kernel also carries one beta1_pow/
+    beta2_pow). Identical to per-tensor updates whenever all params
+    step together — the SPMD/jit training reality; per-tensor pows that
+    diverged via selective freezing are not representable here.
+
+    Returns (new_ps, new_ms, new_vs) with original shapes/dtypes."""
+    from . import interpret_mode
+    cols = 128
+    sizes = [int(np.prod(p.shape)) if p.shape else 1 for p in ps]
+    rows_each = [-(-n // cols) for n in sizes]  # per-tensor row padding
+    offsets = np.cumsum([0] + rows_each)
+    rows = int(offsets[-1])
+
+    def flat_cat(xs, dtype=jnp.float32):
+        parts = []
+        for x, n, r in zip(xs, sizes, rows_each):
+            x = x.reshape(-1).astype(dtype)
+            pad = r * cols - n
+            if pad:
+                x = jnp.concatenate([x, jnp.zeros((pad,), dtype)])
+            parts.append(x.reshape(r, cols))
+        return jnp.concatenate(parts, axis=0)
+
+    scal = jnp.stack([jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(beta1_pow, jnp.float32),
+                      jnp.asarray(beta2_pow, jnp.float32),
+                      jnp.asarray(weight_decay, jnp.float32)])
+
+    br = min(rows, 1024)  # same scoped-VMEM budget as the single path
+    new_p, new_m, new_v = pl.pallas_call(
+        functools.partial(_adam_multi_kernel, beta1=beta1, beta2=beta2,
+                          eps=eps),
+        grid=(pl.cdiv(rows, br),),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [
+            pl.BlockSpec((br, cols), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)] * 4,
+        out_specs=[pl.BlockSpec((br, cols), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((rows, cols), jnp.float32)] * 3,
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret_mode(),
+    )(scal, flat_cat(ps), flat_cat(gs), flat_cat(ms), flat_cat(vs))
+
+    def split(buf, refs, dtype_from=None):
+        outs = []
+        for i, (n, x) in enumerate(zip(sizes, refs)):
+            seg = buf[offsets[i]:offsets[i + 1]].reshape(-1)[:n]
+            outs.append(seg.reshape(x.shape).astype(
+                x.dtype if dtype_from else jnp.float32))
+        return outs
+
+    return (split(new_p, ps, dtype_from=True), split(new_m, ms),
+            split(new_v, vs))
+
+
 def adam_step(p, g, m, v, lr, beta1_pow, beta2_pow, *, beta1=0.9,
               beta2=0.999, eps=1e-8, use_fused=None):
     """THE Adam update rule, shared by optimizer.Adam and the fleet/

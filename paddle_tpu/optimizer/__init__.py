@@ -147,6 +147,9 @@ class Optimizer:
             regularized.append((p, g))
         params_grads = regularized
         lr = self._lr_tensor.data
+        if self._batched_update(params_grads, lr):
+            self._post_step()
+            return
         for p, g in params_grads:
             if g is None:
                 continue
@@ -158,6 +161,12 @@ class Optimizer:
             for n, v in new_slots.items():
                 self._slot(p, n).data = v
         self._post_step()
+
+    def _batched_update(self, params_grads, lr):
+        """Hook: apply ALL updates in one dispatch (multi-tensor
+        kernels). Return True if handled; False falls through to the
+        per-param _rule loop. Base: no batched path."""
+        return False
 
     def _ensure_all_slots(self):
         """Create every accumulator eagerly (used by jit.to_static so slot
@@ -387,13 +396,14 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, lazy_mode=False,
-                 use_fused=None, **kw):
+                 use_fused=None, use_multi_tensor=None, **kw):
         super().__init__(learning_rate, parameters, **kw)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         # None = auto, resolved via pallas.enabled() when the step traces
         # (configure() before the first jitted step; traced steps keep
         # the choice they were compiled with)
         self._use_fused = use_fused
+        self._use_multi_tensor = use_multi_tensor
 
     def _pre_param(self, p):
         self._slot(p, "moment1")
@@ -411,6 +421,38 @@ class Adam(Optimizer):
                                 use_fused=self._use_fused)
         return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
                        "beta2_pow": b2p}
+
+    def _batched_update(self, params_grads, lr):
+        """Multi-tensor path (reference adam_op.cu FusedAdamKernel):
+        one Pallas dispatch updates every param. Shared beta-pow bias
+        correction — see fused_adam_update_multi's semantics note."""
+        use = self._use_multi_tensor
+        if use is None:
+            from ..ops import pallas as P
+            use = P.enabled("fused_adam_multi")
+        live = [(p, g) for p, g in params_grads if g is not None]
+        if not use or len(live) < 2:
+            return False
+        from ..ops.pallas.fused_adam import fused_adam_update_multi
+        for p, _ in live:
+            self._pre_param(p)
+        slots = [self._accumulators[id(p)] for p, _ in live]
+        b1p = slots[0]["beta1_pow"].data * self._beta1
+        b2p = slots[0]["beta2_pow"].data * self._beta2
+        new_ps, new_ms, new_vs = fused_adam_update_multi(
+            [p.data for p, _ in live], [g for _, g in live],
+            [s["moment1"].data for s in slots],
+            [s["moment2"].data for s in slots],
+            lr, b1p, b2p, beta1=self._beta1, beta2=self._beta2,
+            eps=self._eps, weight_decay=getattr(self, "_wd", 0.0))
+        for (p, _), s, np_, nm, nv in zip(live, slots, new_ps, new_ms,
+                                          new_vs):
+            p.data = np_
+            s["moment1"].data = nm
+            s["moment2"].data = nv
+            s["beta1_pow"].data = b1p
+            s["beta2_pow"].data = b2p
+        return True
 
 
 class AdamW(Adam):

@@ -464,10 +464,28 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
             tf = t.astype(jnp.float32)
             b1p = jnp.power(cfg.beta1, tf)
             b2p = jnp.power(cfg.beta2, tf)
-            new_params, new_opt = {}, {}
-            for k in params_local:
-                new_params[k], new_opt[k] = _adam_update(
-                    params_local[k], grads[k], state["opt"][k], b1p, b2p)
+            from ..ops import pallas as _P
+            if _P.enabled("fused_adam_multi"):
+                # same multi-tensor rule as Optimizer.Adam: one dispatch
+                # over every LOCAL shard (slot state sharded like params)
+                from ..ops.pallas.fused_adam import fused_adam_update_multi
+                keys = list(params_local)
+                nps, nms, nvs = fused_adam_update_multi(
+                    [params_local[k] for k in keys],
+                    [grads[k] for k in keys],
+                    [state["opt"][k]["m"] for k in keys],
+                    [state["opt"][k]["v"] for k in keys],
+                    cfg.lr, b1p, b2p, beta1=cfg.beta1, beta2=cfg.beta2,
+                    eps=cfg.adam_eps)
+                new_params = dict(zip(keys, nps))
+                new_opt = {k: {"m": m, "v": v}
+                           for k, m, v in zip(keys, nms, nvs)}
+            else:
+                new_params, new_opt = {}, {}
+                for k in params_local:
+                    new_params[k], new_opt[k] = _adam_update(
+                        params_local[k], grads[k], state["opt"][k], b1p,
+                        b2p)
         else:
             new_params = jax.tree_util.tree_map(
                 lambda p, g: p - cfg.lr * g, params_local, grads)

@@ -660,3 +660,78 @@ def test_fused_batch_norm_gated_in_layer():
     o2 = run(False)
     for a, b_ in zip(o1, o2):
         np.testing.assert_allclose(a, b_, atol=3e-4)
+
+
+def test_fused_adam_multi_matches_per_tensor():
+    """Multi-tensor kernel == the plain-XLA per-tensor math (shared
+    beta pows, mixed shapes incl. scalar-ish and non-128-aligned)."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.fused_adam import (
+        adam_step, fused_adam_update_multi)
+
+    rng = np.random.RandomState(0)
+    shapes = [(3, 5), (17,), (2, 2, 2), (1,)]
+    ps = [jnp.asarray(rng.randn(*s).astype("f4")) for s in shapes]
+    gs = [jnp.asarray(rng.randn(*s).astype("f4")) for s in shapes]
+    ms = [jnp.asarray(rng.rand(*s).astype("f4")) for s in shapes]
+    vs = [jnp.asarray(rng.rand(*s).astype("f4")) for s in shapes]
+    lr, b1p, b2p = 0.01, 0.9, 0.999
+
+    nps, nms, nvs = fused_adam_update_multi(ps, gs, ms, vs, lr, b1p, b2p)
+    for i in range(len(shapes)):
+        ep, em, ev = adam_step(ps[i], gs[i], ms[i], vs[i], lr, b1p, b2p,
+                               use_fused=False)
+        np.testing.assert_allclose(np.asarray(nps[i]), np.asarray(ep),
+                                   rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(nms[i]), np.asarray(em),
+                                   rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(nvs[i]), np.asarray(ev),
+                                   rtol=2e-5, atol=1e-6)
+
+
+def test_fused_adam_multi_weight_decay():
+    """Decoupled wd inside the kernel == AdamW's p - lr*wd*p term."""
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.fused_adam import (
+        adam_step, fused_adam_update_multi)
+    rng = np.random.RandomState(1)
+    p = jnp.asarray(rng.randn(4, 4).astype("f4"))
+    g = jnp.asarray(rng.randn(4, 4).astype("f4"))
+    m = jnp.zeros((4, 4), jnp.float32)
+    v = jnp.zeros((4, 4), jnp.float32)
+    lr, wd = 0.01, 0.1
+    nps, _, _ = fused_adam_update_multi([p], [g], [m], [v], lr, 0.9,
+                                        0.999, weight_decay=wd)
+    ep, _, _ = adam_step(p, g, m, v, lr, 0.9, 0.999, use_fused=False)
+    expect = np.asarray(ep) - lr * wd * np.asarray(p)
+    np.testing.assert_allclose(np.asarray(nps[0]), expect, rtol=2e-5,
+                               atol=1e-6)
+
+
+def test_adam_optimizer_multi_tensor_path():
+    """optimizer.AdamW(use_multi_tensor=True) trains identically to the
+    per-tensor path (all params stepping together)."""
+    from paddle_tpu import nn, optimizer
+
+    def build():
+        pt.seed(3)
+        m = nn.Sequential(nn.Linear(6, 8), nn.ReLU(), nn.Linear(8, 2))
+        return m
+
+    x = pt.to_tensor(np.random.RandomState(2).randn(4, 6).astype("f4"))
+    y = pt.to_tensor(np.random.RandomState(3).randn(4, 2).astype("f4"))
+
+    results = []
+    for multi in (False, True):
+        m = build()
+        o = optimizer.AdamW(learning_rate=1e-2,
+                            parameters=m.parameters(),
+                            weight_decay=0.01, use_multi_tensor=multi)
+        for _ in range(4):
+            loss = pt.nn.functional.mse_loss(m(x), y)
+            loss.backward()
+            o.step()
+            o.clear_grad()
+        results.append([p.numpy().copy() for p in m.parameters()])
+    for a, b in zip(*results):
+        np.testing.assert_allclose(a, b, rtol=3e-5, atol=1e-6)
