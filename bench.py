@@ -158,19 +158,28 @@ def bench_bert(batch=64, seq=128, steps=32, inner=8, **cfg_kw):
     return batch * seq / dt, float(loss.numpy())
 
 
-def bench_resnet(batch=128, steps=12, inner=4):
+# Headline ResNet layout. scripts/bench_nhwc_resnet.py measures
+# NCHW vs NHWC vs NHWC+pallas-BN on chip; flip this (and the pallas
+# batch_norm auto default) to whatever wins there.
+RESNET_FORMAT = "NCHW"
+
+
+def bench_resnet(batch=128, steps=12, inner=4, data_format=None):
     """`inner` real steps per compiled call (distinct resident uint8
     batches, normalized on device) — see bench_bert."""
     import paddle_tpu as pt
     from paddle_tpu import nn, optimizer as opt, jit, amp
     from paddle_tpu.models.resnet import resnet50
 
+    data_format = data_format or RESNET_FORMAT
     pt.seed(0)
-    model = resnet50()
+    model = resnet50(data_format=data_format)
     o = opt.Momentum(learning_rate=0.1, momentum=0.9,
                      parameters=model.parameters())
     rng = np.random.RandomState(0)
-    x = (rng.rand(inner, batch, 3, 224, 224) * 255).astype("u1")
+    shape = (inner, batch, 3, 224, 224) if data_format == "NCHW" \
+        else (inner, batch, 224, 224, 3)
+    x = (rng.rand(*shape) * 255).astype("u1")
     y = rng.randint(0, 1000, (inner, batch)).astype("i4")
 
     def one(xb, yb):

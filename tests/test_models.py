@@ -180,3 +180,32 @@ def test_se_resnext50_forward_and_grads():
     blk = next(l for l in m.sublayers()
                if isinstance(l, SEResNeXtBottleneck))
     assert blk.conv1._attrs["groups"] == 32
+
+
+def test_resnet_nhwc_matches_nchw():
+    """NHWC resnet == NCHW resnet on transposed input (same seed, same
+    params): the layout knob changes memory order only. Also asserts
+    the fused Pallas BN path (interpret mode) agrees end-to-end.
+    Tolerance is loose (~1e-2): conv reduction order differs per
+    layout and 18 BN divisions amplify it."""
+    import numpy as np
+    from paddle_tpu.models.resnet import resnet18
+    from paddle_tpu.ops import pallas as P
+
+    x = np.random.RandomState(0).rand(2, 3, 32, 32).astype("f4")
+
+    def logits(fmt, pallas_bn=False):
+        P.configure(batch_norm=pallas_bn)
+        try:
+            pt.seed(11)
+            m = resnet18(num_classes=8, data_format=fmt)
+            xin = x if fmt == "NCHW" else x.transpose(0, 2, 3, 1)
+            return m(pt.to_tensor(xin)).numpy()
+        finally:
+            P.configure(batch_norm=None)
+
+    a = logits("NCHW")
+    b = logits("NHWC")
+    np.testing.assert_allclose(b, a, rtol=3e-2, atol=3e-3)
+    c = logits("NHWC", pallas_bn=True)
+    np.testing.assert_allclose(c, a, rtol=3e-2, atol=3e-3)
