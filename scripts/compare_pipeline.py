@@ -1,0 +1,124 @@
+"""PipelineStack (GSPMD stacked-scan) vs the explicit 1F1B executor at
+the SAME geometry on the virtual CPU mesh — the data behind
+docs/distributed.md's production-path decision (VERDICT r4 task 7).
+
+Geometry: 4 stages x 1 block/stage, hidden H, global batch B split into
+M microbatches for the executor; the stack consumes the full batch in
+one scan. Reports wall step-time (CPU-mesh proxy — ICI-free, so only
+the schedule/dispatch overheads differ, NOT collective time) plus the
+analytic schedule numbers (bubble fraction, peak live activations) that
+do transfer to real hardware.
+
+Run: python -u scripts/compare_pipeline.py
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+N_RANKS, N_MICRO, H, B = 4, 8, 256, 32
+STEPS = 20
+
+
+def _params(rng):
+    return {
+        "w": jnp.asarray(rng.randn(N_RANKS, H, H) * 0.1, jnp.float32),
+        "b": jnp.zeros((N_RANKS, H), jnp.float32),
+    }
+
+
+def run_stack():
+    """GSPMD path: full batch, stage-stacked weights, lax.scan; grads by
+    plain jax.grad; mesh pp4 shards the stacked axis."""
+    rng = np.random.RandomState(0)
+    params = _params(rng)
+    mesh = Mesh(np.asarray(jax.devices()[:N_RANKS]), ("pp",))
+    from jax.sharding import NamedSharding
+    params = {k: jax.device_put(v, NamedSharding(
+        mesh, P(*(("pp",) + (None,) * (v.ndim - 1)))))
+        for k, v in params.items()}
+    x = jnp.asarray(rng.randn(B, H), jnp.float32)
+    lab = jnp.asarray(rng.randn(B, H), jnp.float32)
+
+    def fwd(params, x):
+        def body(h, sl):
+            return h + jnp.tanh(h @ sl[0] + sl[1]), None
+        h, _ = jax.lax.scan(body, x, (params["w"], params["b"]))
+        return h
+
+    @jax.jit
+    def step(params, x, lab):
+        def loss_fn(p):
+            return jnp.mean((fwd(p, x) - lab) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        return loss, g
+
+    step(params, x, lab)[0].block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss, g = step(params, x, lab)
+    loss.block_until_ready()
+    return (time.perf_counter() - t0) / STEPS * 1e3, float(loss)
+
+
+def run_executor(kind="1f1b"):
+    """Explicit schedule: M microbatches over a ppermute ring."""
+    from paddle_tpu.parallel.pipeline import build_schedule, pipeline_step
+    rng = np.random.RandomState(0)
+    params = _params(rng)
+    sched = build_schedule(kind, N_RANKS, N_MICRO)
+    x = jnp.asarray(rng.randn(N_MICRO, B // N_MICRO, H), jnp.float32)
+    lab = jnp.asarray(rng.randn(N_MICRO, B // N_MICRO, H), jnp.float32)
+    mesh = Mesh(np.asarray(jax.devices()[:N_RANKS]), ("pp",))
+
+    def stage(h, p):
+        return h + jnp.tanh(h @ p["w"] + p["b"])
+
+    def loss_fn(y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def fn(params, x, lab):
+        return pipeline_step(sched, stage, loss_fn, params, x, lab,
+                             axis="pp")
+
+    step = jax.jit(jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P("pp"), params),
+                  P(), P()),
+        out_specs=(P(), jax.tree_util.tree_map(lambda _: P("pp"),
+                                               params)),
+        check_vma=False))
+    step(params, x, lab)[0].block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss, g = step(params, x, lab)
+    loss.block_until_ready()
+    return ((time.perf_counter() - t0) / STEPS * 1e3, float(loss), sched)
+
+
+def main():
+    ms_stack, loss_s = run_stack()
+    print(f"PipelineStack  (GSPMD scan, pp{N_RANKS}, full batch {B}): "
+          f"{ms_stack:8.2f} ms/step  loss={loss_s:.4f}")
+    for kind in ("1f1b", "gpipe"):
+        ms, loss, sched = run_executor(kind)
+        print(f"executor {kind:>6} (pp{N_RANKS} x {N_MICRO} micro):"
+              f"          {ms:8.2f} ms/step  loss={loss:.4f}  "
+              f"bubble={sched.bubble_fraction():.3f}  "
+              f"peak_acts={sched.peak_live_activations()} micro "
+              f"(= {sched.peak_live_activations() * B // N_MICRO} rows "
+              f"vs stack's {B})")
+
+
+if __name__ == "__main__":
+    main()
