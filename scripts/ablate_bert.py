@@ -11,7 +11,7 @@ import numpy as np
 
 
 def bench(batch, seq, flash, pallas_ln, fused_adam, xent, steps=16,
-          inner=4):
+          inner=4, adam_multi=False):
     """`inner` real optimizer steps per compiled call (same amortization
     as bench.py): the tunnel's 30-45 ms per-dispatch overhead would
     otherwise drown the per-kernel deltas this ablation exists to
@@ -26,7 +26,8 @@ def bench(batch, seq, flash, pallas_ln, fused_adam, xent, steps=16,
     # crossover, so the seq gate must not silently reroute flash=1 rows
     # to sdpa at seq 128
     P.configure(flash_attention=flash, layer_norm=pallas_ln,
-                fused_adam=fused_adam, softmax_xent=xent, flash_min_seq=0)
+                fused_adam=fused_adam, softmax_xent=xent, flash_min_seq=0,
+                fused_adam_multi=adam_multi)
     cfg = BertConfig.base(use_flash_attention=flash)
     model = BertForPretraining(cfg)
     o = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
@@ -95,6 +96,17 @@ def main():
             print(f"batch={batch} flash={flash} ln={ln} "
                   f"adam={fa} xent={xe}: FAIL {type(e).__name__}: {e}",
                   flush=True)
+    # full-model multi-tensor adam row (r5): one dispatch over all params
+    # vs XLA's fused update, in situ at the headline shape
+    for multi in (0, 1):
+        try:
+            tps, _ = bench(64, 128, True, True, False, False,
+                           adam_multi=bool(multi))
+            print(f"batch=64 adam_multi={multi}: {tps:,.0f} tok/s",
+                  flush=True)
+        except Exception as e:
+            print(f"batch=64 adam_multi={multi}: FAIL "
+                  f"{type(e).__name__}: {e}", flush=True)
     # full-model check of the flash_min_seq=512 crossover (the sweep's
     # kernel-only verdict at 512 was a wash; this decides it in situ)
     for flash in (0, 1):
