@@ -89,3 +89,52 @@ def test_init_retry_gives_fail_json_when_probe_never_succeeds(
              if l.startswith("{")]
     d = json.loads(lines[-1])
     assert "still wedged" in d["error"] and d["value"] == 0.0
+
+
+@pytest.fixture()
+def battery():
+    spec = importlib.util.spec_from_file_location(
+        "battery_under_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "watcher_battery.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_battery_parses_last_json_line(battery):
+    out = 'noise\n{"value": 1.5}\n{"value": 2.5, "x": 1}\ntrailing'
+    assert battery._last_json_line(out) == {"value": 2.5, "x": 1}
+    assert battery._last_json_line("rubbish only") is None
+    assert battery._last_json_line("{broken json}\nrest") is None
+
+
+def test_battery_refreshes_latest_only_on_positive_value(battery,
+                                                         tmp_path,
+                                                         monkeypatch):
+    latest = tmp_path / "latest.json"
+    monkeypatch.setattr(battery, "LATEST", str(latest))
+    monkeypatch.setattr(battery, "LOGS", str(tmp_path / "logs"))
+    calls = []
+
+    def fake_run(cmd, log_name, timeout_s):
+        calls.append(cmd)
+        if "bench.py" in cmd[-1]:
+            return 0, '{"value": 123.0, "unit": "tokens/s"}'
+        return 0, ""
+
+    monkeypatch.setattr(battery, "_run", fake_run)
+    battery.main()
+    data = json.loads(latest.read_text())
+    assert data["value"] == 123.0
+    assert "measured_at" in data and "git_rev" in data
+
+    # zero/failed bench must NOT clobber a previous good record
+    def fake_run_zero(cmd, log_name, timeout_s):
+        if "bench.py" in cmd[-1]:
+            return 0, '{"value": 0.0, "error": "tunnel wedged"}'
+        return 0, ""
+
+    monkeypatch.setattr(battery, "_run", fake_run_zero)
+    battery.main()
+    assert json.loads(latest.read_text())["value"] == 123.0
