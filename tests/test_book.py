@@ -143,6 +143,7 @@ def test_understand_sentiment_conv():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_label_semantic_roles_crf():
     """reference book/test_label_semantic_roles.py — BiLSTM + linear
     chain CRF (dygraph form: the static CRF path is the same op)."""
@@ -217,6 +218,7 @@ def test_rnn_encoder_decoder():
     assert losses[-1] < losses[0] * 0.9
 
 
+@pytest.mark.slow
 def test_machine_translation_beam_decode():
     """reference book/test_machine_translation.py — train briefly, then
     beam-search decode with the Transformer zoo model (the modern path the

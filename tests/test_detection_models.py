@@ -5,6 +5,8 @@ fluid/layers/detection.py)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import paddle_tpu as pt
 from paddle_tpu import jit, optimizer as opt
 from paddle_tpu.models.detection import YOLOv3, SSD

@@ -59,6 +59,7 @@ def test_megatron_param_spec_patterns():
     assert megatron_param_spec("encoder.0.attn_norm.weight", (64,)) == P()
 
 
+@pytest.mark.slow
 def test_fleet_bert_dp_tp_matches_single_device():
     # ---- single-device reference run -------------------------------
     cfg, model_ref, ids, mlm, nsp = _bert_and_data()
@@ -94,6 +95,7 @@ def test_fleet_bert_dp_tp_matches_single_device():
     assert qkv.data.sharding.spec == P(None, "tp")
 
 
+@pytest.mark.slow
 def test_fleet_dp_only_matches_single_device():
     cfg, model_ref, ids, mlm, nsp = _bert_and_data(batch=8)
     o_ref = optimizer.Momentum(learning_rate=0.05, momentum=0.9,

@@ -13,6 +13,8 @@ reference: fleet collective DistributedStrategy + PipelineOptimizer
 fluid/optimizer.py)."""
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -53,6 +53,7 @@ def _reference_losses(steps=3):
             for _ in range(steps)], (ids, mlm, nsp)
 
 
+@pytest.mark.slow
 def test_fleet_bert_dp_pp_tp_matches_single_device():
     ref_losses, (ids, mlm, nsp) = _reference_losses()
 
@@ -78,6 +79,7 @@ def test_fleet_bert_dp_pp_tp_matches_single_device():
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_fleet_bert_sp_sharded_tokens_matches_single_device():
     ref_losses, (ids, mlm, nsp) = _reference_losses()
 

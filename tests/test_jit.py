@@ -180,6 +180,7 @@ def test_to_static_multi_step_unrolled_matches_sequential():
         np.testing.assert_allclose(p1.numpy(), p2.numpy(), atol=1e-6)
 
 
+@pytest.mark.slow
 def test_bert_recompute_matches_plain():
     """use_recompute=True (per-layer jax.checkpoint, RNG threaded
     explicitly through the checkpointed region) must be bit-comparable to

@@ -23,6 +23,7 @@ def test_flash_longer_seq_causal_matches_sdpa():
     np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=2e-3)
 
 
+@pytest.mark.slow
 def test_bert_long_seq_recompute_flash_trains():
     """Tiny-width BERT at seq 512 with recompute on: the long-context
     configuration (flash stays off on CPU via the auto gate — it runs on

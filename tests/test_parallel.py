@@ -155,6 +155,7 @@ def test_dataparallel_wrapper(mesh8):
     assert getattr(sh, "mesh", None) is not None
 
 
+@pytest.mark.slow
 def test_megatron_dryrun_entry():
     """__graft_entry__.dryrun_multichip contract: full 5-axis train step."""
     import importlib, sys
@@ -163,6 +164,7 @@ def test_megatron_dryrun_entry():
     g.dryrun_multichip(8)
 
 
+@pytest.mark.slow
 def test_megatron_loss_decreases():
     from paddle_tpu.parallel import megatron as M
     import numpy as np
@@ -179,6 +181,7 @@ def test_megatron_loss_decreases():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_megatron_8dev_matches_single_device():
     """Gold SPMD-correctness test: one train step on the dp2/pp2/tp2 mesh
     must produce the SAME logical parameters as the identical model run on
@@ -228,6 +231,7 @@ def test_megatron_8dev_matches_single_device():
             err_msg=f"param {k} diverged between 8-dev and 1-dev")
 
 
+@pytest.mark.slow
 def test_megatron_fused_adam_matches_fallback():
     """The Pallas fused-adam kernel running on per-device shards INSIDE
     shard_map (interpret mode here) must match the plain-XLA adam rule the
@@ -309,6 +313,7 @@ def test_sync_batch_norm_matches_global_batch():
     np.testing.assert_allclose(out_local, out_ref, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_megatron_multi_tensor_adam_matches():
     """fused_adam_multi on (interpret mode, shard_map over dp2) must
     train exactly like the per-tensor adam path: the r5 multi-tensor
