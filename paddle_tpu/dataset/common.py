@@ -57,3 +57,50 @@ def rng_for(name):
     seed = int.from_bytes(hashlib.sha256(name.encode()).digest()[:4],
                           "little")
     return np.random.RandomState(seed)
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """reference common.py:split — dump a reader's samples into
+    line_count-sized pickle files; returns the file list."""
+    import pickle
+    dumper = dumper or pickle.dump
+    indx_f = 0
+    files = []
+    lines = []
+    for d in reader():
+        lines.append(d)
+        if len(lines) == line_count:
+            filename = suffix % indx_f
+            with open(filename, "wb") as f:
+                dumper(lines, f)
+            files.append(filename)
+            indx_f += 1
+            lines = []
+    if lines:
+        filename = suffix % indx_f
+        with open(filename, "wb") as f:
+            dumper(lines, f)
+        files.append(filename)
+    return files
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """reference common.py:cluster_files_reader — each trainer reads
+    its modulo-slice of the sorted file list."""
+    import glob
+    import pickle
+    loader = loader or pickle.load
+
+    def reader():
+        if not callable(loader):
+            raise TypeError("loader should be callable.")
+        file_list = sorted(glob.glob(files_pattern))
+        my_files = [fn for idx, fn in enumerate(file_list)
+                    if idx % trainer_count == trainer_id]
+        for fn in my_files:
+            with open(fn, "rb") as f:
+                for line in loader(f):
+                    yield line
+
+    return reader

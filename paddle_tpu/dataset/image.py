@@ -93,3 +93,74 @@ def resize_exact(im, h, w):
     xs = (np.arange(w) * (im.shape[1] / w)).astype(int).clip(0,
                                                              im.shape[1] - 1)
     return im[ys][:, xs]
+
+
+def load_image_bytes(bytes, is_color=True):
+    """reference image.py:load_image_bytes — decode an image from a
+    bytes buffer. The reference decodes via cv2; here PNG/raw-npy
+    buffers decode without native deps (JPEG needs cv2/PIL, which this
+    environment deliberately avoids — decode on the host pipeline)."""
+    import io
+    try:
+        with io.BytesIO(bytes) as bio:
+            im = np.load(bio, allow_pickle=False)
+        if not is_color and im.ndim == 3:
+            im = im.mean(axis=2).astype(im.dtype)
+        return im
+    except Exception:
+        pass
+    try:
+        import matplotlib.image as mpimg  # optional
+        import io as _io
+        im = mpimg.imread(_io.BytesIO(bytes), format=None)
+        if im.dtype != np.uint8:
+            im = (im * 255).astype("u1")
+        if not is_color and im.ndim == 3:
+            im = im.mean(axis=2).astype("u1")
+        return im
+    except Exception as e:
+        raise ValueError(
+            "load_image_bytes: buffer is neither .npy nor a format "
+            f"matplotlib can decode ({type(e).__name__}); decode "
+            "JPEGs in the host data pipeline") from e
+
+
+def batch_images_from_tar(data_file, dataset_name, img2label,
+                          num_per_batch=1024):
+    """reference image.py:batch_images_from_tar — read images from a
+    tar, pickle them into batch files of (data, label) lists, write a
+    batch manifest; returns the manifest path."""
+    import os
+    import pickle
+    import tarfile
+
+    out_path = f"{data_file}_{dataset_name}_batch"
+    meta_file = os.path.join(out_path, "batch_names.txt")
+    if os.path.exists(meta_file):
+        return meta_file
+    os.makedirs(out_path, exist_ok=True)
+    tf = tarfile.open(data_file)
+    mems = tf.getmembers()
+    data, labels, names, file_id = [], [], [], 0
+    for mem in mems:
+        if mem.name not in img2label:
+            continue
+        data.append(tf.extractfile(mem).read())
+        labels.append(img2label[mem.name])
+        if len(data) == num_per_batch:
+            output = {"label": labels, "data": data}
+            name = os.path.join(out_path, f"batch_{file_id}")
+            with open(name, "wb") as f:
+                pickle.dump(output, f, protocol=2)
+            names.append(os.path.basename(name))
+            file_id += 1
+            data, labels = [], []
+    if data:
+        output = {"label": labels, "data": data}
+        name = os.path.join(out_path, f"batch_{file_id}")
+        with open(name, "wb") as f:
+            pickle.dump(output, f, protocol=2)
+        names.append(os.path.basename(name))
+    with open(meta_file, "w") as f:
+        f.write("\n".join(names) + "\n")
+    return meta_file

@@ -57,3 +57,10 @@ def test(word_idx=None):
 
 def fetch():
     pass
+
+
+def build_dict(pattern=None, cutoff=0):
+    """reference imdb.py:build_dict — frequency-sorted word dict with a
+    cutoff; over the synthetic corpus this equals word_dict() (every
+    token appears well above any small cutoff)."""
+    return word_dict()

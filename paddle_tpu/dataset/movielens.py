@@ -114,3 +114,13 @@ def test():
 
 def fetch():
     pass
+
+
+def get_movie_title_dict():
+    """reference movielens.py:get_movie_title_dict — title-word → id."""
+    return {f"t{i}": i for i in range(TITLE_VOCAB)}
+
+
+def movie_categories():
+    """reference movielens.py:movie_categories — category → id."""
+    return {f"cat{i}": i for i in range(NUM_CATEGORIES)}

@@ -38,3 +38,8 @@ from .layers import Upsample as UpSample  # noqa: F401 (2.0-alpha name)
 from .layers import HSigmoid  # noqa: F401
 from .moe import MoEFFN, moe_aux_loss  # noqa: F401
 from ..fluid.dygraph import RowConv  # noqa: F401
+
+# paddle.nn 1.x functional tails (reference: python/paddle/nn/
+# {clip,control_flow}.py re-export the fluid twins at paddle.nn level)
+from ..ops.math import clip  # noqa: F401,E402
+from ..ops.control_flow import case, cond, while_loop  # noqa: F401,E402

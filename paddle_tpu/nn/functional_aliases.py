@@ -1,0 +1,5 @@
+"""1.x paddle.nn.functional spellings whose canonical names differ
+(reference: python/paddle/nn/functional/activation.py aliases)."""
+from ..ops.nn_ops import log_sigmoid as logsigmoid  # noqa: F401
+from ..ops.nn_ops import tanhshrink as tanh_shrink  # noqa: F401
+from ..ops.manip import diag_embed  # noqa: F401

@@ -310,3 +310,33 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
                                       shard_id=shard_id,
                                       ignore_value=ignore_value),
                  nondiff=True, name="shard_index")
+
+
+def unbind(input, axis=0):
+    """reference: unbind_op.cc — split a tensor into a LIST of tensors
+    along `axis`, removing that axis from each (same op as unstack;
+    Tensor.unbind delegates there too)."""
+    return unstack(input, axis=axis)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1):
+    """reference: diag_embed_op.cc — embed the last dim of `input` as a
+    diagonal of a new 2D plane appended at (dim1, dim2)."""
+    def impl(x, offset, dim1, dim2):
+        m = x.shape[-1] + abs(offset)
+        out_ndim = x.ndim + 1
+        d1 = dim1 % out_ndim
+        d2 = dim2 % out_ndim
+        # build on trailing axes then move into position
+        plane = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+        idx = jnp.arange(x.shape[-1])
+        rows = idx + max(-offset, 0)
+        cols = idx + max(offset, 0)
+        plane = plane.at[..., rows, cols].set(x)
+        # trailing axes are (ndim-2, ndim-1) = (d1', d2') — move to
+        # requested dims, keeping their relative order
+        order = sorted((d1, d2))
+        src = [out_ndim - 2, out_ndim - 1]
+        return jnp.moveaxis(plane, src, order if d1 < d2 else order[::-1])
+    return apply(impl, (input,), dict(offset=offset, dim1=dim1, dim2=dim2),
+                 name="diag_embed")

@@ -20,7 +20,7 @@ import threading
 
 import numpy as np
 
-__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose", "ComposeNotAligned",
            "buffered", "firstn", "xmap_readers", "multiprocess_reader",
            "batch"]
 
@@ -81,9 +81,14 @@ def chain(*readers):
     return creator
 
 
+class ComposeNotAligned(ValueError):
+    """reference reader/decorator.py:ComposeNotAligned — raised when
+    composed readers have different lengths."""
+
+
 def compose(*readers, **kwargs):
     """Zip readers into flat tuples: (a, b), (c) -> (a, b, c).
-    check_alignment=True raises if lengths differ."""
+    check_alignment=True raises ComposeNotAligned if lengths differ."""
     check_alignment = kwargs.pop("check_alignment", True)
 
     def _flatten(item):
@@ -96,7 +101,7 @@ def compose(*readers, **kwargs):
         if check_alignment:
             for items in itertools.zip_longest(*its):
                 if any(i is None for i in items):
-                    raise ValueError(
+                    raise ComposeNotAligned(
                         "compose: readers have different lengths")
                 yield sum((_flatten(i) for i in items), ())
         else:

@@ -296,3 +296,10 @@ def as_tensor(x):
     if isinstance(x, Tensor):
         return x
     return Tensor(x)
+
+
+def unbind(input, axis=0):
+    """reference tensor/manipulation.py:unbind — module-level twin of
+    Tensor.unbind (lazy import: ops depends on this module)."""
+    from .ops.manip import unbind as _unbind
+    return _unbind(input, axis)

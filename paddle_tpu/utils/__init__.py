@@ -5,3 +5,6 @@ from . import debug
 from . import log
 from .debug import check_nan_inf, enable_nan_guard
 from .log import get_logger, logger
+from .plot import Ploter  # noqa: F401,E402
+from .profiler import (ProfilerOptions, Profiler,  # noqa: F401,E402
+                       get_profiler)

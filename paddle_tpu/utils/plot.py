@@ -1,0 +1,66 @@
+"""paddle.utils.plot parity (reference: python/paddle/utils/plot.py) —
+the Ploter training-curve helper. Falls back to silent data collection
+when matplotlib/display is unavailable (headless TPU hosts), matching
+the reference's disable-on-no-display behavior."""
+import os
+
+__all__ = ["Ploter", "PlotData"]
+
+
+class PlotData:
+    """reference plot.py:PlotData."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.step = []
+        self.value = []
+
+    def append(self, step, value):
+        self.step.append(step)
+        self.value.append(value)
+
+
+class Ploter:
+    """reference plot.py:Ploter — collect (step, value) per named curve
+    and plot them together. Plotting needs matplotlib + a display; data
+    collection always works."""
+
+    def __init__(self, *args):
+        self.__args__ = args
+        self.__plot_data__ = {t: PlotData() for t in args}
+        self.__disable_plot__ = os.environ.get("DISABLE_PLOT", "")
+        try:  # pragma: no cover - environment dependent
+            import matplotlib.pyplot as plt
+            self.plt = plt
+        except Exception:
+            self.plt = None
+
+    def __plot_is_disabled__(self):
+        return self.plt is None or self.__disable_plot__.lower() == "true"
+
+    def append(self, title, step, value):
+        if title not in self.__plot_data__:
+            raise ValueError(f"no title named {title!r}; known: "
+                             f"{list(self.__plot_data__)}")
+        self.__plot_data__[title].append(step, value)
+
+    def plot(self, path=None):
+        if self.__plot_is_disabled__():
+            return
+        titles = []  # pragma: no cover - needs matplotlib
+        for title, data in self.__plot_data__.items():
+            if len(data.step) > 0:
+                titles.append(title)
+                self.plt.plot(data.step, data.value)
+        self.plt.legend(titles, loc="upper left")
+        if path is None:
+            self.plt.show()
+        else:
+            self.plt.savefig(path)
+        self.plt.clf()
+
+    def reset(self):
+        for data in self.__plot_data__.values():
+            data.reset()

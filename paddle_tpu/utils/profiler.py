@@ -16,16 +16,20 @@ import jax
 
 _records = defaultdict(lambda: [0.0, 0])
 _trace_dir = None
+_profiling_active = False  # the reference's core.is_profiler_enabled()
 
 
 def start_profiler(state="All", tracer_option=None, trace_dir=None):
     """reference: profiler.start_profiler. Starts a jax.profiler trace."""
-    global _trace_dir
+    global _trace_dir, _profiling_active
     _trace_dir = trace_dir or "/tmp/paddle_tpu_trace"
     jax.profiler.start_trace(_trace_dir)
+    _profiling_active = True
 
 
 def stop_profiler(sorted_key=None, profile_path=None):
+    global _profiling_active
+    _profiling_active = False
     jax.profiler.stop_trace()
     print(f"[paddle_tpu.profiler] XLA trace written to {_trace_dir} "
           "(open with TensorBoard / Perfetto)")
@@ -137,3 +141,122 @@ def summarize_trace(trace_dir, top=20, steps=1):
         print(f"{name[:43]:<44}{ms:>10.2f}")
     print(f"{'TOTAL (top ' + str(top) + ')':<44}{total:>10.2f}")
     return fams
+
+
+# --- paddle.utils.profiler parity (reference: utils/profiler.py) -----------
+
+import sys as _sys
+
+
+class ProfilerOptions:
+    """reference utils/profiler.py:ProfilerOptions — option dict with
+    'none' → None resolution."""
+
+    def __init__(self, options=None):
+        self.options = {
+            "state": "All",
+            "sorted_key": "default",
+            "tracer_level": "Default",
+            "batch_range": [0, _sys.maxsize],
+            "output_thread_detail": False,
+            "profile_path": "none",
+            "timeline_path": "none",
+            "op_summary_path": "none",
+        }
+        if options is not None:
+            for key in self.options:
+                if options.get(key, None) is not None:
+                    self.options[key] = options[key]
+
+    def with_state(self, state):
+        self.options["state"] = state
+        return self
+
+    def __getitem__(self, name):
+        if self.options.get(name, None) is None:
+            raise ValueError(
+                f"ProfilerOptions does not have an option named {name}.")
+        v = self.options[name]
+        return None if isinstance(v, str) and v == "none" else v
+
+
+_current_profiler = None
+
+
+class Profiler:
+    """reference utils/profiler.py:Profiler — context-manager +
+    batch-range driver over start/stop_profiler."""
+
+    def __init__(self, enabled=True, options=None):
+        self.profiler_options = options if options is not None \
+            else ProfilerOptions()
+        self.batch_id = 0
+        self.enabled = enabled
+
+    def __enter__(self):
+        global _current_profiler
+        self.previous_profiler = _current_profiler
+        _current_profiler = self
+        if self.enabled and self.profiler_options["batch_range"][0] == 0:
+            self.start()
+        return self
+
+    def __exit__(self, exception_type, exception_value, traceback):
+        global _current_profiler
+        _current_profiler = self.previous_profiler
+        if self.enabled:
+            self.stop()
+
+    def start(self):
+        if not self.enabled:
+            return
+        import warnings
+        try:
+            start_profiler(
+                state=self.profiler_options["state"],
+                tracer_option=self.profiler_options["tracer_level"])
+        except Exception as e:  # pragma: no cover
+            warnings.warn("Profiler is not enabled because following "
+                          f"exception:\n{e}")
+
+    def stop(self):
+        if not self.enabled or not _profiling_active:
+            return
+        import warnings
+        try:
+            stop_profiler(
+                sorted_key=self.profiler_options["sorted_key"],
+                profile_path=self.profiler_options["profile_path"])
+        except Exception as e:  # pragma: no cover
+            warnings.warn("Profiler is not disabled because following "
+                          f"exception:\n{e}")
+
+    def reset(self):
+        if self.enabled and self.profiler_options["state"] != "Off":
+            reset_profiler()
+
+    def record_step(self, change_profiler_status=True):
+        if not self.enabled:
+            return
+        self.batch_id += 1
+        if not change_profiler_status:
+            return
+        lo, hi = self.profiler_options["batch_range"]
+        if self.batch_id == lo:
+            # reference gate: core.is_profiler_enabled() — reset a trace
+            # that is already running, start one otherwise
+            if _profiling_active:
+                self.reset()
+            else:
+                self.start()
+        if self.batch_id == hi:
+            self.stop()
+
+
+def get_profiler():
+    """reference utils/profiler.py:get_profiler — the active Profiler,
+    creating a disabled default if none is in scope."""
+    global _current_profiler
+    if _current_profiler is None:
+        _current_profiler = Profiler(enabled=False)
+    return _current_profiler

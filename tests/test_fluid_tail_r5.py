@@ -428,3 +428,137 @@ def test_dataset_pipe_command_blank_lines(tmp_path):
     ds.set_use_var([V("a"), V("b")])
     ds.load_into_memory()
     assert ds.get_memory_data_size() == 2  # blank line skipped
+
+
+# ---- top-level paddle tail (r5 full-tree sweep) -----------------------------
+
+def test_unbind_and_diag_embed():
+    t = pt.to_tensor(np.arange(6).reshape(2, 3).astype("f4"))
+    parts = pt.unbind(t, axis=0)
+    assert len(parts) == 2 and tuple(parts[0].shape) == (3,)
+    np.testing.assert_allclose(parts[1].numpy(), [3, 4, 5])
+    s = (parts[0] * 2).sum()
+    s.backward()  # differentiable through the list output
+
+    d = pt.diag_embed(pt.to_tensor(np.array([1., 2.], "f4")), offset=1)
+    np.testing.assert_allclose(
+        d.numpy(), [[0, 1, 0], [0, 0, 2], [0, 0, 0]])
+
+
+def test_compose_not_aligned_exception():
+    from paddle_tpu import reader
+
+    def r1():
+        yield from [1, 2, 3]
+
+    def r2():
+        yield from [4, 5]
+
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(r1, r2)())
+    assert issubclass(reader.ComposeNotAligned, ValueError)
+    got = list(reader.compose(r1, r2, check_alignment=False)())
+    assert got == [(1, 4), (2, 5)]
+
+
+def test_utils_profiler_classes():
+    from paddle_tpu.utils import (Profiler, ProfilerOptions, get_profiler,
+                                  Ploter)
+    opts = ProfilerOptions({"state": "CPU"})
+    assert opts["state"] == "CPU"
+    assert opts["profile_path"] is None  # 'none' -> None
+    with pytest.raises(ValueError):
+        opts["no_such_option"]
+    p = Profiler(enabled=False)
+    with p:
+        p.record_step()
+    assert p.batch_id == 0  # disabled: no counting
+    assert get_profiler() is not None
+
+    pl = Ploter("train", "test")
+    pl.append("train", 0, 1.0)
+    pl.append("train", 1, 0.5)
+    with pytest.raises(ValueError):
+        pl.append("nope", 0, 1.0)
+    assert pl.__plot_data__["train"].value == [1.0, 0.5]
+    pl.reset()
+    assert pl.__plot_data__["train"].value == []
+
+
+def test_fs_wrapper_localfs(tmp_path):
+    from paddle_tpu.distributed.fs_wrapper import FS, LocalFS, BDFS
+    fs = LocalFS()
+    d = tmp_path / "a"
+    fs.mkdir(str(d))
+    assert fs.stat(str(d))
+    (d / "x.txt").write_text("hi")
+    assert fs.ls_dir(str(d)) == ["x.txt"]
+    assert fs.list_dirs(str(tmp_path)) == ["a"]
+    fs.download(str(d / "x.txt"), str(tmp_path / "y.txt"))
+    assert (tmp_path / "y.txt").read_text() == "hi"
+    fs.delete(str(d))
+    assert not fs.stat(str(d))
+    assert not fs.need_upload_download()
+    assert issubclass(LocalFS, FS)
+    with pytest.raises(RuntimeError):
+        BDFS()
+
+
+def test_dataset_tail_helpers(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common, imdb, movielens
+
+    monkeypatch.chdir(tmp_path)
+
+    def rdr():
+        yield from range(25)
+
+    files = common.split(rdr, 10)
+    assert len(files) >= 2
+    back = sorted(common.cluster_files_reader(
+        str(tmp_path / "*.pickle"), 1, 0)())
+    assert back == list(range(25))
+    # two trainers partition the files disjointly
+    a = list(common.cluster_files_reader(str(tmp_path / "*.pickle"),
+                                         2, 0)())
+    b = list(common.cluster_files_reader(str(tmp_path / "*.pickle"),
+                                         2, 1)())
+    assert sorted(a + b) == list(range(25))
+
+    assert imdb.build_dict() == imdb.word_dict()
+    assert len(movielens.movie_categories()) == movielens.NUM_CATEGORIES
+    assert len(movielens.get_movie_title_dict()) == movielens.TITLE_VOCAB
+
+
+def test_nn_functional_one_x_surface():
+    from paddle_tpu.nn import functional as F
+    x = pt.to_tensor(np.array([[-1.0, 0.5]], "f4"))
+    out = F.logsigmoid(x)
+    np.testing.assert_allclose(
+        out.numpy(), np.log(1 / (1 + np.exp([[1.0, -0.5]]))), rtol=1e-5)
+    assert callable(F.roi_align) and callable(F.yolov3_loss)
+    assert callable(F.noam_decay) and callable(F.tanh_shrink)
+
+
+def test_profiler_batch_range_starts_mid_run(monkeypatch):
+    """Review regression: batch_range [2, 3] must START the trace at
+    batch 2 (the old `_current_profiler is self` gate never did)."""
+    from paddle_tpu.utils import profiler as prof
+    calls = []
+
+    def fake_start(**kw):
+        calls.append("start")
+        prof._profiling_active = True
+
+    def fake_stop(**kw):
+        calls.append("stop")
+        prof._profiling_active = False
+
+    monkeypatch.setattr(prof, "start_profiler", fake_start)
+    monkeypatch.setattr(prof, "stop_profiler", fake_stop)
+    monkeypatch.setattr(prof, "_profiling_active", False)
+    opts = prof.ProfilerOptions({"batch_range": [2, 3]})
+    with prof.Profiler(enabled=True, options=opts) as p:
+        for _ in range(4):
+            p.record_step()
+    assert "start" in calls, calls
+    assert calls.index("start") < calls.index("stop")
