@@ -296,7 +296,11 @@ def _fail_json(msg):
     """Emit the SAME JSON schema as a successful run so the driver always
     records a parseable line (r3's backend-init exception escaped main()
     and the round's only number was a raw traceback). Any stage that
-    already finished contributes its REAL number instead of a zero."""
+    already finished contributes its REAL number instead of a zero.
+    The headline stays 0.0 on failure — but the line carries a labeled
+    pointer to the most recent COMMITTED on-chip measurement (the
+    watcher's bench_latest_measured.json, else the r4 snapshot) so a
+    wedged tunnel doesn't erase where the repo's measured state lives."""
     out = {
         "metric": "bert_base_tokens/sec/chip", "value": 0.0,
         "unit": "tokens/s", "vs_baseline": 0.0,
@@ -304,6 +308,27 @@ def _fail_json(msg):
     }
     out.update(_RESULTS)
     out["error"] = msg[:500]
+    try:
+        import os
+        here = os.path.dirname(os.path.abspath(__file__))
+        for rel in ("docs/bench_latest_measured.json",
+                    "docs/bench_r04_measured.json"):
+            path = os.path.join(here, rel)
+            if os.path.exists(path):
+                with open(path) as fh:
+                    snap = json.load(fh)
+                keep = {k: snap[k] for k in
+                        ("measured_at", "git_rev", "value", "vs_baseline",
+                         "resnet50_images_per_sec", "resnet50_vs_baseline",
+                         "bert_base_seq128_tokens_per_sec",
+                         "bert_vs_v100_baseline_25k",
+                         "resnet50_vs_v100_baseline_360", "note")
+                        if k in snap}
+                out["last_committed_measurement"] = keep
+                out["last_committed_measurement_file"] = rel
+                break
+    except Exception:
+        pass  # the pointer is best-effort; never break the fail line
     print(json.dumps(out), flush=True)
 
 
