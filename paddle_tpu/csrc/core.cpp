@@ -290,4 +290,75 @@ void ptc_batcher_destroy(void* batcher) {
   delete static_cast<Batcher*>(batcher);
 }
 
+
+// ---------------------------------------------------------------------------
+// MultiSlot text parser (reference: paddle/fluid/framework/data_feed.cc
+// MultiSlotDataFeed::ParseOneInstance — the C++ hot path of the CTR
+// ingest pipeline; rebuilt here as a single-pass strtod/strtoll token
+// stream so fluid.dataset does not pay python-level tokenization).
+//
+// Format: whitespace-separated tokens; per record, for each of n_slots:
+// an integer count then that many values. Line boundaries are plain
+// whitespace (the format is self-describing via counts).
+//
+// out_vals holds 8-byte lanes: double for float slots, int64 bit
+// patterns for slots flagged in slot_is_int (exact for full int64
+// range, unlike a float64 round-trip). out_counts is [n_records x
+// n_slots]. Returns the record count, or -1 on malformed input.
+
+long long ptc_multislot_parse(const char* text, size_t len, int n_slots,
+                              const int* slot_is_int,
+                              double* out_vals, long long* out_counts,
+                              long long max_vals, long long max_recs,
+                              long long* n_vals_out) {
+  const char* p = text;
+  const char* end = text + len;
+  long long rec = 0, vi = 0;
+  auto skip_ws = [&]() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' ||
+                       *p == '\r')) ++p;
+  };
+  // every token must END at whitespace/EOF: a partial numeric parse
+  // ('1.5' read as count 1) would silently misalign the whole stream
+  auto at_boundary = [&](const char* q) {
+    return q >= end || *q == ' ' || *q == '\t' || *q == '\n' ||
+           *q == '\r' || *q == '\0';
+  };
+  while (true) {
+    skip_ws();
+    if (p >= end) break;
+    if (rec >= max_recs) return -1;
+    for (int s = 0; s < n_slots; ++s) {
+      skip_ws();
+      char* q = nullptr;
+      long long cnt = strtoll(p, &q, 10);
+      // cnt > max_vals - vi also rejects strtoll's LLONG_MAX overflow
+      // clamp without ever computing vi + cnt (signed-overflow UB)
+      if (q == p || !at_boundary(q) || cnt < 0 ||
+          cnt > max_vals - vi) return -1;
+      p = q;
+      out_counts[rec * n_slots + s] = cnt;
+      for (long long i = 0; i < cnt; ++i) {
+        skip_ws();
+        if (p >= end) return -1;  // truncated record
+        if (slot_is_int[s]) {
+          long long v = strtoll(p, &q, 10);
+          if (q == p || !at_boundary(q)) return -1;
+          memcpy(&out_vals[vi], &v, sizeof v);
+        } else {
+          double v = strtod(p, &q);
+          if (q == p || !at_boundary(q)) return -1;
+          out_vals[vi] = v;
+        }
+        p = q;
+        ++vi;
+      }
+    }
+    ++rec;
+  }
+  *n_vals_out = vi;
+  return rec;
+}
+
 }  // extern "C"
+

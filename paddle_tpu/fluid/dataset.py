@@ -79,29 +79,17 @@ class DatasetBase:
                 f"slots: {self.use_var_names}")
 
     # -- host-side feed assembly --
-    def _read_lines(self):
-        for path in self.filelist:
-            if self.proto_desc_pipe_command not in (None, "", "cat"):
-                with open(path, "rb") as fh:
-                    out = subprocess.run(
-                        self.proto_desc_pipe_command, shell=True,
-                        stdin=fh, capture_output=True,
-                        check=True).stdout.decode()
-                for line in out.splitlines():
-                    if line.strip():
-                        yield line
-            else:
-                with open(path) as fh:
-                    for line in fh:
-                        if line.strip():
-                            yield line.rstrip("\n")
+    def _slot_is_int(self):
+        """One source of truth for the int-slot flags (native parse,
+        python parse, and batch assembly must agree)."""
+        return self.use_var_int or [False] * len(self.use_var_names)
 
     def _parse_line(self, line):
         """MultiSlot text format: for each slot, an integer count then
         that many values. Integer slots (per set_use_var dtype) parse
         as python ints, never floats."""
         toks = line.split()
-        is_int = self.use_var_int or [False] * len(self.use_var_names)
+        is_int = self._slot_is_int()
         slots, i = [], 0
         for si, _ in enumerate(self.use_var_names):
             n = int(toks[i])
@@ -111,9 +99,54 @@ class DatasetBase:
             i += 1 + n
         return slots
 
+    def _read_file_text(self, path):
+        """Whole-file text after the pipe command (if any)."""
+        if self.proto_desc_pipe_command not in (None, "", "cat"):
+            with open(path, "rb") as fh:
+                return subprocess.run(
+                    self.proto_desc_pipe_command, shell=True, stdin=fh,
+                    capture_output=True, check=True).stdout
+        with open(path, "rb") as fh:
+            return fh.read()
+
     def _records(self):
-        for line in self._read_lines():
-            yield self._parse_line(line)
+        """Per file: one pipe/read, then the C MultiSlot parser (csrc
+        ptc_multislot_parse — the data_feed.cc rebuild: one
+        strtod/strtoll pass, records yielded as numpy views, int slots
+        exact int64). A missing/unbuildable native library falls back
+        to python parsing of the SAME text (the pipe command never runs
+        twice); a genuinely malformed file raises ValueError from
+        either path. The parse is whole-file (the reference's DataFeed
+        also slurps per-file chunks); record emission streams."""
+        is_int = self._slot_is_int()
+        n_slots = len(self.use_var_names)
+        for path in self.filelist:
+            text = self._read_file_text(path)
+            parsed = None
+            if getattr(self, "use_native_parse", True):
+                try:
+                    from ..io import native
+                    parsed = native.multislot_parse(text, n_slots, is_int)
+                except ValueError:
+                    raise  # malformed data: never mask with a re-parse
+                except Exception:
+                    parsed = None  # lib build/load issue: python path
+            if parsed is not None:
+                counts, vals = parsed
+                ivals = vals.view(np.int64)
+                off = 0
+                for r in range(counts.shape[0]):
+                    rec = []
+                    for s in range(n_slots):
+                        n = int(counts[r, s])
+                        rec.append(
+                            (ivals if is_int[s] else vals)[off:off + n])
+                        off += n
+                    yield rec
+            else:
+                for line in text.decode().splitlines():
+                    if line.strip():
+                        yield self._parse_line(line)
 
     def _batches(self, records=None):
         """Yield dicts {var_name: np.ndarray} of batch_size records.
@@ -131,7 +164,7 @@ class DatasetBase:
 
     def _assemble(self, recs):
         out = {}
-        is_int = self.use_var_int or [False] * len(self.use_var_names)
+        is_int = self._slot_is_int()
         for si, name in enumerate(self.use_var_names):
             col = [r[si] for r in recs]
             width = max(len(v) for v in col)

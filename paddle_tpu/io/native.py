@@ -61,8 +61,43 @@ def get_lib():
     lib.ptc_batcher_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.ptc_batcher_new_epoch.argtypes = [ctypes.c_void_p]
     lib.ptc_batcher_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptc_multislot_parse.restype = ctypes.c_longlong
+    lib.ptc_multislot_parse.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_longlong,
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_longlong)]
     _lib = lib
     return lib
+
+
+def multislot_parse(text, n_slots, slot_is_int):
+    """Parse MultiSlot text in C (reference: data_feed.cc
+    MultiSlotDataFeed) — returns (counts [n_rec, n_slots] int64,
+    values_lanes [n_vals] 8-byte buffer). Float slots' lanes are
+    doubles; int slots' lanes are int64 bit patterns (exact full-range
+    ids). Raises ValueError on malformed input."""
+    lib = get_lib()
+    if isinstance(text, str):
+        text = text.encode()
+    # bounds: every value/count token is >= 1 char + separator, and a
+    # record carries at least n_slots count tokens — so counts stays
+    # ~len//2 total instead of scaling with n_slots
+    max_vals = len(text) // 2 + 2
+    max_recs = len(text) // (2 * max(n_slots, 1)) + 2
+    vals = np.empty((max_vals,), np.float64)
+    counts = np.empty((max_recs * n_slots,), np.int64)
+    flags = (ctypes.c_int * n_slots)(*[int(b) for b in slot_is_int])
+    n_vals = ctypes.c_longlong(0)
+    rec = lib.ptc_multislot_parse(
+        text, len(text), n_slots, flags,
+        vals.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        max_vals, max_recs, ctypes.byref(n_vals))
+    if rec < 0:
+        raise ValueError("malformed MultiSlot text (native parser)")
+    return (counts[:rec * n_slots].reshape(rec, n_slots).copy(),
+            vals[:n_vals.value].copy())
 
 
 class Arena:

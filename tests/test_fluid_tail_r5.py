@@ -562,3 +562,66 @@ def test_profiler_batch_range_starts_mid_run(monkeypatch):
             p.record_step()
     assert "start" in calls, calls
     assert calls.index("start") < calls.index("stop")
+
+
+def test_dataset_native_parse_matches_python(tmp_path):
+    """The C MultiSlot parser (csrc ptc_multislot_parse) and the python
+    fallback produce identical batches — including full-range int64 ids
+    a float64 lane would corrupt — and both reject malformed text."""
+    big = 2 ** 62 + 12345  # beyond float64's 2^53 exact-integer range
+    f = tmp_path / "m.txt"
+    f.write_text(
+        f"2 {big} 7 2 0.5 -1.25\n"
+        "1 42 1 3.75\n"
+        "\n"  # blank lines are plain whitespace in the token stream
+        "3 1 2 3 0\n")  # zero-count float slot
+
+    class V:
+        def __init__(self, name, dtype):
+            self.name, self.dtype = name, dtype
+
+    def load(use_native):
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(4)
+        ds.set_filelist([str(f)])
+        ds.set_use_var([V("ids", "int64"), V("x", "float32")])
+        ds.use_native_parse = use_native
+        ds.load_into_memory()
+        return list(ds._batches())
+
+    native_b = load(True)
+    python_b = load(False)
+    assert len(native_b) == len(python_b) == 1
+    for key in ("ids", "x"):
+        np.testing.assert_array_equal(native_b[0][key], python_b[0][key])
+    assert native_b[0]["ids"].dtype == np.int64
+    assert native_b[0]["ids"][0, 0] == big  # exact through the i64 lane
+
+    # malformed: truncated record
+    from paddle_tpu.io import native
+    with pytest.raises(ValueError):
+        native.multislot_parse(b"2 1.0", 2, [False, False])
+    with pytest.raises(ValueError):
+        native.multislot_parse(b"x 1.0 1 2.0", 2, [False, False])
+
+
+def test_dataset_native_rejects_misaligned_tokens(tmp_path):
+    """Review regression: a float count token ('1.5') must be rejected
+    by BOTH parsers, not silently consumed as count 1 + value 0.5."""
+    from paddle_tpu.io import native
+    with pytest.raises(ValueError):
+        native.multislot_parse(b"1.5 2.0 3.0", 1, [False])
+
+    f = tmp_path / "bad.txt"
+    f.write_text("1.5 2.0 3.0\n")
+
+    class V:
+        def __init__(self, name):
+            self.name = name
+    for use_native in (True, False):
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_filelist([str(f)])
+        ds.set_use_var([V("x")])
+        ds.use_native_parse = use_native
+        with pytest.raises(ValueError):
+            ds.load_into_memory()
