@@ -1,0 +1,69 @@
+"""Native C MultiSlot parser vs python tokenization (CPU-side, no TPU
+needed — the host ingest half of the CTR pipeline, reference
+data_feed.cc). Prints MB/s for both paths over a synthetic Criteo-like
+file (26 int id slots + 13 dense floats + label).
+
+Run: python -u scripts/bench_multislot.py
+"""
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_file(path, n_records=20000):
+    rng = np.random.RandomState(0)
+    with open(path, "w") as fh:
+        for _ in range(n_records):
+            ids = rng.randint(0, 10**9, 26)
+            dense = rng.rand(13)
+            parts = ["26", " ".join(map(str, ids)),
+                     "13", " ".join(f"{v:.6f}" for v in dense),
+                     "1", str(rng.randint(0, 2))]
+            fh.write(" ".join(parts) + "\n")
+    return os.path.getsize(path)
+
+
+def bench(ds, reps=3):
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        ds.load_into_memory()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    from paddle_tpu import fluid
+
+    class V:
+        def __init__(self, name, dtype):
+            self.name, self.dtype = name, dtype
+
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "criteo.txt")
+        nbytes = make_file(path)
+        print(f"file: {nbytes / 1e6:.1f} MB, 20k records "
+              f"(26 int-id slots, 13 dense, label)")
+        results = {}
+        for use_native in (False, True):
+            ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+            ds.set_batch_size(256)
+            ds.set_filelist([path])
+            ds.set_use_var([V("ids", "int64"), V("dense", "float32"),
+                            V("label", "int64")])
+            ds.use_native_parse = use_native
+            dt = bench(ds)
+            label = "native C" if use_native else "python  "
+            results[use_native] = dt
+            print(f"{label}: {dt * 1e3:8.1f} ms  "
+                  f"({nbytes / dt / 1e6:6.1f} MB/s)")
+        sp = results[False] / results[True]
+        print(f"native speedup: {sp:.2f}x")
+
+
+if __name__ == "__main__":
+    main()
