@@ -138,3 +138,45 @@ def test_battery_refreshes_latest_only_on_positive_value(battery,
     monkeypatch.setattr(battery, "_run", fake_run_zero)
     battery.main()
     assert json.loads(latest.read_text())["value"] == 123.0
+
+
+def test_main_fast_and_full_stage_selection(bench, monkeypatch):
+    """--fast runs only the two headline stages; the full path runs
+    pipeline + seq-512 + seq-2048 and banks their metrics."""
+    import sys as _sys
+    monkeypatch.setattr(bench, "_arm_watchdog", lambda *a, **k: None)
+    monkeypatch.setattr(bench, "_enable_persistent_compile_cache",
+                        lambda: None)
+    monkeypatch.setattr(bench, "_init_backend_with_retry",
+                        lambda *a, **k: True)
+    monkeypatch.setattr(bench, "_probe_pallas_kernels", lambda: None)
+    monkeypatch.setattr(bench, "bench_bert",
+                        lambda **k: (111111.0, 2.5))
+    monkeypatch.setattr(bench, "bench_resnet",
+                        lambda **k: (2500.0, 3.1))
+    calls = []
+    monkeypatch.setattr(bench, "bench_resnet_pipeline",
+                        lambda **k: calls.append("pipe") or (1.0, 2.0))
+    monkeypatch.setattr(bench, "bench_bert_seq512",
+                        lambda **k: calls.append("s512") or (1.0, 0.0))
+    monkeypatch.setattr(bench, "bench_bert_long",
+                        lambda **k: calls.append("s2048") or (1.0, 0.0))
+    for argv, expect_extra in ((["bench.py", "--fast"], False),
+                               (["bench.py"], True)):
+        bench._RESULTS.clear()
+        calls.clear()
+        monkeypatch.setattr(_sys, "argv", argv)
+        import contextlib as _ctx
+        import io as _io2
+        buf = _io2.StringIO()
+        with _ctx.redirect_stdout(buf):
+            bench.main()
+        out = json.loads(
+            [l for l in buf.getvalue().splitlines()
+             if l.startswith("{")][-1])
+        assert out["value"] == 111111.0
+        assert out["resnet50_images_per_sec"] == 2500.0
+        assert (len(calls) > 0) == expect_extra
+        if expect_extra:
+            assert out["bert_seq512_tokens_per_sec"] == 1.0
+            assert out["bert_seq2048_tokens_per_sec"] == 1.0
