@@ -109,6 +109,22 @@ class DatasetBase:
         with open(path, "rb") as fh:
             return fh.read()
 
+    def _native_parse(self, text):
+        """(counts, vals) via csrc ptc_multislot_parse, or None when
+        the native library is unavailable. ValueError (malformed data)
+        always propagates — a re-parse must never mask it. ONE policy
+        shared by the streaming and in-memory paths."""
+        if not getattr(self, "use_native_parse", True):
+            return None
+        try:
+            from ..io import native
+            return native.multislot_parse(
+                text, len(self.use_var_names), self._slot_is_int())
+        except ValueError:
+            raise
+        except Exception:
+            return None  # lib build/load issue: python path
+
     def _records(self):
         """Per file: one pipe/read, then the C MultiSlot parser (csrc
         ptc_multislot_parse — the data_feed.cc rebuild: one
@@ -122,15 +138,7 @@ class DatasetBase:
         n_slots = len(self.use_var_names)
         for path in self.filelist:
             text = self._read_file_text(path)
-            parsed = None
-            if getattr(self, "use_native_parse", True):
-                try:
-                    from ..io import native
-                    parsed = native.multislot_parse(text, n_slots, is_int)
-                except ValueError:
-                    raise  # malformed data: never mask with a re-parse
-                except Exception:
-                    parsed = None  # lib build/load issue: python path
+            parsed = self._native_parse(text)
             if parsed is not None:
                 counts, vals = parsed
                 ivals = vals.view(np.int64)
@@ -203,6 +211,8 @@ class InMemoryDataset(DatasetBase):
         super().__init__()
         self.proto_desc_name = "InMemoryDataset"
         self._memory = None
+        self._columnar = None  # {'counts','offsets','vals','ivals'}
+        self._perm = None
         self.queue_num = None
         self.fleet_send_batch_size = None
 
@@ -213,7 +223,58 @@ class InMemoryDataset(DatasetBase):
         self.fleet_send_batch_size = int(n)
 
     def load_into_memory(self):
-        self._memory = list(self._records())
+        """Native path keeps the parse COLUMNAR (counts/offsets/value
+        lanes straight from csrc ptc_multislot_parse) so batches
+        assemble by vectorized fancy-indexing and shuffling permutes an
+        index array — the reference's resident-Record vector, minus the
+        per-record python objects. Falls back to the python record list
+        when the native library is unavailable; each file's pipe
+        command runs exactly once either way."""
+        n_slots = len(self.use_var_names)
+        # ONE library probe decides the path (availability is global,
+        # not per-file); afterwards each file's text is read (pipe runs
+        # once), parsed, and dropped — peak memory is one file's bytes
+        # plus the accumulated parse, never all raw bytes at once.
+        native_ok = getattr(self, "use_native_parse", True)
+        if native_ok:
+            try:
+                from ..io import native
+                native.get_lib()
+            except Exception:
+                native_ok = False
+        if native_ok:
+            from ..io import native
+            parsed = []  # per-file (counts, vals)
+            for path in self.filelist:
+                text = self._read_file_text(path)
+                # library is proven live: real errors (malformed data,
+                # MemoryError) must raise loudly, not degrade silently
+                parsed.append(native.multislot_parse(
+                    text, n_slots, self._slot_is_int()))
+            counts = (np.concatenate([c for c, _ in parsed])
+                      if parsed else np.zeros((0, n_slots), np.int64))
+            vals = (np.concatenate([v for _, v in parsed])
+                    if parsed else np.zeros((0,), np.float64))
+            flat = counts.reshape(-1)
+            ends = np.cumsum(flat)
+            self._columnar = {
+                "counts": counts,
+                "offsets": (ends - flat).reshape(counts.shape),
+                "vals": vals,
+                "ivals": vals.view(np.int64),
+            }
+            self._perm = np.arange(counts.shape[0])
+            self._memory = None
+        else:
+            self._columnar = None
+            self._perm = None
+            recs = []
+            for path in self.filelist:
+                text = self._read_file_text(path)
+                recs.extend(self._parse_line(line)
+                            for line in text.decode().splitlines()
+                            if line.strip())
+            self._memory = recs
 
     def preload_into_memory(self, thread_num=None):
         self.load_into_memory()
@@ -222,10 +283,14 @@ class InMemoryDataset(DatasetBase):
         pass
 
     def local_shuffle(self):
-        if self._memory is None:
+        if self._memory is None and self._columnar is None:
             raise RuntimeError("call load_into_memory() first")
         from ..random import get_seed
-        np.random.RandomState(get_seed()).shuffle(self._memory)
+        rng = np.random.RandomState(get_seed())
+        if self._columnar is not None:
+            rng.shuffle(self._perm)
+        else:
+            rng.shuffle(self._memory)
 
     def global_shuffle(self, fleet=None, thread_num=12):
         """Single-host: same permutation as local_shuffle (the reference
@@ -235,14 +300,44 @@ class InMemoryDataset(DatasetBase):
 
     def release_memory(self):
         self._memory = None
+        self._columnar = None
+        self._perm = None
 
     def get_memory_data_size(self, fleet=None):
+        if self._columnar is not None:
+            return int(self._columnar["counts"].shape[0])
         return len(self._memory or [])
 
     def get_shuffle_data_size(self, fleet=None):
         return self.get_memory_data_size(fleet)
 
+    def _batches_columnar(self):
+        c = self._columnar
+        counts, offsets = c["counts"], c["offsets"]
+        is_int = self._slot_is_int()
+        n = counts.shape[0]
+        bs = self.batch_size_
+        for start in range(0, n, bs):
+            recs = self._perm[start:start + bs]
+            out = {}
+            for s, name in enumerate(self.use_var_names):
+                cnt = counts[recs, s]
+                w = int(cnt.max()) if len(cnt) else 0
+                src = c["ivals"] if is_int[s] else c["vals"]
+                ar = np.arange(w)
+                idx = offsets[recs, s][:, None] + ar[None, :]
+                mask = ar[None, :] < cnt[:, None]
+                if len(src):
+                    data = src[np.clip(idx, 0, len(src) - 1)]
+                else:
+                    data = np.zeros(idx.shape, src.dtype)
+                out[name] = np.where(mask, data, 0).astype(
+                    "int64" if is_int[s] else "float32", copy=False)
+            yield out
+
     def _batches(self, records=None):
+        if records is None and self._columnar is not None:
+            return self._batches_columnar()
         if records is None and self._memory is not None:
             records = self._memory
         return super()._batches(records)

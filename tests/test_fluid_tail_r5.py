@@ -625,3 +625,40 @@ def test_dataset_native_rejects_misaligned_tokens(tmp_path):
         ds.use_native_parse = use_native
         with pytest.raises(ValueError):
             ds.load_into_memory()
+
+
+def test_dataset_columnar_batches_match_python_after_shuffle(tmp_path):
+    """The columnar (native-parse) batch assembler must produce the
+    SAME batches as the python record path — including after
+    local_shuffle (both draw the same RandomState permutation)."""
+    f = tmp_path / "c.txt"
+    rng = np.random.RandomState(3)
+    with open(f, "w") as fh:
+        for _ in range(23):
+            n = rng.randint(1, 5)
+            ids = rng.randint(0, 10**7, n)
+            fh.write(f"{n} " + " ".join(map(str, ids)) +
+                     f" 1 {rng.rand():.4f}\n")
+
+    class V:
+        def __init__(self, name, dtype):
+            self.name, self.dtype = name, dtype
+
+    def batches(use_native):
+        pt.seed(7)  # same shuffle seed both paths
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(6)
+        ds.set_filelist([str(f)])
+        ds.set_use_var([V("ids", "int64"), V("x", "float32")])
+        ds.use_native_parse = use_native
+        ds.load_into_memory()
+        ds.local_shuffle()
+        return list(ds._batches())
+
+    nat = batches(True)
+    py = batches(False)
+    assert len(nat) == len(py) == 4  # 23 records / 6
+    for a, b in zip(nat, py):
+        for key in ("ids", "x"):
+            np.testing.assert_array_equal(a[key], b[key])
+    assert nat[0]["ids"].dtype == np.int64
