@@ -1,0 +1,94 @@
+"""Cross-validation of numerically-tricky ops against torch CPU (the
+suite's independent oracle, like the existing ctc-vs-torch check):
+grid sampling, affine grids, KL divergence, and the legacy dygraph
+LSTM/GRU cells weight-mapped onto torch.nn.LSTMCell/GRUCell."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as pt
+from paddle_tpu import fluid
+from paddle_tpu.fluid import dygraph
+
+
+def test_affine_grid_matches_torch():
+    rng = np.random.RandomState(0)
+    theta = rng.randn(2, 2, 3).astype("f4")
+    out = fluid.layers.affine_grid(pt.to_tensor(theta),
+                                   [2, 3, 5, 7]).numpy()
+    ref = torch.nn.functional.affine_grid(
+        torch.tensor(theta), (2, 3, 5, 7), align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_grid_sampler_matches_torch():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 6, 5).astype("f4")
+    grid = (rng.rand(2, 4, 7, 2).astype("f4") * 2 - 1)
+    out = fluid.layers.grid_sampler(pt.to_tensor(x),
+                                    pt.to_tensor(grid)).numpy()
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode="bilinear",
+        padding_mode="zeros", align_corners=True).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_kldiv_loss_matches_torch():
+    rng = np.random.RandomState(2)
+    logp = np.log(rng.dirichlet(np.ones(6), size=8).astype("f4") + 1e-8)
+    tgt = rng.dirichlet(np.ones(6), size=8).astype("f4")
+    for reduction in ("mean", "sum", "batchmean", "none"):
+        out = fluid.layers.kldiv_loss(pt.to_tensor(logp),
+                                      pt.to_tensor(tgt),
+                                      reduction=reduction).numpy()
+        ref = torch.nn.functional.kl_div(
+            torch.tensor(logp), torch.tensor(tgt),
+            reduction=reduction).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-6)
+
+
+def _copy_cell_weights(cell, tcell, n_gates):
+    """paddle cudnn-layout cells and torch cells share the (gates*h, in)
+    weight layout and gate order — copy torch's init over."""
+    cell._weight_ih.set_value(tcell.weight_ih.detach().numpy())
+    cell._weight_hh.set_value(tcell.weight_hh.detach().numpy())
+    cell._bias_ih.set_value(tcell.bias_ih.detach().numpy())
+    cell._bias_hh.set_value(tcell.bias_hh.detach().numpy())
+
+
+def test_dygraph_lstm_cell_matches_torch():
+    """fluid.dygraph.LSTMCell (cudnn layout, i/f/g/o chunks) == torch
+    LSTMCell under identical weights."""
+    rng = np.random.RandomState(3)
+    hidden, inp, batch = 8, 5, 4
+    tcell = torch.nn.LSTMCell(inp, hidden)
+    cell = dygraph.LSTMCell(hidden, inp, use_cudnn_impl=True)
+    _copy_cell_weights(cell, tcell, 4)
+
+    x = rng.randn(batch, inp).astype("f4")
+    h = rng.randn(batch, hidden).astype("f4")
+    c = rng.randn(batch, hidden).astype("f4")
+    th, tc = tcell(torch.tensor(x), (torch.tensor(h), torch.tensor(c)))
+    nh, nc = cell(pt.to_tensor(x), pt.to_tensor(h), pt.to_tensor(c))
+    np.testing.assert_allclose(nh.numpy(), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(nc.numpy(), tc.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dygraph_gru_cell_matches_torch():
+    """fluid.dygraph.GRUCell (cudnn layout, r/u/c chunks) == torch
+    GRUCell under identical weights (u==z, cand==n)."""
+    rng = np.random.RandomState(4)
+    hidden, inp, batch = 8, 5, 4
+    tcell = torch.nn.GRUCell(inp, hidden)
+    cell = dygraph.GRUCell(hidden, inp, use_cudnn_impl=True)
+    _copy_cell_weights(cell, tcell, 3)
+
+    x = rng.randn(batch, inp).astype("f4")
+    h = rng.randn(batch, hidden).astype("f4")
+    th = tcell(torch.tensor(x), torch.tensor(h))
+    nh = cell(pt.to_tensor(x), pt.to_tensor(h))
+    np.testing.assert_allclose(nh.numpy(), th.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
