@@ -92,3 +92,106 @@ def test_dygraph_gru_cell_matches_torch():
     nh = cell(pt.to_tensor(x), pt.to_tensor(h))
     np.testing.assert_allclose(nh.numpy(), th.detach().numpy(),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_norm_layers_match_torch_training_mode():
+    """BatchNorm (training stats + running-stat update), GroupNorm,
+    InstanceNorm, LayerNorm vs torch under identical affine params."""
+    from paddle_tpu import nn
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 6, 5, 5).astype("f4") * 2 + 1
+
+    # BatchNorm2D training forward + running stats
+    bn = nn.BatchNorm2D(6, momentum=0.9)
+    tbn = torch.nn.BatchNorm2d(6, momentum=0.1)  # torch momentum = 1-m
+    w = rng.rand(6).astype("f4") + 0.5
+    b = rng.randn(6).astype("f4")
+    bn.weight.set_value(w)
+    bn.bias.set_value(b)
+    with torch.no_grad():
+        tbn.weight.copy_(torch.tensor(w))
+        tbn.bias.copy_(torch.tensor(b))
+    bn.train()
+    tbn.train()
+    out = bn(pt.to_tensor(x)).numpy()
+    ref = tbn(torch.tensor(x)).detach().numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(bn._mean.numpy()), tbn.running_mean.numpy(),
+        rtol=1e-3, atol=1e-5)
+    # torch tracks UNBIASED running var, the reference (and this
+    # framework) biased: var_torch = 0.9 + 0.1*biased*n/(n-1) while
+    # ours = 0.9 + 0.1*biased — relate them exactly
+    n = x.shape[0] * x.shape[2] * x.shape[3]
+    biased_from_torch = (tbn.running_var.numpy() - 0.9) / 0.1 \
+        * (n - 1) / n
+    np.testing.assert_allclose(
+        np.asarray(bn._variance.numpy()),
+        0.9 + 0.1 * biased_from_torch, rtol=1e-3, atol=1e-5)
+
+    # GroupNorm
+    gn = nn.GroupNorm(num_groups=3, num_channels=6)
+    tgn = torch.nn.GroupNorm(3, 6)
+    np.testing.assert_allclose(
+        gn(pt.to_tensor(x)).numpy(),
+        tgn(torch.tensor(x)).detach().numpy(), rtol=1e-3, atol=1e-4)
+
+    # InstanceNorm
+    inn = nn.InstanceNorm2D(6)
+    tin = torch.nn.InstanceNorm2d(6, affine=False)
+    np.testing.assert_allclose(
+        inn(pt.to_tensor(x)).numpy(),
+        tin(torch.tensor(x)).detach().numpy(), rtol=1e-3, atol=1e-4)
+
+    # LayerNorm over trailing dims
+    ln = nn.LayerNorm([6, 5, 5])
+    tln = torch.nn.LayerNorm([6, 5, 5])
+    np.testing.assert_allclose(
+        ln(pt.to_tensor(x)).numpy(),
+        tln(torch.tensor(x)).detach().numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_conv_transpose_and_pool_match_torch():
+    from paddle_tpu import nn
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 8, 8).astype("f4")
+
+    m = nn.Conv2DTranspose(3, 5, 3, stride=2, padding=1)
+    tm = torch.nn.ConvTranspose2d(3, 5, 3, stride=2, padding=1)
+    with torch.no_grad():
+        tm.weight.copy_(torch.tensor(np.asarray(m.weight.numpy())))
+        tm.bias.copy_(torch.tensor(np.asarray(m.bias.numpy())))
+    np.testing.assert_allclose(
+        m(pt.to_tensor(x)).numpy(),
+        tm(torch.tensor(x)).detach().numpy(), rtol=1e-3, atol=1e-4)
+
+    # max + avg pool with uneven stride/padding
+    mp = nn.MaxPool2D(3, stride=2, padding=1)
+    tmp_ = torch.nn.MaxPool2d(3, stride=2, padding=1)
+    np.testing.assert_allclose(
+        mp(pt.to_tensor(x)).numpy(),
+        tmp_(torch.tensor(x)).numpy(), rtol=1e-5)
+    ap = nn.AvgPool2D(2, stride=2)
+    tap = torch.nn.AvgPool2d(2, stride=2)
+    np.testing.assert_allclose(
+        ap(pt.to_tensor(x)).numpy(),
+        tap(torch.tensor(x)).numpy(), rtol=1e-5)
+
+
+def test_prelu_and_activations_match_torch():
+    from paddle_tpu.nn import functional as F
+    rng = np.random.RandomState(7)
+    x = rng.randn(4, 9).astype("f4")
+    tx = torch.tensor(x)
+    pairs = [
+        (lambda t: F.elu(t), torch.nn.functional.elu),
+        (lambda t: F.gelu(t), lambda v: torch.nn.functional.gelu(v)),
+        (lambda t: F.softplus(t), torch.nn.functional.softplus),
+        (lambda t: F.hardtanh(t), torch.nn.functional.hardtanh),
+        (lambda t: F.log_sigmoid(t), torch.nn.functional.logsigmoid),
+        (lambda t: F.tanhshrink(t), torch.nn.functional.tanhshrink),
+    ]
+    for mine, theirs in pairs:
+        np.testing.assert_allclose(
+            mine(pt.to_tensor(x)).numpy(), theirs(tx).numpy(),
+            rtol=1e-4, atol=1e-5)
