@@ -46,6 +46,11 @@ class MegatronConfig(NamedTuple):
     beta1: float = 0.9
     beta2: float = 0.999
     adam_eps: float = 1e-8
+    # int8-wire ring all-reduce for the dp gradient sync
+    # (collective.all_reduce_quantized, EQuARX direction / the
+    # reference's DGC bandwidth lever) — opt-in: ~4x less gradient
+    # traffic at a bounded quantization error; exact psum by default
+    quantized_grad_allreduce: bool = False
 
 
 def factorize_mesh(n_devices):
@@ -457,8 +462,15 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
         # reference's c_allreduce on NCCL — here psum over dp and sp (tp/pp/
         # ep-sharded params already got their grads via their own psums in
         # the forward transpose).
-        grads = jax.tree_util.tree_map(
-            lambda g: lax.pmean(lax.pmean(g, "dp"), "sp"), grads)
+        if cfg.quantized_grad_allreduce:
+            from .collective import all_reduce_quantized
+            n_dp = lax.axis_size("dp")
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(
+                    all_reduce_quantized(g, "dp") / n_dp, "sp"), grads)
+        else:
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(lax.pmean(g, "dp"), "sp"), grads)
         t = state["t"] + 1
         if cfg.optimizer == "adam":
             tf = t.astype(jnp.float32)
