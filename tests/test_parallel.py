@@ -392,3 +392,31 @@ def test_megatron_quantized_grads_trains():
         state, loss = step(state, toks)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("n", [2, 3, 5])
+def test_quantized_allreduce_odd_rings(n):
+    """Non-power-of-2 ring sizes and degenerate inputs (zeros, single
+    element) stay correct."""
+    from paddle_tpu.parallel.collective import all_reduce_quantized
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rng = np.random.RandomState(n)
+    per_dev = rng.randn(n, 37).astype("f4")
+    per_dev[0] = 0.0  # one all-zero contribution
+    exact = per_dev.sum(0)
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    out = np.asarray(jax.jit(jax.shard_map(
+        lambda x: all_reduce_quantized(x, axis_name="dp"), mesh=mesh,
+        in_specs=P("dp", None), out_specs=P("dp", None)))(per_dev))
+    scale = max(np.abs(exact).max(), 1e-6)
+    for rk in range(n):
+        assert np.abs(out[rk] - exact).max() / scale < 0.08
+        np.testing.assert_array_equal(out[rk], out[0])
+
+    # all-zero everywhere: exact zeros out
+    zeros = np.zeros((n, 8), "f4")
+    out0 = np.asarray(jax.jit(jax.shard_map(
+        lambda x: all_reduce_quantized(x, axis_name="dp"), mesh=mesh,
+        in_specs=P("dp", None), out_specs=P("dp", None)))(zeros))
+    np.testing.assert_array_equal(out0, zeros)
