@@ -17,13 +17,24 @@ set -u
 OUT=/tmp/tpu_watcher
 mkdir -p "$OUT"
 cd "$(dirname "$0")/.."
-START=$(date +%s)
-MAX_RUNTIME=$((10 * 3600))   # the round is ~12h: nothing may touch the
-                             # chip after START+10h, so the driver's
-                             # round-end bench never contends
+# WATCHER_START overrides the anchor (epoch seconds) so a restarted
+# watcher keeps its cutoffs relative to the ROUND start, not the
+# restart time
+START=${WATCHER_START:-$(date +%s)}
+MAX_RUNTIME=$((11 * 3600 + 1200))  # probe up to ~T+11h20m (round is
+                             # ~12h; the r4 chip window opened in the
+                             # final hours, so the watcher must stay
+                             # alive into them without ever letting a
+                             # battery overlap the driver's round-end
+                             # bench)
 BATTERY_TIMEOUT=7500         # watcher_battery.py's own deadline is
                              # 7200s; +300s slack so the battery's
                              # bounded skip logic, not SIGKILL, ends it
+FAST_AFTER=$((8 * 3600))     # past T+8h, batteries run the FAST
+                             # profile (bench --fast + top ablations,
+                             # 3300s budget) so a late window still
+                             # fits before the cutoff
+FAST_TIMEOUT=3600
 MAX_BATTERIES=3
 BATTERY_GAP=4500             # >= 75 min between batteries
 BATTERIES=0
@@ -54,13 +65,20 @@ while true; do
         log "stop file present; retiring"
         exit 0
     fi
-    if (( now - START > MAX_RUNTIME - BATTERY_TIMEOUT )); then
+    if (( now - START > FAST_AFTER )); then
+        CUR_TIMEOUT=$FAST_TIMEOUT
+        CUR_ENV="BATTERY_BUDGET_S=3300 BATTERY_FAST=1"
+    else
+        CUR_TIMEOUT=$BATTERY_TIMEOUT
+        CUR_ENV=""
+    fi
+    if (( now - START > MAX_RUNTIME - CUR_TIMEOUT )); then
         log "too close to max runtime to start another battery; retiring"
         exit 0
     fi
     if probe; then
-        log "tunnel ALIVE; running battery $((BATTERIES + 1))"
-        timeout -k 30 "$BATTERY_TIMEOUT" python -u scripts/watcher_battery.py \
+        log "tunnel ALIVE; running battery $((BATTERIES + 1)) (timeout ${CUR_TIMEOUT}s ${CUR_ENV})"
+        env $CUR_ENV timeout -k 30 "$CUR_TIMEOUT" python -u scripts/watcher_battery.py \
             >> "$OUT/battery.log" 2>&1
         log "battery $((BATTERIES + 1)) rc=$?"
         BATTERIES=$((BATTERIES + 1))

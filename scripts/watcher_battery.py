@@ -70,11 +70,13 @@ def _last_json_line(text):
 
 
 def main():
+    fast = os.environ.get("BATTERY_FAST", "") == "1"
     # 3420 > bench.py's own 3300s watchdog: a wedged bench gets killed
     # by ITS watchdog first, which emits the partial-credit fail-JSON
     # carrying any stages that did finish — so a real bert number from a
     # run that wedged at the resnet stage still refreshes LATEST.
-    rc, out = _run([sys.executable, "bench.py"], "bench.log", 3420)
+    cmd = [sys.executable, "bench.py"] + (["--fast"] if fast else [])
+    rc, out = _run(cmd, "bench.log", 2400 if fast else 3420)
     parsed = _last_json_line(out)
     if parsed and parsed.get("value", 0) > 0:
         record = {
@@ -99,13 +101,16 @@ def main():
 
     # Secondary measurements — each independently time-boxed.
     extras = [
+        (["scripts/bench_nhwc_resnet.py"], "nhwc_resnet.log", 1800),
+        (["scripts/bench_adam_multi.py"], "adam_multi.log", 900),
         (["scripts/ablate_bert.py"], "ablate.log", 1800),
         (["scripts/bench_pallas_bn.py"], "pallas_bn.log", 1200),
-        (["scripts/bench_adam_multi.py"], "adam_multi.log", 900),
-        (["scripts/bench_nhwc_resnet.py"], "nhwc_resnet.log", 1800),
         (["scripts/bench_int8.py"], "int8.log", 1200),
         (["scripts/profile_resnet.py"], "profile_resnet.log", 1200),
     ]
+    if fast:
+        # late-window fast profile: the two flip-decision benches only
+        extras = extras[:2]
     for cmd, log_name, budget in extras:
         if not os.path.exists(os.path.join(REPO, cmd[0])):
             print(f"[battery] skip {cmd[0]} (absent)", flush=True)
