@@ -78,6 +78,20 @@ def _probe_pallas_kernels():
             [p * 0 for p in ps], 1e-3, 0.9, 0.999)
         nps[0].block_until_ready()
 
+    def batch_norm():
+        # ResNet-50 stage-1 NHWC shape (the largest BN the bench hits
+        # if the channels-last path is headlined): bf16 activations
+        from paddle_tpu.ops.pallas.batch_norm import _batch_norm2
+        x = jnp.ones((128 * 112 * 112, 64), jnp.bfloat16)
+        w = jnp.ones((64,), jnp.float32)
+        b = jnp.zeros((64,), jnp.float32)
+
+        def f(x):
+            out, _, _ = _batch_norm2(x, w, b, 1e-5)
+            return out.astype(jnp.float32).sum()
+
+        jax.grad(f)(x).block_until_ready()
+
     def softmax_xent():
         # 8192 rows = the real bench shape (batch 64 × seq 128): the r4
         # VMEM blow-up was shape-dependent and a 256-row probe missed it
@@ -94,6 +108,7 @@ def _probe_pallas_kernels():
                         ("layer_norm", layer_norm),
                         ("fused_adam", fused_adam),
                         ("fused_adam_multi", fused_adam_multi),
+                        ("batch_norm", batch_norm),
                         ("softmax_xent", softmax_xent)):
         if not P.enabled(name):
             continue  # auto-off kernel: no bench stage can reach it
