@@ -307,6 +307,65 @@ def bench_bert_seq512(batch=16, seq=512, steps=16, inner=4):
 _RESULTS = {}  # metrics banked as each stage finishes (partial-credit)
 
 
+def _mfu(rate_per_s, flops_per_item):
+    """MFU from a throughput: items/s × train flops/item ÷ the live
+    device's peak bf16 flops (monitor's per-device_kind table, or the
+    PADDLE_TPU_FLOPS_CEILING override). None when the ceiling is
+    unknown (CPU, unrecognized kind) — absent beats fabricated."""
+    try:
+        from paddle_tpu import monitor
+        peak = monitor.peak_flops_for_device()
+    except Exception:
+        peak = None
+    if not peak or not rate_per_s:
+        return None
+    return round(rate_per_s * flops_per_item / peak, 4)
+
+
+def _bert_flops_per_token():
+    """Params-only 6N convention (no attention quadratic term), the
+    common MFU denominator — keeps seq-128/512/2048 rows comparable."""
+    from paddle_tpu import monitor
+    return monitor.transformer_train_flops_per_token(
+        monitor.BERT_BASE_PARAMS)
+
+
+def _provenance(with_device=False):
+    """Who/where/what for the perf ledger: every emitted line (success
+    or _fail_json) carries enough to re-attribute the number later.
+    Device fields are added only after backend init proves the tunnel
+    answers (touching jax.devices() on a wedged tunnel hangs)."""
+    import datetime
+    import os
+    import platform
+    import subprocess
+    prov = {
+        "measured_at": datetime.datetime.now(
+            datetime.timezone.utc).isoformat(timespec="seconds"),
+        "host": platform.node(),
+    }
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__))).stdout.strip()
+        prov["git_rev"] = rev or None
+    except Exception:
+        prov["git_rev"] = None
+    try:
+        import jax
+        prov["jax_version"] = jax.__version__
+        if with_device:
+            d = jax.devices()[0]
+            prov["device_platform"] = d.platform
+            prov["device_kind"] = getattr(d, "device_kind", None)
+            from paddle_tpu import monitor
+            prov["peak_flops_bf16"] = monitor.peak_flops_for_device(d)
+    except Exception:
+        pass
+    return prov
+
+
 def _fail_json(msg):
     """Emit the SAME JSON schema as a successful run so the driver always
     records a parseable line (r3's backend-init exception escaped main()
@@ -452,8 +511,10 @@ def main():
     args = ap.parse_args()
     _arm_watchdog()
     _enable_persistent_compile_cache()
+    _RESULTS["provenance"] = _provenance()  # fail lines carry it too
     if not _init_backend_with_retry():
         return
+    _RESULTS["provenance"] = _provenance(with_device=True)
     _probe_pallas_kernels()
     bert_tps, bert_loss = bench_bert()
     # partial lines are deliberately NOT json (exactly one JSON line at
@@ -462,13 +523,16 @@ def main():
     _RESULTS.update(value=round(bert_tps, 1),
                     vs_baseline=round(bert_tps / BERT_BASELINE_TOKENS_S,
                                       3),
-                    bert_loss=round(bert_loss, 4))
+                    bert_loss=round(bert_loss, 4),
+                    bert_mfu=_mfu(bert_tps, _bert_flops_per_token()))
     rn_ips, rn_loss = bench_resnet()
     print(f"partial resnet_images_per_sec={rn_ips:.1f}", flush=True)
+    from paddle_tpu import monitor as _mon
     _RESULTS.update(
         resnet50_images_per_sec=round(rn_ips, 1),
         resnet50_vs_baseline=round(rn_ips / RESNET_BASELINE_IMG_S, 3),
-        resnet50_loss=round(rn_loss, 4))
+        resnet50_loss=round(rn_loss, 4),
+        resnet50_mfu=_mfu(rn_ips, _mon.RESNET50_TRAIN_FLOPS_PER_IMAGE))
     if not args.fast:
         try:
             pipe_ips, loader_ips = bench_resnet_pipeline()
@@ -491,6 +555,8 @@ def main():
                 tps = 0.0
             print(f"partial {key}={tps:.1f}", flush=True)
             _RESULTS[key] = round(tps, 1)
+            _RESULTS[key.replace("_tokens_per_sec", "_mfu")] = \
+                _mfu(tps, _bert_flops_per_token())
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

@@ -55,6 +55,15 @@ from . import quantization
 from . import slim
 from . import fleet
 from . import dataset
+from . import monitor
+
+# PADDLE_TPU_MONITOR=1 turns the metrics runtime on for the whole
+# process (sink location via PADDLE_TPU_MONITOR_DIR); default stays
+# off — a single flag check on the dispatch fast path.
+import os as _os
+if _os.environ.get("PADDLE_TPU_MONITOR", "") not in ("", "0", "false",
+                                                     "False"):
+    monitor.enable()
 
 # dygraph/static mode management (reference: fluid.enable_dygraph /
 # paddle.enable_static). Dygraph is the default here (modern surface).

@@ -16,6 +16,8 @@ arrays and on tracers — ``jit.to_static`` simply traces the dygraph path.
 """
 from __future__ import annotations
 
+import time as _time
+
 import jax
 
 from .tensor import Tensor, as_tensor
@@ -26,6 +28,21 @@ from .autograd import TapeNode
 # import. When non-None and static mode is on, apply() records graph nodes.
 _static_recorder = None
 _in_static_mode = False
+
+# Monitor hook, installed by paddle_tpu.monitor.enable(). None (the
+# default) keeps the fast path at a single `is None` check — the
+# disabled-mode cost contract asserted by tests/test_monitor.py.
+_monitor_hook = None
+_monitor_time = False
+
+
+def install_monitor_hook(fn, time_ops=False):
+    """fn(name, grad, t0, static=False) or None to uninstall. With
+    time_ops, apply() stamps t0 before running the impl so the hook can
+    histogram host-side dispatch latency."""
+    global _monitor_hook, _monitor_time
+    _monitor_hook = fn
+    _monitor_time = bool(time_ops) and fn is not None
 
 
 def set_static_mode(flag):
@@ -51,8 +68,13 @@ def apply(impl, tensors, attrs=None, nondiff=False, n_out=1, name=""):
     nondiff: output carries no gradient (argmax, comparisons, ...)
     """
     attrs = attrs or {}
+    hook = _monitor_hook  # the single flag check on the disabled path
     if _in_static_mode and _static_recorder is not None:
+        if hook is not None:
+            hook(name, False, None, static=True)
         return _static_recorder(impl, tensors, attrs, nondiff, n_out, name)
+    if hook is not None:
+        t0 = _time.perf_counter() if _monitor_time else None
 
     ts = [as_tensor(t) for t in tensors]
     arrays = [t.data for t in ts]
@@ -74,5 +96,8 @@ def apply(impl, tensors, attrs=None, nondiff=False, n_out=1, name=""):
         node = TapeNode(ts, vjp, list(out_tensors), name=name)
         for ot in out_tensors:
             ot._tape_node = node
+
+    if hook is not None:
+        hook(name, need_grad, t0)
 
     return out_tensors[0] if single else out_tensors

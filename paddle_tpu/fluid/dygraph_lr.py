@@ -35,6 +35,14 @@ class LearningRateDecay:
         self.step_num += self.step_size
         return float(lr)
 
+    def peek(self):
+        """lr at the CURRENT step_num without mutating ANY schedule
+        state. step() is already pure for every built-in decay except
+        LinearLrWarmup (whose step() advances a wrapped inner decay —
+        it overrides this); the optimizer uses peek() for its init-time
+        get_lr() value."""
+        return float(self.step())
+
     def create_lr_var(self, lr):
         # The reference materialized a [1] Variable; host float math
         # keeps the schedule out of the compiled graph here.
@@ -205,3 +213,14 @@ class LinearLrWarmup(LearningRateDecay):
         if self.step_num < self.warmup_steps:
             return self.lr_ratio_before_warmup * self.step_num
         return base_lr
+
+    def peek(self):
+        # step() advances the wrapped inner schedule via base_lr() —
+        # peek the inner decay instead so an init-time read (the
+        # optimizer's get_lr() seed) leaves its step_num untouched.
+        if self.step_num < self.warmup_steps:
+            return float(self.lr_ratio_before_warmup * self.step_num)
+        base_lr = self.learning_rate
+        if isinstance(base_lr, LearningRateDecay):
+            return base_lr.peek()
+        return float(base_lr)

@@ -8,9 +8,25 @@ def one_hot(input, depth, allow_out_of_range=False):
     """reference input.py:one_hot — ids → one-hot along a NEW last axis.
     With allow_out_of_range, out-of-range ids produce all-zero rows
     (jax's one_hot semantics natively); otherwise they are a user error
-    the reference checks at runtime — XLA cannot, so they also produce
-    zero rows rather than UB."""
+    the reference checks at runtime. In eager mode the ids are concrete,
+    so we match the reference and raise; under jit/static tracing XLA
+    cannot raise at run time, so out-of-range ids keep producing zero
+    rows rather than UB."""
     from ..ops.manip import one_hot as _one_hot
+    if not allow_out_of_range:
+        from .. import dispatch
+        import jax as _jax
+        data = getattr(input, "data", input)
+        if (not dispatch.in_static_mode() and data is not None
+                and not isinstance(data, _jax.core.Tracer)):
+            import numpy as _np
+            ids = _np.asarray(_jax.device_get(data))
+            if ids.size and (ids.min() < 0 or ids.max() >= depth):
+                bad = int(ids.min()) if ids.min() < 0 else int(ids.max())
+                raise ValueError(
+                    f"one_hot: input id {bad} is out of range for "
+                    f"depth {depth} (expected 0 <= id < depth); pass "
+                    "allow_out_of_range=True for zero-row semantics")
     out = _one_hot(input, depth)
     # The reference appends depth after the trailing [..., 1] axis is
     # squeezed; manip.one_hot already matches that contract.

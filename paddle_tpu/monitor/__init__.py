@@ -1,0 +1,191 @@
+"""paddle_tpu.monitor — framework-wide metrics & tracing runtime.
+
+The observability subsystem every hot path reports through
+(reference analogue: paddle/fluid/platform/profiler.cc — but that was
+per-op CUDA timings printed at exit; this is a structured, queryable
+record):
+
+* ``dispatch.apply``      — per-op call counts (eager/static, grad/no-grad,
+                            optional host timing), behind one flag check
+* ``parallel.collective`` — per-collective issue counts + payload bytes
+                            by mesh axis
+* ``static.Executor``     — program run/compile counts, cache hits
+* ``optimizer.step``      — step entries per optimizer class
+* ``StepMonitor``         — step time, items/sec, device memory, MFU
+
+Everything funnels into one process-global :class:`Registry` and,
+when a sink is configured (``PADDLE_TPU_MONITOR_DIR`` or an explicit
+path to ``enable()``), a JSONL event stream.
+
+Cost discipline: when disabled (the default), the ONLY overhead on the
+dispatch fast path is a single ``_monitor_hook is None`` check inside
+``dispatch.apply`` — no dict writes, no allocation (asserted by
+tests/test_monitor.py). Collective/executor/optimizer sites check
+``monitor.enabled()`` once per call, off any per-element loop.
+
+Usage::
+
+    import paddle_tpu as pt
+    from paddle_tpu import monitor
+
+    monitor.enable("/tmp/run1")          # or PADDLE_TPU_MONITOR=1 in env
+    ... train ...
+    print(monitor.snapshot("dispatch."))  # per-op counts
+    monitor.disable()                     # flushes a counters snapshot
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from .registry import Registry, JsonlSink, read_jsonl  # noqa: F401
+from .step import (StepMonitor, mfu, peak_flops_for_device,  # noqa: F401
+                   transformer_train_flops_per_token,
+                   device_memory_stats,
+                   BERT_BASE_PARAMS, RESNET50_TRAIN_FLOPS_PER_IMAGE)
+
+__all__ = [
+    "enable", "disable", "enabled", "registry", "counter", "gauge",
+    "histogram", "emit", "snapshot", "reset", "jsonl_path",
+    "record_collective", "StepMonitor", "mfu", "peak_flops_for_device",
+    "transformer_train_flops_per_token", "device_memory_stats",
+    "read_jsonl",
+]
+
+_registry = Registry()
+_sink = None
+_enabled = False
+_time_dispatch = False
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+
+def enabled():
+    return _enabled
+
+
+def registry() -> Registry:
+    return _registry
+
+
+def jsonl_path():
+    """The active sink file, or None (enabled() can be true with no sink
+    — counters still collect in memory)."""
+    return _sink.path if _sink is not None else None
+
+
+def _resolve_sink_path(path):
+    p = str(path)
+    if p.endswith(".jsonl"):
+        return p
+    os.makedirs(p, exist_ok=True)
+    return os.path.join(p, f"events-{os.getpid()}.jsonl")
+
+
+def enable(path=None, time_dispatch=None):
+    """Turn monitoring on. `path` is a directory (an events-<pid>.jsonl
+    file is created inside) or a *.jsonl file path; default is
+    $PADDLE_TPU_MONITOR_DIR, and with neither the registry collects
+    in-memory only. time_dispatch=True additionally histograms host-side
+    per-op dispatch latency ($PADDLE_TPU_MONITOR_TIME_DISPATCH).
+    Returns the JSONL path (or None). Idempotent; a new path replaces
+    the old sink."""
+    global _enabled, _sink, _time_dispatch
+    if time_dispatch is None:
+        time_dispatch = os.environ.get(
+            "PADDLE_TPU_MONITOR_TIME_DISPATCH", "") not in ("", "0")
+    _time_dispatch = bool(time_dispatch)
+
+    target = path or os.environ.get("PADDLE_TPU_MONITOR_DIR")
+    if target:
+        fp = _resolve_sink_path(target)
+        if _sink is None or _sink.path != os.path.abspath(fp):
+            if _sink is not None:
+                _sink.close()
+            _sink = JsonlSink(fp)
+    _enabled = True
+
+    from .. import dispatch
+    dispatch.install_monitor_hook(_dispatch_hook, time_ops=_time_dispatch)
+    emit(kind="monitor", action="enable", pid=os.getpid(),
+         time_dispatch=_time_dispatch)
+    return jsonl_path()
+
+
+def disable(flush_counters=True):
+    """Turn monitoring off: uninstall the dispatch hook (restoring the
+    zero-overhead fast path), emit a final counters snapshot, and close
+    the sink. The registry keeps its values for post-run inspection —
+    reset() clears them."""
+    global _enabled, _sink
+    if flush_counters and _enabled:
+        emit(kind="counters", counters=snapshot())
+    from .. import dispatch
+    dispatch.install_monitor_hook(None)
+    _enabled = False
+    if _sink is not None:
+        _sink.close()
+        _sink = None
+
+
+# ---------------------------------------------------------------------------
+# metric + event surface
+
+def counter(name):
+    return _registry.counter(name)
+
+
+def gauge(name):
+    return _registry.gauge(name)
+
+
+def histogram(name, buckets=None):
+    return _registry.histogram(name, buckets=buckets)
+
+
+def snapshot(prefix=""):
+    return _registry.snapshot(prefix)
+
+
+def reset():
+    _registry.reset()
+
+
+def emit(kind="event", **fields):
+    """Append one JSONL record (no-op without a sink)."""
+    if _sink is not None:
+        rec = {"ts": time.time(), "kind": kind}
+        rec.update(fields)
+        _sink.emit(rec)
+
+
+# ---------------------------------------------------------------------------
+# instrumentation hooks (called by dispatch / collective / executor /
+# optimizer — each call site is behind its own enabled() gate)
+
+def _dispatch_hook(name, grad, t0, static=False):
+    """Installed into paddle_tpu.dispatch while enabled. Must stay
+    allocation-light: two counter incs, plus one histogram observe when
+    host timing is on."""
+    op = name or "anon"
+    _registry.counter(f"dispatch.{op}").inc()
+    if static:
+        _registry.counter(f"dispatch.static.{op}").inc()
+    elif grad:
+        _registry.counter(f"dispatch.grad.{op}").inc()
+    if t0 is not None:
+        _registry.histogram(f"dispatch_ms.{op}").observe(
+            (time.perf_counter() - t0) * 1e3)
+
+
+def record_collective(op, axis_name, nbytes):
+    """Per-collective accounting (parallel/collective.py calls this
+    after its SPMD gate, so pure-eager identity paths don't count).
+    `nbytes` is the per-shard payload at the issue site; inside a jitted
+    region the count is per trace, not per device execution — see
+    docs/observability.md."""
+    axis = axis_name or "none"
+    _registry.counter(f"collective.{op}.{axis}.calls").inc()
+    _registry.counter(f"collective.{op}.{axis}.bytes").inc(int(nbytes))
+    emit(kind="collective", op=op, axis=axis, bytes=int(nbytes))

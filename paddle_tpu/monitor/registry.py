@@ -1,0 +1,215 @@
+"""paddle_tpu.monitor.registry — metric primitives + the JSONL event sink.
+
+The reference stack's observability was per-op CUDA timing tables
+(reference: paddle/fluid/platform/profiler.cc, device_tracer.cc) printed
+at exit. This registry is the TPU rebuild's canonical store: counters,
+gauges and histograms keyed by dotted names, all mutations behind one
+lock, and a line-buffered JSONL sink so every run leaves a
+machine-readable record a later tool (or the perf ledger) can ingest
+without re-running anything.
+
+Metric name convention (dotted, lowest-cardinality label last):
+
+* ``dispatch.<op>``                 — per-op dispatch call counts
+* ``dispatch.grad.<op>``            — the subset recorded on the tape
+* ``dispatch.static.<op>``          — the subset recorded into a Program
+* ``collective.<op>.<axis>.calls``  — collective issue counts per mesh axis
+* ``collective.<op>.<axis>.bytes``  — per-shard payload bytes
+* ``executor.{run,compile,cache_hit,cache_miss}``
+* ``optimizer.step.<Class>``        — optimizer step entries
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+class Counter:
+    """Monotonic counter. ``inc`` only; negative increments are a bug in
+    the caller and raise."""
+
+    kind = "counter"
+
+    def __init__(self, name, lock):
+        self.name = name
+        self._lock = lock
+        self._value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError(f"counter {self.name}: negative inc {n}")
+        with self._lock:
+            self._value += n
+        return self
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins scalar (step time, live memory, mfu...)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, lock):
+        self.name = name
+        self._lock = lock
+        self._value = None
+
+    def set(self, v):
+        with self._lock:
+            self._value = float(v)
+        return self
+
+    @property
+    def value(self):
+        return self._value
+
+    def snapshot(self):
+        return self._value
+
+
+# default bounds cover ns-scale timings through multi-GB byte counts
+_DEFAULT_BUCKETS = tuple(4.0 ** e for e in range(-10, 18))
+
+
+class Histogram:
+    """Bucketed distribution: count/sum/min/max plus cumulative-style
+    bucket counts (each observation lands in the first bound >= value;
+    values past the last bound land in the +Inf overflow)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, lock, buckets=None):
+        self.name = name
+        self._lock = lock
+        self.buckets = tuple(sorted(buckets or _DEFAULT_BUCKETS))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def observe(self, v):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            self.min = v if self.min is None else min(self.min, v)
+            self.max = v if self.max is None else max(self.max, v)
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    break
+            else:
+                self._counts[-1] += 1
+        return self
+
+    @property
+    def mean(self):
+        return self.sum / self.count if self.count else 0.0
+
+    def snapshot(self):
+        out = {"count": self.count, "sum": self.sum, "min": self.min,
+               "max": self.max}
+        # only the populated buckets — full default bounds are noise
+        out["buckets"] = {
+            ("inf" if i == len(self.buckets) else repr(self.buckets[i])): c
+            for i, c in enumerate(self._counts) if c}
+        return out
+
+
+class Registry:
+    """Name → metric store. One RLock guards creation and every
+    mutation; get-or-create with a conflicting type raises."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics = {}
+
+    def _get_or_create(self, name, cls, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, self._lock, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name, buckets=None) -> Histogram:
+        return self._get_or_create(name, Histogram, buckets=buckets)
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def value(self, name, default=0):
+        m = self._metrics.get(name)
+        return default if m is None else m.value
+
+    def names(self, prefix=""):
+        with self._lock:
+            return sorted(n for n in self._metrics if n.startswith(prefix))
+
+    def snapshot(self, prefix=""):
+        """{name: scalar-or-dict} for every metric under `prefix`."""
+        with self._lock:
+            return {n: m.snapshot() for n, m in sorted(self._metrics.items())
+                    if n.startswith(prefix)}
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+class JsonlSink:
+    """Append-only JSONL event writer. Every record gets a wall-clock
+    ``ts``; writes are line-atomic under a lock and flushed eagerly so a
+    killed run keeps everything emitted before the kill."""
+
+    def __init__(self, path):
+        self.path = os.path.abspath(path)
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def emit(self, record: dict):
+        record.setdefault("ts", time.time())
+        line = json.dumps(record, default=str)
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+def read_jsonl(path):
+    """Parse a sink file back into a list of dicts (the test/tooling
+    round-trip helper)."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

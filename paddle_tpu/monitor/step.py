@@ -1,0 +1,218 @@
+"""paddle_tpu.monitor.step — training-loop instrumentation + MFU.
+
+``StepMonitor`` wraps a training loop and reports, per step window:
+step time, items/sec (tokens or images), device memory stats
+(``jax.local_devices()[i].memory_stats()``), and MFU against a
+configurable flops ceiling. Each step emits a JSONL ``step`` record
+through the monitor sink, and ``report()`` prints a summary table plus a
+final ``counters`` snapshot event — the round's perf ledger rows
+(docs/PERF_LEDGER.md) are built from exactly these records.
+
+MFU here is the standard model-flops utilization: model flops per step
+(NOT hardware flops — rematerialization and padding don't count) divided
+by step time, over the chip's peak. The ceiling resolves, in order:
+an explicit ``peak_flops=``, ``PADDLE_TPU_FLOPS_CEILING``, then a
+device-kind table of per-chip dense bf16 peaks. Unknown device (e.g. the
+CPU test mesh) leaves ``mfu`` null rather than inventing a number.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+# per-chip dense bf16 peak FLOP/s by jax device_kind substring
+_PEAK_FLOPS_BF16 = (
+    ("TPU v6", 918e12),
+    ("TPU v5p", 459e12),
+    ("TPU v5 lite", 197e12),
+    ("TPU v5e", 197e12),
+    ("TPU v4", 275e12),
+    ("TPU v3", 123e12),
+    ("TPU v2", 46e12),
+)
+
+# BERT-base has ~110M params; training flops/token ~= 6N (fwd 2N + bwd 4N)
+BERT_BASE_PARAMS = 110e6
+# ResNet-50 fwd @224 is ~4.1 GMACs = 8.2 GFLOPs; training ~= 3x fwd
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 8.2e9
+
+
+def transformer_train_flops_per_token(n_params):
+    """6N flops/token (Kaplan/PaLM accounting: fwd 2N + bwd 4N)."""
+    return 6.0 * float(n_params)
+
+
+def peak_flops_for_device(device=None):
+    """Per-chip flops ceiling, or None when the device is unknown.
+    PADDLE_TPU_FLOPS_CEILING (flops/s) overrides the table."""
+    env = os.environ.get("PADDLE_TPU_FLOPS_CEILING")
+    if env:
+        return float(env)
+    if device is None:
+        import jax
+        try:
+            device = jax.local_devices()[0]
+        except Exception:
+            return None
+    kind = str(getattr(device, "device_kind", ""))
+    for tag, peak in _PEAK_FLOPS_BF16:
+        if tag in kind:
+            return peak
+    return None
+
+
+def mfu(flops_per_step, step_time_s, peak_flops=None):
+    """Model-flops utilization, or None if the ceiling is unknown."""
+    peak = peak_flops if peak_flops is not None else peak_flops_for_device()
+    if not peak or not flops_per_step or not step_time_s:
+        return None
+    return flops_per_step / step_time_s / peak
+
+
+def device_memory_stats():
+    """bytes_in_use / peak_bytes_in_use per local device; {} where the
+    backend exposes nothing (CPU returns None)."""
+    import jax
+    out = {}
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return out
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out[str(d.id)] = {
+            k: stats[k] for k in ("bytes_in_use", "peak_bytes_in_use",
+                                  "bytes_limit") if k in stats}
+    return out
+
+
+class StepMonitor:
+    """Wraps a training loop:
+
+        mon = monitor.StepMonitor(items_per_step=batch * seq,
+                                  flops_per_step=6 * n_params * batch * seq,
+                                  item="tokens", label="bert")
+        for batch in loader:
+            loss = train_step(batch)
+            mon.step(loss=loss)
+        mon.report()
+
+    ``step()`` stamps the wall-clock since the previous step (call it
+    AFTER the device sync your loop already does — an async dispatch
+    makes any host timer lie), updates throughput/mfu gauges, and emits
+    one JSONL ``step`` record per ``window`` steps (default every step).
+    """
+
+    def __init__(self, items_per_step=None, flops_per_step=None,
+                 peak_flops=None, item="items", label="train", window=1,
+                 memory_every=10):
+        self.items_per_step = items_per_step
+        self.flops_per_step = flops_per_step
+        self.peak_flops = (peak_flops if peak_flops is not None
+                           else peak_flops_for_device())
+        self.item = item
+        self.label = label
+        self.window = max(1, int(window))
+        self.memory_every = max(1, int(memory_every))
+        self.steps = 0
+        self.total_time = 0.0
+        self.records = []
+        self._last = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.report()
+
+    def start(self):
+        self._last = time.perf_counter()
+        return self
+
+    def step(self, items=None, loss=None, **extra):
+        """Mark one completed step; returns the record dict."""
+        now = time.perf_counter()
+        if self._last is None:
+            self._last = now
+            return None
+        dt = now - self._last
+        self._last = now
+        self.steps += 1
+        self.total_time += dt
+
+        items = items if items is not None else self.items_per_step
+        rate = (items / dt) if (items and dt > 0) else None
+        step_mfu = mfu(self.flops_per_step, dt, self.peak_flops)
+
+        from . import emit, enabled, gauge
+        rec = {"kind": "step", "label": self.label, "step": self.steps,
+               "step_time_s": round(dt, 6),
+               f"{self.item}_per_sec": round(rate, 2) if rate else None,
+               "items_per_sec": round(rate, 2) if rate else None,
+               "mfu": round(step_mfu, 4) if step_mfu is not None else None}
+        if loss is not None:
+            try:
+                rec["loss"] = float(loss.numpy() if hasattr(loss, "numpy")
+                                    else loss)
+            except Exception:
+                pass
+        rec.update(extra)
+        if self.steps % self.memory_every == 0 or self.steps == 1:
+            mem = device_memory_stats()
+            if mem:
+                rec["device_memory"] = mem
+        self.records.append(rec)
+        if enabled():
+            gauge(f"step.{self.label}.time_s").set(dt)
+            if rate:
+                gauge(f"step.{self.label}.items_per_sec").set(rate)
+            if step_mfu is not None:
+                gauge(f"step.{self.label}.mfu").set(step_mfu)
+            if self.steps % self.window == 0:
+                emit(**rec)
+        return rec
+
+    # -- summary ------------------------------------------------------------
+    def summary(self):
+        if not self.steps:
+            return {"label": self.label, "steps": 0}
+        avg_dt = self.total_time / self.steps
+        rate = (self.items_per_step / avg_dt
+                if self.items_per_step and avg_dt > 0 else None)
+        return {
+            "label": self.label, "steps": self.steps,
+            "avg_step_time_s": round(avg_dt, 6),
+            f"{self.item}_per_sec": round(rate, 2) if rate else None,
+            "mfu": (round(mfu(self.flops_per_step, avg_dt,
+                              self.peak_flops), 4)
+                    if mfu(self.flops_per_step, avg_dt,
+                           self.peak_flops) is not None else None),
+            "peak_flops_ceiling": self.peak_flops,
+        }
+
+    def report(self, print_table=True):
+        """Print the summary table and emit it (plus a full counters
+        snapshot) to the JSONL sink; returns the summary dict."""
+        s = self.summary()
+        if print_table and self.steps:
+            rate = s.get(f"{self.item}_per_sec")
+            rows = [("steps", s["steps"]),
+                    ("avg step time", f"{s['avg_step_time_s'] * 1e3:.2f} ms"),
+                    (f"{self.item}/sec", f"{rate:,.1f}" if rate else "n/a"),
+                    ("mfu", f"{s['mfu']:.1%}" if s["mfu"] is not None
+                     else "n/a (no flops ceiling)")]
+            width = max(len(k) for k, _ in rows)
+            print(f"[paddle_tpu.monitor] {self.label}")
+            for k, v in rows:
+                print(f"  {k:<{width}}  {v}")
+        from . import emit, enabled, snapshot
+        if enabled():
+            emit(kind="step_summary", **s)
+            emit(kind="counters", counters=snapshot())
+        return s

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..tensor import Tensor, Parameter
 from ..regularizer import WeightDecayRegularizer, L2Decay
 from ..clip import ClipGradBase
+from .. import monitor as _monitor
 from . import lr as lr_sched
 from .lr import LRScheduler
 
@@ -58,9 +59,11 @@ class Optimizer:
             # each step (reference optimizer.py dygraph minimize path),
             # vs LRScheduler's user-driven scheduler.step().
             self._lr_decay = learning_rate
-            # current-step value WITHOUT advancing (step() computes,
-            # __call__ advances), so get_lr() is right before training
-            lr_value = float(learning_rate.step())
+            # current-step value WITHOUT advancing, so get_lr() is right
+            # before training. peek() — NOT step(): LinearLrWarmup's
+            # step() calls a wrapped inner decay, which would advance
+            # the inner schedule once before training ever starts.
+            lr_value = float(learning_rate.peek())
         else:
             lr_value = float(learning_rate)
         # lr lives on device so compiled steps treat it as input state
@@ -113,6 +116,8 @@ class Optimizer:
     def step(self):
         """Apply one update from accumulated .grad (reference: dygraph
         minimize path in optimizer.py:Optimizer.apply_gradients)."""
+        if _monitor.enabled():
+            _monitor.counter(f"optimizer.step.{type(self).__name__}").inc()
         if self._lr_decay is not None:
             # host-side schedule: advance + refresh the device lr tensor
             # (under jit the tensor is input state, so no retrace)
@@ -422,10 +427,16 @@ class Adam(Optimizer):
         return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p,
                        "beta2_pow": b2p}
 
+    _warned_unequal_beta_pow = False
+
     def _batched_update(self, params_grads, lr):
         """Multi-tensor path (reference adam_op.cu FusedAdamKernel):
         one Pallas dispatch updates every param. Shared beta-pow bias
-        correction — see fused_adam_update_multi's semantics note."""
+        correction — see fused_adam_update_multi's semantics note. The
+        shared correction is only valid when every live param has
+        stepped in lockstep; unequal beta-pow slots (a param added
+        mid-training, a partial checkpoint restore) warn once and fall
+        back to the exact per-tensor loop."""
         use = self._use_multi_tensor
         if use is None:
             from ..ops import pallas as P
@@ -437,6 +448,19 @@ class Adam(Optimizer):
         for p, _ in live:
             self._pre_param(p)
         slots = [self._accumulators[id(p)] for p, _ in live]
+        if not self._beta_pows_aligned(slots):
+            if not Adam._warned_unequal_beta_pow:
+                import warnings
+                warnings.warn(
+                    "multi-tensor Adam: live params' beta1_pow/beta2_pow "
+                    "slots are not all equal (params stepped out of "
+                    "lockstep); falling back to the exact per-tensor "
+                    "update loop", RuntimeWarning)
+                Adam._warned_unequal_beta_pow = True
+            if _monitor.enabled():
+                _monitor.counter(
+                    "optimizer.adam_multi_tensor_fallback").inc()
+            return False
         b1p = slots[0]["beta1_pow"].data * self._beta1
         b2p = slots[0]["beta2_pow"].data * self._beta2
         new_ps, new_ms, new_vs = fused_adam_update_multi(
@@ -453,6 +477,20 @@ class Adam(Optimizer):
             s["beta1_pow"].data = b1p
             s["beta2_pow"].data = b2p
         return True
+
+    @staticmethod
+    def _beta_pows_aligned(slots):
+        """True when every live param's beta-pow pair matches slot 0's.
+        Tracers (a step being traced by jit.to_static) can't be compared
+        host-side — the traced loop keeps whatever layout it was traced
+        with, so treat them as aligned."""
+        vals = []
+        for s in slots:
+            pair = (s["beta1_pow"].data, s["beta2_pow"].data)
+            if any(isinstance(v, jax.core.Tracer) for v in pair):
+                return True
+            vals.append((float(pair[0]), float(pair[1])))
+        return all(v == vals[0] for v in vals[1:])
 
 
 class AdamW(Adam):

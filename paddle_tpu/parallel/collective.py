@@ -24,6 +24,7 @@ from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
 
 from ..tensor import Tensor, as_tensor
 from ..dispatch import apply
+from .. import monitor as _monitor
 
 # ---------------------------------------------------------------------------
 # global mesh registry (the TPU analogue of the reference's communicator /
@@ -82,10 +83,35 @@ def shard(x, spec, mesh=None):
 # SPMD-region detection: collectives need an axis name bound by
 # shard_map/pmap; in plain eager (or plain jit) they act as identity.
 
+def axis_size(axis_name):
+    """lax.axis_size(axis_name) across jax versions. Older jax has no
+    lax.axis_size; psum of the literal 1 folds statically to the axis
+    size inside any SPMD region and raises NameError outside — exactly
+    the contract callers (and in_spmd_context) need."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, check_vma=None):
+    """jax.shard_map across jax versions: older jax only ships
+    jax.experimental.shard_map.shard_map, whose replication-check kwarg
+    is spelled check_rep rather than check_vma."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    from jax.experimental.shard_map import shard_map as _sm
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+
+
 def in_spmd_context(axis_name=None):
     try:
         if axis_name is not None:
-            lax.axis_size(axis_name)
+            axis_size(axis_name)
             return True
         return False
     except (NameError, KeyError, Exception):
@@ -100,10 +126,30 @@ def _maybe(axis_name):
     return axis_name is not None and in_spmd_context(axis_name)
 
 
+def _account(op, x, axis_name):
+    """Monitor accounting for one issued collective: op count + payload
+    bytes by mesh axis. Runs AFTER the SPMD gate, so eager identity
+    fallbacks don't count. Shapes are static under shard_map tracing, so
+    this works on tracers; bytes are the per-shard payload, and inside a
+    jitted region the record is per trace, not per device execution."""
+    if not _monitor.enabled():
+        return
+    a = x.data if isinstance(x, Tensor) else x
+    shape = tuple(getattr(a, "shape", ()) or ())
+    try:
+        itemsize = jnp.dtype(getattr(a, "dtype", jnp.float32)).itemsize
+    except TypeError:
+        itemsize = 4
+    nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize if shape \
+        else itemsize
+    _monitor.record_collective(op, axis_name, nbytes)
+
+
 def all_reduce(x, op="sum", axis_name="dp", group=None):
     """c_allreduce_* → lax.psum/pmax/pmin on the ICI mesh axis."""
     if not _maybe(axis_name):
         return as_tensor(x)
+    _account(f"c_allreduce_{op}", x, axis_name)
     fns = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
            "prod": lambda v, n: jnp.exp(lax.psum(jnp.log(v), n))}
     fn = fns[op]
@@ -114,6 +160,7 @@ def all_gather(x, axis=0, axis_name="dp", group=None):
     """c_allgather → lax.all_gather along the mesh axis."""
     if not _maybe(axis_name):
         return as_tensor(x)
+    _account("c_allgather", x, axis_name)
     return apply(lambda x: lax.all_gather(x, axis_name, axis=axis,
                                           tiled=True),
                  (x,), name="c_allgather")
@@ -123,6 +170,7 @@ def reduce_scatter(x, axis=0, axis_name="dp", group=None):
     """c_reducescatter → lax.psum_scatter."""
     if not _maybe(axis_name):
         return as_tensor(x)
+    _account("c_reducescatter", x, axis_name)
     return apply(lambda x: lax.psum_scatter(x, axis_name,
                                             scatter_dimension=axis,
                                             tiled=True),
@@ -133,6 +181,7 @@ def broadcast(x, src=0, axis_name="dp", group=None):
     """c_broadcast: every rank takes rank-src's value (select+psum)."""
     if not _maybe(axis_name):
         return as_tensor(x)
+    _account("c_broadcast", x, axis_name)
 
     def impl(x):
         idx = lax.axis_index(axis_name)
@@ -146,6 +195,7 @@ def all_to_all(x, split_axis=0, concat_axis=0, axis_name="dp", group=None):
     """alltoall_op → lax.all_to_all (the sequence/expert-parallel workhorse)."""
     if not _maybe(axis_name):
         return as_tensor(x)
+    _account("alltoall", x, axis_name)
     return apply(lambda x: lax.all_to_all(x, axis_name, split_axis,
                                           concat_axis, tiled=True),
                  (x,), name="alltoall")
@@ -156,6 +206,7 @@ def ppermute(x, perm, axis_name="dp"):
     pipeline parallelism)."""
     if not _maybe(axis_name):
         return as_tensor(x)
+    _account("ppermute", x, axis_name)
     return apply(lambda x: lax.ppermute(x, axis_name, perm), (x,),
                  name="ppermute")
 
@@ -164,6 +215,7 @@ def barrier(axis_name="dp", group=None):
     """barrier_op — on XLA a barrier is an all-reduce of a scalar."""
     if not _maybe(axis_name):
         return
+    _account("barrier", jnp.zeros((), jnp.float32), axis_name)
     lax.psum(jnp.zeros((), jnp.float32), axis_name)
 
 
@@ -176,7 +228,7 @@ def rank(axis_name="dp"):
 def world_size(axis_name="dp"):
     if not _maybe(axis_name):
         return 1
-    return lax.axis_size(axis_name)
+    return axis_size(axis_name)
 
 
 # reference-parity aliases (fluid.layers.collective underscored names)
@@ -201,7 +253,7 @@ def all_reduce_quantized(x, axis_name="dp", bits=8):
     SUM over the axis (like lax.psum). bits=8 only (int8 wire)."""
     if bits != 8:
         raise ValueError("int8 wire only (bits=8)")
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return x
     qmax = 127.0

@@ -59,7 +59,7 @@ def sharded_lookup(ids, weight, axis_name="mp"):
     """Explicit lookup for shard_map regions: `weight` is the LOCAL row
     shard; out-of-range ids contribute zeros; one psum merges."""
     def impl(ids, w):
-        n = lax.axis_size(axis_name)
+        n = collective.axis_size(axis_name)
         r = lax.axis_index(axis_name)
         rows = w.shape[0]
         lo = r * rows

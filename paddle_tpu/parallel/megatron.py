@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P, NamedSharding
 
+from .collective import axis_size as _axis_size
+
 
 # ---------------------------------------------------------------------------
 # config
@@ -268,7 +270,7 @@ def _scale_grad(x, factor):
 def _moe_ffn(x, p, cfg):
     """Expert-parallel MoE ffn: top-1 routing + all_to_all over ep.
     x: [mb, s, h] -> same."""
-    ep = lax.axis_size("ep")
+    ep = _axis_size("ep")
     n_exp_local = cfg.n_experts
     n_exp = ep * n_exp_local
     mb, s, h = x.shape
@@ -325,7 +327,7 @@ def _stage_fn(x, stage_params, cfg, is_last):
 def _pipeline(x_micro, p_local, cfg):
     """GPipe over pp via ppermute: x_micro [n_micro, mb, s_local, h].
     Device at pp-rank r runs stage r; activations ride the ring."""
-    n = lax.axis_size("pp")
+    n = _axis_size("pp")
     r = lax.axis_index("pp")
     n_micro = x_micro.shape[0]
     T = n_micro + n - 1
@@ -364,7 +366,7 @@ def _loss_fn(params_local, tokens, cfg):
     LOCAL slice [n_micro, mb, s_local] of input ids; labels are the shifted
     ids (computed globally before sharding — here next-token within the
     local block for simplicity of the dryrun)."""
-    sp = lax.axis_size("sp")
+    sp = _axis_size("sp")
     sp_r = lax.axis_index("sp")
     s_local = tokens.shape[-1]
     h = cfg.hidden
@@ -464,7 +466,7 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
         # the forward transpose).
         if cfg.quantized_grad_allreduce:
             from .collective import all_reduce_quantized
-            n_dp = lax.axis_size("dp")
+            n_dp = _axis_size("dp")
             grads = jax.tree_util.tree_map(
                 lambda g: lax.pmean(
                     all_reduce_quantized(g, "dp") / n_dp, "sp"), grads)
@@ -510,8 +512,9 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
     # tokens: [n_micro, batch, seq]: batch over dp, seq over sp
     token_spec = P(None, "dp", "sp")
 
+    from .collective import shard_map_compat
     step = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             device_fn, mesh=mesh,
             in_specs=(state_spec, token_spec),
             out_specs=(state_spec, P()),

@@ -29,6 +29,7 @@ from ..tensor import Tensor, Parameter
 from ..dispatch import apply
 from .. import autograd as _ag
 from ..nn.layer import Layer
+from .collective import axis_size as _axis_size
 
 __all__ = ["PipelineStack", "PipelineSchedule", "build_schedule",
            "pipeline_step"]
@@ -349,7 +350,7 @@ def pipeline_step(schedule, stage_fn, loss_fn, params, x_micro,
     stage INPUT saved by its F op; the stage is re-run inside vjp), so
     activation memory follows the schedule's peak_live_activations, not
     the autodiff engine's whole-timeline saves."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     r = lax.axis_index(axis)
     m = schedule.n_micro
     v = schedule.n_chunks

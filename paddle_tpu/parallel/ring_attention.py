@@ -23,10 +23,11 @@ from jax import lax
 
 from ..tensor import Tensor, as_tensor
 from ..dispatch import apply
+from .collective import axis_size as _axis_size
 
 
 def _ring_attention_impl(q, k, v, axis_name, causal, scale):
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     me = lax.axis_index(axis_name)
     sl = q.shape[-2]  # local seq block
     d = q.shape[-1]

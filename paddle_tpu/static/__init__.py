@@ -23,6 +23,7 @@ import jax.numpy as jnp
 
 from ..tensor import Tensor, Parameter, convert_dtype
 from .. import dispatch
+from .. import monitor as _monitor
 
 
 # ---------------------------------------------------------------------------
@@ -425,6 +426,10 @@ class Executor:
         key = (program.id, program.version, tuple(fetch_names),
                tuple(sorted((k, a.shape, str(a.dtype))
                             for k, a in feed_arrays.items())))
+        if _monitor.enabled():
+            _monitor.counter("executor.run").inc()
+            _monitor.counter("executor.cache_hit" if key in self._cache
+                             else "executor.cache_miss").inc()
         if key not in self._cache:
             self._cache[key] = self._compile(program, fetch_names,
                                              sorted(feed_arrays),
@@ -486,6 +491,13 @@ class Executor:
 
     def _compile(self, program, fetch_names, feed_order, param_names,
                  slot_names):
+        if _monitor.enabled():
+            _monitor.counter("executor.compile").inc()
+            _monitor.emit(kind="executor_compile", program_id=program.id,
+                          program_version=program.version,
+                          n_ops=len(program.global_block().ops),
+                          n_params=len(param_names),
+                          fetches=list(fetch_names))
         ops = list(program.global_block().ops)
         const_vals = {n: t.data for n, t in program.const_vars.items()}
         opt_entries = program.optimizers

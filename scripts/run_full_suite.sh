@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Full test battery: overrides pytest.ini's `-m "not slow"` default so
+# the slow-marked gold parity suites (SPMD 8-dev shard_map tests,
+# long-seq kernels) actually run, with the monitor runtime enabled so
+# the run leaves a JSONL evidence stream behind.
+#
+#   scripts/run_full_suite.sh [extra pytest args...]
+#
+# Env: PADDLE_TPU_SUITE_PLATFORM=cpu|tpu (default cpu) picks the jax
+# backend; the monitor sink lands in ${PADDLE_TPU_MONITOR_DIR:-/tmp/paddle_tpu_suite}.
+set -u
+cd "$(dirname "$0")/.."
+
+PLATFORM="${PADDLE_TPU_SUITE_PLATFORM:-cpu}"
+MONITOR_DIR="${PADDLE_TPU_MONITOR_DIR:-/tmp/paddle_tpu_suite}"
+mkdir -p "$MONITOR_DIR"
+
+JAX_PLATFORMS="$PLATFORM" \
+PADDLE_TPU_MONITOR=1 \
+PADDLE_TPU_MONITOR_DIR="$MONITOR_DIR" \
+python -m pytest tests/ -q -m "" \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:randomly \
+    "$@"
+rc=$?
+
+latest=$(ls -t "$MONITOR_DIR"/events-*.jsonl 2>/dev/null | head -1)
+echo ""
+echo "monitor JSONL: ${latest:-<none written>} (dir: $MONITOR_DIR)"
+exit $rc
