@@ -487,18 +487,36 @@ def _arm_watchdog(seconds=3300):
     signal.alarm(seconds)
 
 
-def _enable_persistent_compile_cache():
-    """Persist XLA compilations across bench processes: first compile of
+def _enable_monitoring_and_cache():
+    """Persist XLA compilations across bench processes (first compile of
     a BERT-size step over the tunnel costs minutes — a cache seeded by an
-    earlier run (e.g. the watcher's) makes the driver's run start from
-    warm executables."""
+    earlier run makes this one start from warm executables) and turn on
+    the in-memory monitor so compiles_per_stage can ride the perf line.
+    Called only AFTER backend init: importing paddle_tpu earlier would
+    touch jax before the subprocess probe proved the tunnel answers."""
+    from paddle_tpu import monitor
+    from paddle_tpu.device import enable_compilation_cache
+    if enable_compilation_cache("/tmp/paddle_tpu_xla_cache") is None:
+        print("compile cache unavailable", flush=True)
+    monitor.enable()  # no sink path: in-memory counters only
+
+
+_COMPILES_SEEN = {"n": 0}
+
+
+def _record_stage_compiles(stage):
+    """Bank how many fresh XLA executables this stage minted (jit +
+    executor compile counters) — next to throughput, the evidence that
+    shape bucketing / the persistent cache keep the compile count flat."""
     try:
-        import jax
-        jax.config.update("jax_compilation_cache_dir",
-                          "/tmp/paddle_tpu_xla_cache")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:  # pragma: no cover - version dependent
-        print(f"compile cache unavailable: {e}", flush=True)
+        from paddle_tpu import monitor
+        reg = monitor.registry()
+        total = int(reg.value("jit.compile", 0)) + \
+            int(reg.value("executor.compile", 0))
+    except Exception:
+        return
+    delta, _COMPILES_SEEN["n"] = total - _COMPILES_SEEN["n"], total
+    _RESULTS.setdefault("compiles_per_stage", {})[stage] = delta
 
 
 def main():
@@ -510,13 +528,14 @@ def main():
                          "stages)")
     args = ap.parse_args()
     _arm_watchdog()
-    _enable_persistent_compile_cache()
     _RESULTS["provenance"] = _provenance()  # fail lines carry it too
     if not _init_backend_with_retry():
         return
     _RESULTS["provenance"] = _provenance(with_device=True)
+    _enable_monitoring_and_cache()
     _probe_pallas_kernels()
     bert_tps, bert_loss = bench_bert()
+    _record_stage_compiles("bert_seq128")
     # partial lines are deliberately NOT json (exactly one JSON line at
     # the end) — they leave evidence if the harness kills us mid-run
     print(f"partial bert_tokens_per_sec={bert_tps:.1f}", flush=True)
@@ -526,6 +545,7 @@ def main():
                     bert_loss=round(bert_loss, 4),
                     bert_mfu=_mfu(bert_tps, _bert_flops_per_token()))
     rn_ips, rn_loss = bench_resnet()
+    _record_stage_compiles("resnet50")
     print(f"partial resnet_images_per_sec={rn_ips:.1f}", flush=True)
     from paddle_tpu import monitor as _mon
     _RESULTS.update(
@@ -540,6 +560,7 @@ def main():
             print(f"pipeline bench failed: {type(e).__name__}: {e}",
                   flush=True)
             pipe_ips, loader_ips = 0.0, 0.0
+        _record_stage_compiles("resnet50_pipeline")
         print(f"partial pipeline_images_per_sec={pipe_ips:.1f}",
               flush=True)
         _RESULTS.update(
@@ -553,6 +574,7 @@ def main():
                 print(f"{key} bench failed: {type(e).__name__}: {e}",
                       flush=True)
                 tps = 0.0
+            _record_stage_compiles(key.replace("_tokens_per_sec", ""))
             print(f"partial {key}={tps:.1f}", flush=True)
             _RESULTS[key] = round(tps, 1)
             _RESULTS[key.replace("_tokens_per_sec", "_mfu")] = \

@@ -32,7 +32,8 @@ from .ops.math import clip  # noqa: F811
 from .param_attr import ParamAttr, WeightNormParamAttr
 from . import device
 from .device import (CPUPlace, TPUPlace, CUDAPlace, set_device, get_device,
-                     is_compiled_with_cuda, device_count)
+                     is_compiled_with_cuda, device_count,
+                     enable_compilation_cache)
 
 # framework-level namespaces filled in by submodules as they land
 from . import jit

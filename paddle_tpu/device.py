@@ -90,3 +90,30 @@ def is_compiled_with_tpu():
 
 def device_count():
     return jax.device_count()
+
+
+def enable_compilation_cache(path=None, min_compile_time_secs=1.0):
+    """Point XLA's persistent compilation cache at ``path`` so executables
+    survive process restarts — the second run of a job skips its multi-
+    minute compile entirely.
+
+    ``path`` defaults to ``$PADDLE_TPU_COMPILE_CACHE_DIR`` or
+    ``~/.cache/paddle_tpu/xla_cache``. Programs that compile faster than
+    ``min_compile_time_secs`` are not persisted (tiny shapes would churn
+    the cache for no win). Returns the cache path, or ``None`` if this
+    jax build does not support a persistent cache.
+    """
+    import os
+    if path is None:
+        path = os.environ.get(
+            "PADDLE_TPU_COMPILE_CACHE_DIR",
+            os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                         "xla_cache"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
+    except Exception:
+        return None
+    return path

@@ -54,6 +54,7 @@ class Model(Layer):
         self._train_step = None
         self._eval_fn = None
         self._pred_fn = None
+        self._bucket_buckets = None  # fit(bucket=True) sets [batch_size]
         self.stop_training = False
 
     # -- wiring ------------------------------------------------------------
@@ -104,7 +105,9 @@ class Model(Layer):
                 self._optimizer.clear_grad()
                 return loss
             self._train_step = jit.to_static(
-                step, models=[self], optimizers=[self._optimizer])
+                step, models=[self], optimizers=[self._optimizer],
+                bucket=self._bucket_buckets is not None,
+                buckets=self._bucket_buckets)
         from ..tensor import to_tensor
         args = [to_tensor(a) for a in list(inputs) + list(labels)]
         loss = self._train_step(*args)
@@ -179,11 +182,23 @@ class Model(Layer):
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None):
-        """reference hapi/model.py:1128 fit."""
+            callbacks=None, prefetch=0, bucket=False):
+        """reference hapi/model.py:1128 fit.
+
+        TPU pipelining extensions: ``prefetch=N`` stages the next N
+        batches on device (background jax.device_put thread) while the
+        current step runs; ``bucket=True`` pads the ragged final batch of
+        each epoch up to ``batch_size`` so the compiled train step is
+        reused instead of recompiled (padded rows repeat the last real
+        sample and contribute to that batch's loss — prefer
+        ``drop_last=True`` when exact epoch-tail losses matter)."""
         assert self._optimizer is not None, "call prepare() first"
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
+        buckets = [batch_size] if bucket else None
+        if buckets != self._bucket_buckets:
+            self._bucket_buckets = buckets
+            self._train_step = None  # recompile with/without bucketing
         cbs = list(callbacks or [])
         if verbose:
             cbs.append(ProgBarLogger(log_freq, verbose))
@@ -199,7 +214,9 @@ class Model(Layer):
             cblist.call("on_epoch_begin", epoch)
             self.train()
             losses = []
-            for step, batch in enumerate(loader):
+            src = pio.prefetch_to_device(iter(loader), size=prefetch) \
+                if prefetch else loader
+            for step, batch in enumerate(src):
                 cblist.call("on_train_batch_begin", step)
                 ins, labs = self._split_batch(batch)
                 (loss,) = self.train_batch(ins, labs)

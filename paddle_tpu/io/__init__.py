@@ -28,6 +28,9 @@ import jax
 
 from ..tensor import Tensor, Parameter
 from ..nn.layer import Layer
+from . import bucketing  # noqa: F401  (shape bucketing / pad-and-mask)
+from .bucketing import next_bucket, pad_to_bucket, batch_mask  # noqa: F401
+from .prefetch import prefetch_to_device  # noqa: F401
 
 
 # ---------------------------------------------------------------------------
@@ -321,13 +324,20 @@ class DataLoader:
     num_workers=0: background-thread prefetch (the C++ fast path in csrc
     covers contiguous array datasets). num_workers>0: that many worker
     PROCESSES run dataset[i] + collate (order-preserving, windowed
-    dispatch of num_workers*prefetch_factor batches ahead)."""
+    dispatch of num_workers*prefetch_factor batches ahead).
+
+    prefetch_to_device=N additionally stages the next N assembled batches
+    on DEVICE via a background jax.device_put thread (sharded over
+    `device_mesh` when given) — see io.prefetch.prefetch_to_device."""
 
     def __init__(self, dataset, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, prefetch_factor=2,
                  batch_sampler=None, return_list=True, feed_list=None,
-                 places=None, use_native=True, seed=None):
+                 places=None, use_native=True, seed=None,
+                 prefetch_to_device=0, device_mesh=None):
         self.dataset = dataset
+        self._device_prefetch = int(prefetch_to_device or 0)
+        self._device_mesh = device_mesh
         # stream-style datasets (reference: dataloader_iter's
         # _DataLoaderIterForIterableDataset): no sampler/len — batches
         # are cut from the iterator in order
@@ -391,26 +401,50 @@ class DataLoader:
         if buf and not self._drop_last:
             yield self.collate_fn(buf)
 
-    def _produce(self, q):
+    @staticmethod
+    def _guarded_put(q, item, stop):
+        """Bounded put the consumer's shutdown can always interrupt — an
+        abandoned iterator must not leave the producer parked forever on
+        a full queue (a daemon-thread leak per discarded iterator)."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _produce(self, q, stop):
         try:
             for idx in self.batch_sampler:
                 if self._native is not None:
-                    q.put(self._native.gather(idx))
+                    item = self._native.gather(idx)
                 else:
-                    q.put(self.collate_fn([self.dataset[i] for i in idx]))
-            q.put(_SENTINEL)
+                    item = self.collate_fn([self.dataset[i] for i in idx])
+                if not self._guarded_put(q, item, stop):
+                    return
+            self._guarded_put(q, _SENTINEL, stop)
         except BaseException as e:  # surface worker errors to the consumer
-            q.put(_WorkerError(e))
+            self._guarded_put(q, _WorkerError(e), stop)
 
-    def _produce_stream(self, q):
+    def _produce_stream(self, q, stop):
         try:
             for batch in self._iter_stream():
-                q.put(batch)
-            q.put(_SENTINEL)
+                if not self._guarded_put(q, batch, stop):
+                    return
+            self._guarded_put(q, _SENTINEL, stop)
         except BaseException as e:  # surface generator errors
-            q.put(_WorkerError(e))
+            self._guarded_put(q, _WorkerError(e), stop)
 
     def __iter__(self):
+        it = self._iter_host()
+        if self._device_prefetch > 0:
+            from .prefetch import prefetch_to_device
+            it = prefetch_to_device(it, size=self._device_prefetch,
+                                    mesh=self._device_mesh)
+        return it
+
+    def _iter_host(self):
         if self._iterable:
             if self.prefetch <= 1:
                 yield from self._iter_stream()
@@ -433,15 +467,25 @@ class DataLoader:
                 return
             producer = self._produce
         q = _queue.Queue(maxsize=self.prefetch)
-        t = threading.Thread(target=producer, args=(q,), daemon=True)
+        stop = threading.Event()
+        t = threading.Thread(target=producer, args=(q, stop), daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is _SENTINEL:
-                break
-            if isinstance(item, _WorkerError):
-                raise item.exc
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _SENTINEL:
+                    break
+                if isinstance(item, _WorkerError):
+                    raise item.exc
+                yield item
+        finally:
+            stop.set()
+            try:  # drain so a producer parked on put() can see the stop
+                while True:
+                    q.get_nowait()
+            except _queue.Empty:
+                pass
+            t.join(timeout=5.0)
 
     def _iter_multiprocess(self):
         """Order-preserving multiprocess iteration (reference:

@@ -1,0 +1,106 @@
+"""paddle_tpu.io.bucketing — recompile-proof shape bucketing (pad-and-mask).
+
+Every new feed shape mints a new XLA executable: the classic utilization
+killer is the ragged final batch of an epoch (n % batch_size rows), which
+retraces and recompiles the whole step for one short batch. Bucketing
+rounds ragged dims up to a small, closed set of bucket sizes so an epoch
+compiles once per bucket instead of once per distinct shape.
+
+Semantics: padding REPEATS the last real row by default (keeps padded
+rows in-distribution so batch statistics — BN, softmax temperature —
+stay sane) or zero-fills (``mode="zeros"``). Per-example fetches are
+sliced back to the real length by the callers (Executor.run /
+jit.to_static); scalar reductions (a mean loss) include the padded rows
+— use :func:`batch_mask` inside a masked loss when exact loss values on
+ragged batches matter. The trade is explicit: bit-exact ragged-batch
+losses vs. one executable per bucket.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def next_bucket(n, buckets=None):
+    """Smallest bucket >= n. With ``buckets=None`` the bucket set is the
+    powers of two; with an explicit iterable, the smallest listed bucket
+    that fits (falling back to exact ``n`` past the largest — that mints
+    a shape, but silently truncating data would be worse)."""
+    n = int(n)
+    if n <= 0:
+        return n
+    if buckets:
+        for b in sorted(int(b) for b in buckets):
+            if b >= n:
+                return b
+        return n
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pad_to_bucket(array, target, axis=0, mode="repeat"):
+    """Pad ``array`` along ``axis`` up to ``target`` rows. Works on numpy
+    and jax arrays alike (stays in the input's array namespace, so a
+    device-resident batch pads on device). No-op at exact size."""
+    n = array.shape[axis]
+    if n == target:
+        return array
+    if n > target:
+        raise ValueError(
+            f"pad_to_bucket: size {n} exceeds bucket {target} on axis "
+            f"{axis}")
+    if isinstance(array, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp  # device array: pad on device
+    pad = target - n
+    if mode == "repeat":
+        idx = [slice(None)] * array.ndim
+        idx[axis] = slice(n - 1, n)
+        reps = [1] * array.ndim
+        reps[axis] = pad
+        fill = xp.tile(array[tuple(idx)], reps)
+    elif mode == "zeros":
+        shape = list(array.shape)
+        shape[axis] = pad
+        fill = xp.zeros(shape, dtype=array.dtype)
+    else:
+        raise ValueError(f"pad_to_bucket: unknown mode {mode!r} "
+                         "(use 'repeat' or 'zeros')")
+    return xp.concatenate([array, fill], axis=axis)
+
+
+def batch_mask(real_n, padded_n, dtype="float32"):
+    """A (padded_n,) 0/1 mask — 1 for real rows. Multiply into
+    per-example losses (and divide by ``mask.sum()``) to make bucketed
+    ragged batches bit-exact with the unpadded computation."""
+    m = np.zeros((int(padded_n),), dtype=dtype)
+    m[:int(real_n)] = 1
+    return m
+
+
+def pad_feed_dict(feed, buckets=None, axis=0, mode="repeat"):
+    """Bucket-pad every array in a name→array feed dict along ``axis``.
+
+    Returns ``(new_feed, real_n, padded_n)``. ``real_n``/``padded_n``
+    describe the (single) pad that was applied so the caller can slice
+    per-example fetches back; they are ``None`` when nothing was padded
+    or when feeds padded inconsistently (different batch dims — then no
+    fetch slicing is safe and outputs pass through at bucket size).
+    """
+    out = dict(feed)
+    pads = set()
+    for k, v in feed.items():
+        ndim = getattr(v, "ndim", 0)
+        if ndim < 1 or v.shape[axis] == 0:
+            continue
+        n = v.shape[axis]
+        t = next_bucket(n, buckets)
+        if t != n:
+            out[k] = pad_to_bucket(v, t, axis=axis, mode=mode)
+            pads.add((n, t))
+    if len(pads) == 1:
+        (real_n, padded_n), = pads
+        return out, real_n, padded_n
+    return out, None, None

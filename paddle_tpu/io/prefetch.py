@@ -1,0 +1,135 @@
+"""paddle_tpu.io.prefetch — async host→device transfer pipelining.
+
+``Executor.run`` / a compiled ``to_static`` step otherwise pays a
+BLOCKING host→device feed transfer at the top of every step: the device
+sits idle while the host copies, then the host sits idle while the
+device computes. :func:`prefetch_to_device` overlaps the two — a
+background thread ``jax.device_put``\\ s the next ``size`` batches while
+step *i* runs, so by the time the training loop asks for batch *i+1* it
+is already device-resident (sharded over the batch axis when a mesh is
+active — the multi-chip shape of the same overlap).
+
+Monitor series (when ``paddle_tpu.monitor`` is enabled):
+
+* ``prefetch.batches``       — batches handed to the consumer
+* ``prefetch.stall_seconds`` — total seconds the CONSUMER waited on the
+                               queue; ~0 means the input pipeline keeps
+                               up and the device is never starved
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+import numpy as np
+import jax
+
+from .. import monitor as _monitor
+
+_SENTINEL = object()
+
+
+class _PrefetchError:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _batch_sharding(mesh, axis_name, arr):
+    """Batch-shard over the mesh when the leading dim divides; replicate
+    otherwise (scalars, per-step metadata)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    ndev = mesh.devices.size
+    ndim = getattr(arr, "ndim", 0)
+    if ndim >= 1 and arr.shape[0] % ndev == 0:
+        return NamedSharding(mesh, P(*((axis_name,) + (None,) * (ndim - 1))))
+    return NamedSharding(mesh, P())
+
+
+def _place(batch, mesh, axis_name, sharding, device):
+    from ..tensor import Tensor
+
+    def leaf(a):
+        if isinstance(a, Tensor):
+            a = a.data
+        if not isinstance(a, (np.ndarray, jax.Array)):
+            a = np.asarray(a)
+        if sharding is not None:
+            return jax.device_put(a, sharding)
+        if mesh is not None:
+            return jax.device_put(a, _batch_sharding(mesh, axis_name, a))
+        return jax.device_put(a, device)
+
+    if isinstance(batch, dict):
+        return {k: leaf(v) for k, v in batch.items()}
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(leaf(v) for v in batch)
+    return leaf(batch)
+
+
+def _guarded_put(q, item, stop):
+    """Bounded put that a consumer shutdown can always interrupt — the
+    producer must never block forever on a queue nobody will drain."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.2)
+            return True
+        except _queue.Full:
+            continue
+    return False
+
+
+def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
+                       sharding=None, device=None):
+    """Wrap a batch iterator so the next ``size`` batches are moved to
+    device on a background thread while the current step computes.
+
+    Batches may be arrays, tuples/lists, or name→array dicts (the
+    Executor feed shape); every array leaf is ``jax.device_put``. With
+    ``mesh``, leaves batch-shard over ``axis_name`` (leading dim must
+    divide the mesh size; non-dividing leaves replicate). An explicit
+    ``sharding`` overrides the per-leaf inference; ``device`` pins a
+    single device when no mesh is given.
+
+    The wrapper is a generator: closing it (break / .close() / GC) stops
+    and joins the worker thread — no thread leaks across iterators.
+    """
+    it = iter(iterator)
+    q = _queue.Queue(maxsize=max(1, int(size)))
+    stop = threading.Event()
+
+    def produce():
+        try:
+            for batch in it:
+                placed = _place(batch, mesh, axis_name, sharding, device)
+                if not _guarded_put(q, placed, stop):
+                    return
+            _guarded_put(q, _SENTINEL, stop)
+        except BaseException as e:  # surface to the consumer
+            _guarded_put(q, _PrefetchError(e), stop)
+
+    t = threading.Thread(target=produce, name="paddle_tpu-prefetch",
+                         daemon=True)
+    t.start()
+    try:
+        while True:
+            t0 = time.perf_counter()
+            item = q.get()
+            if _monitor.enabled():
+                _monitor.counter("prefetch.stall_seconds").inc(
+                    time.perf_counter() - t0)
+            if item is _SENTINEL:
+                break
+            if isinstance(item, _PrefetchError):
+                raise item.exc
+            if _monitor.enabled():
+                _monitor.counter("prefetch.batches").inc()
+            yield item
+    finally:
+        stop.set()
+        try:  # unblock a producer parked on a full queue
+            while True:
+                q.get_nowait()
+        except _queue.Empty:
+            pass
+        t.join(timeout=5.0)

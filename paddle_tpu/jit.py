@@ -30,6 +30,7 @@ from .tensor import Tensor, Parameter
 from .nn.layer import Layer
 from .optimizer import Optimizer
 from . import random as prandom
+from . import monitor as _monitor
 
 
 def _discover_state_objects(fn, models, optimizers, scalers=None):
@@ -99,7 +100,8 @@ class StaticFunction:
     """The compiled callable returned by to_static."""
 
     def __init__(self, fn, models=None, optimizers=None, donate_state=True,
-                 jit_kwargs=None, scalers=None):
+                 jit_kwargs=None, scalers=None, bucket=False, buckets=None,
+                 pad_mode="repeat"):
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__"),
                                  updated=())
@@ -115,6 +117,12 @@ class StaticFunction:
         self._jit_kwargs = jit_kwargs or {}
         self._cache = {}
         self._state_cache = None  # (validity key, holders, names, params)
+        # shape bucketing: ragged leading (batch) dims round up to a
+        # bucket so a short final batch reuses the full-batch executable
+        self._bucket = bucket
+        self._buckets = buckets
+        self._pad_mode = pad_mode
+        self._seen_base = set()  # recompile (vs first-compile) accounting
 
     def _resolve_objects(self):
         if self._models is None or self._optimizers is None:
@@ -185,13 +193,36 @@ class StaticFunction:
             else:
                 statics.append((i, a))
 
-        train_flags = tuple(m.training for m in models)
-        key = (treedef, tuple(arr_idx),
-               tuple((a.shape, str(a.dtype)) for a in arrays),
-               tuple((i, repr(s)) for i, s in statics), train_flags,
-               tuple(state_names), ast_on)
+        pad_info = None
+        if self._bucket and arrays and arrays[0].ndim >= 1:
+            # bucket the common leading (batch) dim: every array sharing
+            # it pads up to the bucket; outputs slice back after the call
+            from .io.bucketing import next_bucket, pad_to_bucket
+            lead = arrays[0].shape[0]
+            target = next_bucket(lead, self._buckets)
+            if target != lead:
+                arrays = [pad_to_bucket(a, target, mode=self._pad_mode)
+                          if a.ndim >= 1 and a.shape[0] == lead else a
+                          for a in arrays]
+                pad_info = (lead, target)
+                if _monitor.enabled():
+                    _monitor.counter("jit.bucket_pad").inc()
 
+        train_flags = tuple(m.training for m in models)
+        base = (treedef, tuple(arr_idx),
+                tuple((i, repr(s)) for i, s in statics), train_flags,
+                tuple(state_names), ast_on)
+        key = base + (tuple((a.shape, str(a.dtype)) for a in arrays),)
+
+        if _monitor.enabled():
+            if key in self._cache:
+                _monitor.counter("jit.cache_hit").inc()
+            else:
+                _monitor.counter("jit.compile").inc()
+                if base in self._seen_base:
+                    _monitor.counter("jit.recompile").inc()
         if key not in self._cache:
+            self._seen_base.add(base)
             self._cache[key] = self._make_entry(treedef, arr_idx, statics,
                                                 state_names)
         entry = self._cache[key]
@@ -203,6 +234,12 @@ class StaticFunction:
             holders[name].data = new
         for p in all_params:
             p._grad = None
+
+        if pad_info is not None:
+            lead, target = pad_info
+            out_arrays = [o[:lead] if getattr(o, "ndim", 0) >= 1 and
+                          o.shape[0] == target else o
+                          for o in out_arrays]
 
         # rebuild outputs: arrays -> Tensors at recorded positions
         meta = entry["meta"]
@@ -268,17 +305,28 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, models=None, optimizers=None,
-              donate_state=True, scalers=None, **kwargs):
+              donate_state=True, scalers=None, bucket=False, buckets=None,
+              pad_mode="repeat", **kwargs):
     """Decorator/wrapper: compile a dygraph step into one XLA computation.
 
     reference: paddle.jit.to_static (dygraph_to_static/program_translator.py)
     — functional-state tracing, preceded by the AST pass
     (dygraph_to_static.convert_function) that rewrites tensor-dependent
     python `if`/`while` into lax control flow.
+
+    ``bucket=True`` (+ ``buckets=[...]``) pads the arrays' common leading
+    dim up to a bucket size before shape-keying, so ragged final batches
+    reuse the full-batch executable instead of recompiling; array outputs
+    at the bucket size are sliced back to the real length. Padded rows
+    repeat the last real row (``pad_mode="zeros"`` zero-fills) and DO
+    participate in scalar reductions — use io.bucketing.batch_mask in the
+    loss when exact ragged-batch values matter.
     """
     def wrap(fn):
         return StaticFunction(fn, models=models, optimizers=optimizers,
-                              donate_state=donate_state, scalers=scalers)
+                              donate_state=donate_state, scalers=scalers,
+                              bucket=bucket, buckets=buckets,
+                              pad_mode=pad_mode)
     if function is not None:
         return wrap(function)
     return wrap

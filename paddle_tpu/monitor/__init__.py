@@ -13,6 +13,20 @@ record):
 * ``optimizer.step``      — step entries per optimizer class
 * ``StepMonitor``         — step time, items/sec, device memory, MFU
 
+Step-pipelining series (docs/performance.md "Step pipelining"):
+
+* ``executor.recompile`` / ``jit.recompile`` — cache misses for a
+  program/function whose earlier shapes already compiled (avoidable,
+  shape-driven recompiles — the number bucketing drives to zero)
+* ``executor.bucket_pad`` / ``jit.bucket_pad`` — ragged batches padded
+  up to a bucket instead of minting a new executable
+* ``executor.fetch_async`` / ``executor.fetch_skipped`` /
+  ``executor.fetch_blocking`` — async-fetch mode accounting (blocking
+  must stay 0 when ``async_fetch=True``)
+* ``executor.aot_warmup``  — executables compiled ahead of time
+* ``prefetch.batches`` / ``prefetch.stall_seconds`` — device-prefetch
+  throughput and consumer starvation time
+
 Everything funnels into one process-global :class:`Registry` and,
 when a sink is configured (``PADDLE_TPU_MONITOR_DIR`` or an explicit
 path to ``enable()``), a JSONL event stream.

@@ -358,17 +358,64 @@ def global_scope():
 class Executor:
     """reference: executor.py:Executor — but run() compiles the WHOLE
     program (+ grads + optimizer update) into one XLA executable, cached per
-    feed signature."""
+    feed signature.
+
+    Pipelining surface (the MXU-feeding knobs):
+
+    * ``bucket=True`` (+ ``buckets=[...]``) — ragged feed batches pad up
+      to a closed bucket set instead of minting a new executable per
+      shape (per-example fetches are sliced back to the real length).
+    * ``async_fetch=True`` / ``fetch_period=k`` — run() returns the
+      PREVIOUS step's fetches (already computed, so ``device_get`` never
+      blocks on the step critical path); ``flush_fetches()`` drains the
+      last pending ones after the loop.
+    * ``warmup()`` — AOT ``lower().compile()`` of a (program, feed-spec)
+      executable before the first step.
+    """
 
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
+        self._seen_base = set()   # (program, fetches, mesh) combos compiled
+        self._pending_fetches = None
+        self._async_runs = 0
+
+    @staticmethod
+    def _mesh_sig(dp_mesh, dp_requested):
+        """Mesh identity for the executable cache key. A plain run and a
+        with_data_parallel run with identical feed shapes produce
+        DIFFERENT executables (sharded feeds + GSPMD partitioning) and
+        must never collide; absence of a mesh is part of the identity."""
+        if dp_mesh is not None:
+            return (tuple(int(d.id) for d in dp_mesh.devices.flat),
+                    tuple(dp_mesh.axis_names))
+        if dp_requested:
+            return "dp"  # with_data_parallel on a single device
+        return None
+
+    @staticmethod
+    def _param_slot_names(program):
+        param_names = sorted(program.param_vars)
+        opt_entries = program.optimizers
+        slot_names = []
+        for oi, (opt, _) in enumerate(opt_entries):
+            trainables = [p for p in program.param_vars.values()
+                          if not p.stop_gradient]
+            opt._parameter_list = opt._parameter_list or trainables
+            opt._ensure_all_slots()
+            for pid, slots in opt._accumulators.items():
+                for sname in slots:
+                    slot_names.append((oi, pid, sname))
+        return param_names, opt_entries, slot_names
 
     def run(self, program=None, feed=None, fetch_list=None,
-            return_numpy=True, scope=None):
+            return_numpy=True, scope=None, bucket=False, buckets=None,
+            pad_mode="repeat", async_fetch=False, fetch_period=None):
         program = program or default_main_program()
         dp_mesh = None
+        dp_requested = False
         if isinstance(program, CompiledProgram):
+            dp_requested = program._data_parallel
             if program._data_parallel:
                 dp_mesh = program._dp_mesh
             program = program.program
@@ -380,12 +427,32 @@ class Executor:
         fetch_names = [v.name if isinstance(v, StaticVar) else str(v)
                        for v in fetch_list]
 
-        # normalize feeds
+        # normalize feeds on the HOST: shapes/dtypes for the cache key
+        # come straight from the numpy/jax arrays — no jnp.asarray (and
+        # its device transfer) before we know whether this is a cache
+        # hit. jit/device_put convert on the way in exactly once.
         feed_arrays = {}
         for k, v in feed.items():
             if isinstance(v, Tensor):
                 v = v.data
-            feed_arrays[k] = jnp.asarray(v)
+            if not isinstance(v, (np.ndarray, jax.Array)):
+                v = np.asarray(v)
+            if isinstance(v, np.ndarray) and v.dtype in (
+                    np.float64, np.int64, np.uint64):
+                # mirror jnp.asarray's x64-off canonicalization so the
+                # cache key matches what the executable will receive
+                v = v.astype({np.dtype(np.float64): np.float32,
+                              np.dtype(np.int64): np.int32,
+                              np.dtype(np.uint64): np.uint32}[v.dtype])
+            feed_arrays[k] = v
+
+        real_n = padded_n = None
+        if bucket:
+            from ..io.bucketing import pad_feed_dict
+            feed_arrays, real_n, padded_n = pad_feed_dict(
+                feed_arrays, buckets=buckets, mode=pad_mode)
+            if padded_n is not None and _monitor.enabled():
+                _monitor.counter("executor.bucket_pad").inc()
 
         if dp_mesh is not None:
             # CompiledProgram.with_data_parallel: batch-shard every feed
@@ -411,26 +478,23 @@ class Executor:
                 if cur != rep:
                     holder.data = jax.device_put(holder.data, rep)
 
-        param_names = sorted(program.param_vars)
-        opt_entries = program.optimizers
-        slot_names = []
-        for oi, (opt, _) in enumerate(opt_entries):
-            trainables = [p for p in program.param_vars.values()
-                          if not p.stop_gradient]
-            opt._parameter_list = opt._parameter_list or trainables
-            opt._ensure_all_slots()
-            for pid, slots in opt._accumulators.items():
-                for sname in slots:
-                    slot_names.append((oi, pid, sname))
+        param_names, opt_entries, slot_names = \
+            self._param_slot_names(program)
 
-        key = (program.id, program.version, tuple(fetch_names),
-               tuple(sorted((k, a.shape, str(a.dtype))
-                            for k, a in feed_arrays.items())))
+        base_key = (program.id, program.version, tuple(fetch_names),
+                    self._mesh_sig(dp_mesh, dp_requested))
+        key = base_key + (tuple(sorted((k, tuple(a.shape), str(a.dtype))
+                                       for k, a in feed_arrays.items())),)
         if _monitor.enabled():
             _monitor.counter("executor.run").inc()
             _monitor.counter("executor.cache_hit" if key in self._cache
                              else "executor.cache_miss").inc()
+            if key not in self._cache and base_key in self._seen_base:
+                # same program+fetches+mesh, new feed shapes: the
+                # avoidable-recompile series bucketing exists to flatten
+                _monitor.counter("executor.recompile").inc()
         if key not in self._cache:
+            self._seen_base.add(base_key)
             self._cache[key] = self._compile(program, fetch_names,
                                              sorted(feed_arrays),
                                              param_names, slot_names)
@@ -455,26 +519,81 @@ class Executor:
         for (oi, pid, sn), v in zip(slot_names, new_slots):
             opt_entries[oi][0]._accumulators[pid][sn].data = v
 
+        if async_fetch or fetch_period:
+            # non-blocking fetch path: hand back the PREVIOUS step's
+            # fetches (their device computation finished while this step
+            # was being dispatched) so the host never sits in device_get
+            # on the step critical path. fetch_period=k additionally
+            # materializes only every k-th call.
+            period = max(1, int(fetch_period or 1))
+            prev = self._pending_fetches
+            self._pending_fetches = (fetches, real_n, padded_n,
+                                     return_numpy)
+            self._async_runs += 1
+            if _monitor.enabled():
+                _monitor.counter("executor.fetch_async").inc()
+            if self._async_runs % period != 0 or prev is None:
+                if _monitor.enabled():
+                    _monitor.counter("executor.fetch_skipped").inc()
+                return None
+            return self._materialize(prev)
+
+        if _monitor.enabled() and return_numpy and fetches:
+            # the blocking device_get this sits in is exactly what
+            # async_fetch removes from the per-step path
+            _monitor.counter("executor.fetch_blocking").inc()
+        return self._materialize((fetches, real_n, padded_n,
+                                  return_numpy))
+
+    @staticmethod
+    def _materialize(pending):
+        fetches, real_n, padded_n, return_numpy = pending
+        if real_n is not None:
+            # bucketing padded the feeds: slice per-example fetches back
+            # to the real batch length (scalar reductions pass through)
+            fetches = [f[:real_n]
+                       if getattr(f, "ndim", 0) >= 1 and
+                       f.shape[0] == padded_n else f
+                       for f in fetches]
         if return_numpy:
             return [np.asarray(jax.device_get(f)) for f in fetches]
         return [Tensor(f) for f in fetches]
 
+    def flush_fetches(self):
+        """Drain the pending async fetches (call once after the training
+        loop; returns None when nothing is pending)."""
+        prev, self._pending_fetches = self._pending_fetches, None
+        self._async_runs = 0
+        if prev is None:
+            return None
+        return self._materialize(prev)
+
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           prefetch=0, bucket=False, buckets=None):
         """reference executor.py:train_from_dataset — run the program
         over every batch a fluid.dataset yields. The reference spawns
         C++ DataFeed threads; here each host-assembled MultiSlot batch
         goes through the same compiled run() path as any feed (one
-        executable, cached per feed signature)."""
+        executable, cached per feed signature).
+
+        ``prefetch=N`` stages the next N feed dicts on device via a
+        background thread while the current step runs; ``bucket=True``
+        pads ragged final batches up to the bucket set so the epoch
+        doesn't recompile on its tail."""
         if dataset is None:
             raise RuntimeError("dataset is required for train_from_dataset")
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [getattr(v, "name", str(v))
                                     for v in fetch_list]
-        for i, batch in enumerate(dataset._batches()):
+        batches = dataset._batches()
+        if prefetch:
+            from ..io.prefetch import prefetch_to_device
+            batches = prefetch_to_device(batches, size=prefetch)
+        for i, batch in enumerate(batches):
             outs = self.run(program, feed=batch, fetch_list=fetch_list,
-                            scope=scope)
+                            scope=scope, bucket=bucket, buckets=buckets)
             if debug and fetch_list and i % max(print_period, 1) == 0:
                 msg = ", ".join(f"{n}={np.asarray(o).ravel()[:1]}"
                                 for n, o in zip(fetch_info, outs))
@@ -482,12 +601,105 @@ class Executor:
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
-                           fetch_info=None, print_period=100):
+                           fetch_info=None, print_period=100,
+                           prefetch=0, bucket=False, buckets=None):
         """reference executor.py:infer_from_dataset — same loop; the
         program carries no optimizer ops so run() only evaluates."""
         return self.train_from_dataset(program, dataset, scope, thread,
                                        debug, fetch_list, fetch_info,
-                                       print_period)
+                                       print_period, prefetch=prefetch,
+                                       bucket=bucket, buckets=buckets)
+
+    def warmup(self, program=None, feed_specs=None, fetch_list=None,
+               bucket=False, buckets=None):
+        """AOT-compile the (program, feed-spec) executable before the
+        first step: ``jit(...).lower(...).compile()`` over abstract
+        ShapeDtypeStructs, cached under the same key ``run`` computes —
+        the first real step starts on a warm executable (and, with the
+        persistent compilation cache enabled, a rerun of the same
+        process skips XLA entirely).
+
+        ``feed_specs`` maps feed name → (shape, dtype) | InputSpec | a
+        template array. Returns the cache key."""
+        program = program or default_main_program()
+        dp_mesh = None
+        dp_requested = False
+        if isinstance(program, CompiledProgram):
+            dp_requested = program._data_parallel
+            if program._data_parallel:
+                dp_mesh = program._dp_mesh
+            program = program.program
+        if not program.global_block().ops:
+            return None
+        fetch_list = fetch_list or []
+        fetch_names = [v.name if isinstance(v, StaticVar) else str(v)
+                       for v in fetch_list]
+
+        specs = {}
+        for k, v in (feed_specs or {}).items():
+            if isinstance(v, InputSpec):
+                shape, dtype = v.shape, v.dtype
+            elif hasattr(v, "shape") and hasattr(v, "dtype"):
+                shape, dtype = v.shape, v.dtype
+            else:
+                shape, dtype = v
+            shape = tuple(int(s) for s in shape)
+            if bucket and shape:
+                from ..io.bucketing import next_bucket
+                shape = (next_bucket(shape[0], buckets),) + shape[1:]
+            specs[k] = (shape, jnp.dtype(convert_dtype(dtype) or dtype))
+
+        param_names, opt_entries, slot_names = \
+            self._param_slot_names(program)
+        base_key = (program.id, program.version, tuple(fetch_names),
+                    self._mesh_sig(dp_mesh, dp_requested))
+        key = base_key + (tuple(sorted((k, s, str(d))
+                                       for k, (s, d) in specs.items())),)
+        if key in self._cache:
+            return key
+
+        if dp_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            ndev = dp_mesh.devices.size
+
+            def sds(shape, dtype):
+                if len(shape) >= 1 and shape[0] % ndev == 0:
+                    spec = P(*(("dp",) + (None,) * (len(shape) - 1)))
+                else:
+                    spec = P()
+                return jax.ShapeDtypeStruct(
+                    shape, dtype, sharding=NamedSharding(dp_mesh, spec))
+
+            rep = NamedSharding(dp_mesh, P())
+
+            def psds(a):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=rep)
+        else:
+            def sds(shape, dtype):
+                return jax.ShapeDtypeStruct(shape, dtype)
+
+            def psds(a):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        feed_order = sorted(specs)
+        feed_structs = [sds(*specs[k]) for k in feed_order]
+        param_structs = [psds(program.param_vars[n].data)
+                         for n in param_names]
+        slot_structs = [psds(opt_entries[oi][0]._accumulators[pid][sn].data)
+                        for oi, pid, sn in slot_names]
+        lr_structs = [psds(opt._lr_tensor.data) for opt, _ in opt_entries]
+        rng_structs = [jax.ShapeDtypeStruct((2,), jnp.uint32)
+                       for _ in program.rng_vars]
+
+        jitted = self._compile(program, fetch_names, feed_order,
+                               param_names, slot_names)
+        compiled = jitted.lower(feed_structs, param_structs, slot_structs,
+                                lr_structs, rng_structs).compile()
+        self._seen_base.add(base_key)
+        self._cache[key] = compiled
+        if _monitor.enabled():
+            _monitor.counter("executor.aot_warmup").inc()
+        return key
 
     def _compile(self, program, fetch_names, feed_order, param_names,
                  slot_names):
@@ -582,6 +794,9 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._seen_base.clear()
+        self._pending_fetches = None
+        self._async_runs = 0
 
 
 # ---------------------------------------------------------------------------

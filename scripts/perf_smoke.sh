@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# CI gate for the step-pipelining acceptance criteria: a ragged-tail
+# epoch must run at >= 10 steps per XLA compile (shape bucketing) with
+# zero blocking device_gets (async fetch). Tier-1-safe: tiny MLP, 30
+# steps, CPU backend, a few seconds end to end.
+#
+# Usage: scripts/perf_smoke.sh [out_dir]
+# The monitor JSONL stream lands in out_dir (default
+# /tmp/paddle_tpu_perf_smoke) as the CI artifact; the last stdout line
+# is one JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_perf_smoke}"
+JAX_PLATFORMS=cpu python scripts/perf_smoke.py --out-dir "$OUT_DIR"

@@ -145,7 +145,7 @@ def test_main_fast_and_full_stage_selection(bench, monkeypatch):
     pipeline + seq-512 + seq-2048 and banks their metrics."""
     import sys as _sys
     monkeypatch.setattr(bench, "_arm_watchdog", lambda *a, **k: None)
-    monkeypatch.setattr(bench, "_enable_persistent_compile_cache",
+    monkeypatch.setattr(bench, "_enable_monitoring_and_cache",
                         lambda: None)
     monkeypatch.setattr(bench, "_init_backend_with_retry",
                         lambda *a, **k: True)
