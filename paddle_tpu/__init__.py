@@ -57,6 +57,7 @@ from . import slim
 from . import fleet
 from . import dataset
 from . import monitor
+from . import resilience
 
 # PADDLE_TPU_MONITOR=1 turns the metrics runtime on for the whole
 # process (sink location via PADDLE_TPU_MONITOR_DIR); default stays

@@ -47,6 +47,22 @@ def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
 amp_guard = auto_cast
 
 
+def tree_all_finite(arrays):
+    """ONE fused all-finite reduction over a list of arrays/Tensors
+    (None entries skipped) — a device bool scalar, no host sync, safe
+    under jit. The finite-check machinery shared by
+    :meth:`GradScaler.unscale_` and the resilience NaN guard
+    (paddle_tpu.resilience.guard)."""
+    finite = jnp.asarray(True)
+    for a in arrays:
+        if a is None:
+            continue
+        if isinstance(a, Tensor):
+            a = a.data
+        finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(a)))
+    return finite
+
+
 def maybe_cast(*arrays):
     """Cast inputs to the AMP compute dtype when autocast is active —
     called by white-listed ops (matmul/conv/linear)."""
@@ -100,10 +116,8 @@ class GradScaler:
         if not self._enable:
             return
         inv = 1.0 / self._scale.data
-        grads = [p._grad for p in optimizer._params() if p._grad is not None]
-        finite = jnp.asarray(True)
-        for g in grads:
-            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(g)))
+        finite = tree_all_finite(
+            [p._grad for p in optimizer._params() if p._grad is not None])
         for p in optimizer._params():
             if p._grad is not None:
                 p._grad = p._grad * inv

@@ -55,6 +55,7 @@ class Model(Layer):
         self._eval_fn = None
         self._pred_fn = None
         self._bucket_buckets = None  # fit(bucket=True) sets [batch_size]
+        self._guard_traced = False   # nan_guard baked into _train_step?
         self.stop_training = False
 
     # -- wiring ------------------------------------------------------------
@@ -182,7 +183,9 @@ class Model(Layer):
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, prefetch=0, bucket=False):
+            callbacks=None, prefetch=0, bucket=False, checkpoint=None,
+            save_steps=None, auto_resume=False, nan_guard=None,
+            watchdog=None):
         """reference hapi/model.py:1128 fit.
 
         TPU pipelining extensions: ``prefetch=N`` stages the next N
@@ -191,8 +194,44 @@ class Model(Layer):
         each epoch up to ``batch_size`` so the compiled train step is
         reused instead of recompiled (padded rows repeat the last real
         sample and contribute to that batch's loss — prefer
-        ``drop_last=True`` when exact epoch-tail losses matter)."""
+        ``drop_last=True`` when exact epoch-tail losses matter).
+
+        Resilience extensions (paddle_tpu.resilience): ``checkpoint``
+        (an io.CheckpointManager or directory path) enables atomic
+        model+optimizer checkpoints every ``save_steps`` global steps
+        and on SIGTERM/SIGINT (cooperative preemption: the signal sets a
+        flag, the loop saves at the next step boundary and stops);
+        ``auto_resume=True`` restores the newest *valid* checkpoint and
+        fast-forwards past already-trained batches; ``nan_guard`` (a
+        resilience.NaNGuard or one of its policy strings) drops
+        non-finite update steps inside the compiled train step and
+        applies skip/rollback/raise on the host; ``watchdog`` (True or a
+        resilience.Watchdog) flags steps that exceed a rolling
+        p99-based deadline and dumps monitor state."""
         assert self._optimizer is not None, "call prepare() first"
+        from ..resilience import faults as _faults
+        from ..resilience._common import record as _rrecord
+
+        cm = None
+        if checkpoint is not None:
+            from ..io import CheckpointManager
+            cm = (checkpoint if isinstance(checkpoint, CheckpointManager)
+                  else CheckpointManager(checkpoint))
+        if isinstance(nan_guard, str):
+            from ..resilience.guard import NaNGuard
+            nan_guard = NaNGuard(nan_guard, checkpoint_manager=cm)
+        if nan_guard is not None and nan_guard.checkpoint_manager is None:
+            nan_guard.checkpoint_manager = cm
+        # the guard's where-selects are baked into the traced step, so
+        # flipping guard presence must invalidate the compiled step
+        if (nan_guard is not None) != self._guard_traced:
+            self._guard_traced = nan_guard is not None
+            self._train_step = None
+        wd = None
+        if watchdog is not None and watchdog is not False:
+            from ..resilience.watchdog import Watchdog
+            wd = watchdog if isinstance(watchdog, Watchdog) else Watchdog()
+
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)
         buckets = [batch_size] if bucket else None
@@ -208,36 +247,114 @@ class Model(Layer):
             "epochs": epochs, "verbose": verbose, "metrics":
             ["loss"] + [m.name() for m in self._metrics]})
         self.stop_training = False
+
+        start_step = 0
+        if auto_resume and cm is not None:
+            latest = cm.latest_step()
+            if latest is not None:
+                state = cm.restore(model=self, optimizer=self._optimizer)
+                start_step = int(state.get("step", latest)) + 1
+                self._train_step = None  # recompile against restored state
+                _rrecord("auto_resume", step=start_step,
+                         checkpoint_step=latest, where="fit")
+        handler = None
+        if cm is not None:
+            from ..resilience.preempt import PreemptionHandler
+            handler = PreemptionHandler().install()
+        if nan_guard is not None:
+            nan_guard.install()
+        if wd is not None:
+            wd.start()
+
         cblist.call("on_train_begin")
         history = {"loss": []}
-        for epoch in range(epochs):
-            cblist.call("on_epoch_begin", epoch)
-            self.train()
-            losses = []
-            src = pio.prefetch_to_device(iter(loader), size=prefetch) \
-                if prefetch else loader
-            for step, batch in enumerate(src):
-                cblist.call("on_train_batch_begin", step)
-                ins, labs = self._split_batch(batch)
-                (loss,) = self.train_batch(ins, labs)
-                losses.append(loss)
-                cblist.call("on_train_batch_end", step, {
-                    "loss": loss,
-                    "batch_size": ins[0].shape[0] if hasattr(
-                        ins[0], "shape") else 1})
-            logs = {"loss": float(np.mean(losses)) if losses else 0.0}
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eres = self.evaluate(eval_data, batch_size=batch_size,
-                                     verbose=0)
-                # eval metrics get an eval_ prefix so the train loss is
-                # not silently overwritten in logs/history
-                logs.update({f"eval_{k}": v for k, v in eres.items()})
-            history["loss"].append(logs["loss"])
-            cblist.call("on_epoch_end", epoch, logs)
-            if self.stop_training:
-                break
+        global_step = 0
+        try:
+            for epoch in range(epochs):
+                cblist.call("on_epoch_begin", epoch)
+                self.train()
+                losses = []
+                src = pio.prefetch_to_device(iter(loader), size=prefetch) \
+                    if prefetch else loader
+                for step, batch in enumerate(src):
+                    if global_step < start_step:
+                        global_step += 1  # auto_resume fast-forward
+                        continue
+                    cblist.call("on_train_batch_begin", step)
+                    ins, labs = self._split_batch(batch)
+                    if _faults.enabled() and _faults.fire("nan_grad",
+                                                          global_step):
+                        ins = [self._poison(ins[0])] + list(ins[1:])
+                    wd_ctx = wd.step(global_step) if wd is not None else None
+                    try:
+                        if wd_ctx is not None:
+                            wd_ctx.__enter__()
+                        if _faults.enabled():
+                            _faults.maybe_sleep("slow_step", global_step)
+                        (loss,) = self.train_batch(ins, labs)
+                    finally:
+                        if wd_ctx is not None:
+                            wd_ctx.__exit__(None, None, None)
+                    ok = True
+                    if nan_guard is not None:
+                        ok = nan_guard.check_host(
+                            loss, step=global_step, model=self,
+                            optimizer=self._optimizer, where="fit")
+                        if not ok and \
+                                nan_guard.policy == "rollback_to_last_ckpt":
+                            # restored state: retrace on the next batch
+                            self._train_step = None
+                    if ok:
+                        losses.append(loss)
+                    cblist.call("on_train_batch_end", step, {
+                        "loss": loss,
+                        "batch_size": ins[0].shape[0] if hasattr(
+                            ins[0], "shape") else 1})
+                    preempted = (handler is not None and handler.triggered) \
+                        or (_faults.enabled() and
+                            _faults.fire("preempt", global_step))
+                    if cm is not None and (preempted or (
+                            save_steps and
+                            (global_step + 1) % save_steps == 0)):
+                        cm.save(global_step, model=self,
+                                optimizer=self._optimizer)
+                        if preempted:
+                            _rrecord("preempt_save", step=global_step,
+                                     where="fit")
+                    global_step += 1
+                    if preempted:
+                        self.stop_training = True
+                        break
+                logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+                if eval_data is not None and (epoch + 1) % eval_freq == 0 \
+                        and not self.stop_training:
+                    eres = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=0)
+                    # eval metrics get an eval_ prefix so the train loss is
+                    # not silently overwritten in logs/history
+                    logs.update({f"eval_{k}": v for k, v in eres.items()})
+                history["loss"].append(logs["loss"])
+                cblist.call("on_epoch_end", epoch, logs)
+                if self.stop_training:
+                    break
+        finally:
+            if wd is not None:
+                wd.stop()
+            if nan_guard is not None:
+                nan_guard.uninstall()
+            if handler is not None:
+                handler.uninstall()
         cblist.call("on_train_end", {"loss": history["loss"]})
         return history
+
+    @staticmethod
+    def _poison(a):
+        """nan_grad fault: replace a batch input with NaNs (same
+        shape/dtype so the compiled step is reused, not recompiled)."""
+        arr = np.asarray(a.numpy() if hasattr(a, "numpy") else a)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return arr
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):

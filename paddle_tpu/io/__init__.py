@@ -158,23 +158,82 @@ def orbax_restore(path, step=None, template=None):
     return walk(template, tree)
 
 
+def _sha256_file(path, chunk=1 << 20):
+    import hashlib
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
 class CheckpointManager:
-    """Train-loop checkpoint/resume helper (keeps last-k, tracks step)."""
+    """Train-loop checkpoint/resume helper (keeps last-k, tracks step).
+
+    Preemption-safe: saves write to ``ckpt-{step}.pkl.tmp`` + fsync and
+    ``os.replace`` into place (a SIGKILL mid-save leaves a stray .tmp,
+    never a truncated checkpoint), with a sha256 sidecar
+    (``ckpt-{step}.pkl.sha256``) written after the data lands.
+    ``latest_step()``/``restore()`` only ever pick *valid* checkpoints
+    — unreadable or checksum-mismatched files are warned about, skipped
+    and (on restore) quarantined to ``*.corrupt`` with a
+    ``resilience.ckpt_quarantine`` event, falling back to the newest
+    checkpoint that does load. Checkpoint I/O retries transient OS
+    errors under resilience.retry.
+    """
 
     def __init__(self, directory, max_to_keep=3):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
+        self._valid_cache = {}  # step -> (size, mtime, ok)
 
-    def save(self, step, model=None, optimizer=None, extra=None):
+    def _path(self, step):
+        return os.path.join(self.directory, f"ckpt-{step}.pkl")
+
+    def save(self, step, model=None, optimizer=None, extra=None,
+             program=None):
+        """Atomic save. ``program=`` captures a static Program's
+        parameter values (plus its recorded optimizers' state) so
+        Executor loops checkpoint through the same manager."""
+        from ..resilience import retry as _retry
         state = {"step": step}
         if model is not None:
             state["model"] = _to_numpy_tree(model.state_dict())
         if optimizer is not None:
             state["optimizer"] = _to_numpy_tree(optimizer.state_dict())
+        if program is not None:
+            state["program"] = {
+                n: np.asarray(jax.device_get(p.data))
+                for n, p in program.param_vars.items()}
+            # recorded optimizers have slots only after the first run
+            state["program_optimizers"] = [
+                _to_numpy_tree(opt.state_dict())
+                if opt._parameter_list is not None else {}
+                for opt, _ in getattr(program, "optimizers", [])]
         if extra:
             state["extra"] = extra
-        save(state, os.path.join(self.directory, f"ckpt-{step}.pkl"))
+        path = self._path(step)
+        tmp = path + ".tmp"
+
+        def _write():
+            with open(tmp, "wb") as f:
+                pickle.dump(_to_numpy_tree(state), f, protocol=4)
+                f.flush()
+                os.fsync(f.fileno())
+
+        _retry.retry_call(_write, label="ckpt_save")
+        digest = _sha256_file(tmp)
+        os.replace(tmp, path)
+        # sidecar lands AFTER the data: a crash in between leaves a
+        # checkpoint without a sidecar, which validation falls back to
+        # verifying by unpickling
+        with open(path + ".sha256", "w", encoding="utf-8") as f:
+            f.write(digest + "\n")
+        self._valid_cache.pop(step, None)
         self._gc()
 
     def _steps(self):
@@ -190,21 +249,105 @@ class CheckpointManager:
     def _gc(self):
         steps = self._steps()
         for s in steps[:-self.max_to_keep]:
-            os.remove(os.path.join(self.directory, f"ckpt-{s}.pkl"))
+            for suffix in ("", ".sha256", ".tmp"):
+                try:
+                    os.remove(self._path(s) + suffix)
+                except FileNotFoundError:
+                    pass
+            self._valid_cache.pop(s, None)
+
+    def _is_valid(self, step):
+        """Readable + checksum-clean (sidecar when present, else a full
+        unpickle probe). Cached per (size, mtime)."""
+        path = self._path(step)
+        try:
+            st = os.stat(path)
+        except OSError:
+            return False
+        cached = self._valid_cache.get(step)
+        if cached is not None and cached[:2] == (st.st_size, st.st_mtime_ns):
+            return cached[2]
+        ok = False
+        try:
+            sidecar = path + ".sha256"
+            if os.path.exists(sidecar):
+                with open(sidecar, encoding="utf-8") as f:
+                    want = f.read().strip()
+                ok = bool(want) and _sha256_file(path) == want
+            else:
+                with open(path, "rb") as f:
+                    pickle.load(f)
+                ok = True
+        except Exception:
+            ok = False
+        self._valid_cache[step] = (st.st_size, st.st_mtime_ns, ok)
+        return ok
+
+    def valid_steps(self):
+        return [s for s in self._steps() if self._is_valid(s)]
+
+    def _quarantine(self, step, why):
+        from ..resilience import record as _record
+        path = self._path(step)
+        warnings.warn(
+            f"CheckpointManager: quarantining corrupt checkpoint "
+            f"{path} ({why})")
+        for suffix in ("", ".sha256"):
+            try:
+                os.replace(path + suffix, path + suffix + ".corrupt")
+            except OSError:
+                pass
+        self._valid_cache.pop(step, None)
+        _record("ckpt_quarantine", step=step, path=path, why=why)
 
     def latest_step(self):
-        steps = self._steps()
-        return steps[-1] if steps else None
+        """Newest *valid* checkpoint step (corrupt/truncated files are
+        skipped with a warning — they never win)."""
+        for s in reversed(self._steps()):
+            if self._is_valid(s):
+                return s
+            warnings.warn(
+                f"CheckpointManager: skipping unreadable/corrupt "
+                f"checkpoint {self._path(s)}")
+        return None
 
-    def restore(self, model=None, optimizer=None, step=None):
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return None
-        state = load(os.path.join(self.directory, f"ckpt-{step}.pkl"))
+    def restore(self, model=None, optimizer=None, step=None, program=None):
+        """Restore the requested (default: newest valid) checkpoint.
+        Corrupt candidates found on the way are quarantined and the
+        next-newest valid one is used; an explicitly requested corrupt
+        step raises."""
+        from ..resilience import retry as _retry
+        if step is not None:
+            if not self._is_valid(step):
+                self._quarantine(step, "explicitly requested but invalid")
+                raise ValueError(
+                    f"checkpoint step {step} is corrupt or missing")
+            chosen = step
+        else:
+            chosen = None
+            for s in reversed(self._steps()):
+                if self._is_valid(s):
+                    chosen = s
+                    break
+                self._quarantine(s, "failed validation during restore")
+            if chosen is None:
+                return None
+        state = _retry.retry_call(
+            load, self._path(chosen), label="ckpt_load")
         if model is not None and "model" in state:
             model.set_state_dict(state["model"])
         if optimizer is not None and "optimizer" in state:
             optimizer.set_state_dict(state["optimizer"])
+        if program is not None and "program" in state:
+            for n, v in state["program"].items():
+                holder = program.param_vars.get(n)
+                if holder is not None:
+                    holder.set_value(np.asarray(v))
+            for (opt, _), ostate in zip(
+                    getattr(program, "optimizers", []),
+                    state.get("program_optimizers", [])):
+                if ostate and opt._parameter_list is not None:
+                    opt.set_state_dict(ostate)
         return state
 
 
@@ -334,8 +477,16 @@ class DataLoader:
                  collate_fn=None, num_workers=0, prefetch_factor=2,
                  batch_sampler=None, return_list=True, feed_list=None,
                  places=None, use_native=True, seed=None,
-                 prefetch_to_device=0, device_mesh=None):
+                 prefetch_to_device=0, device_mesh=None, retry=True):
         self.dataset = dataset
+        # transient batch-assembly errors retry under backoff
+        # (resilience.retry); retry=False disables, a RetryPolicy
+        # customizes the budget
+        if retry is True:
+            from ..resilience.retry import default_policy
+            self._retry_policy = default_policy()
+        else:
+            self._retry_policy = retry or None
         self._device_prefetch = int(prefetch_to_device or 0)
         self._device_mesh = device_mesh
         # stream-style datasets (reference: dataloader_iter's
@@ -414,13 +565,30 @@ class DataLoader:
                 continue
         return False
 
+    def _assemble(self, idx, batch_index):
+        """One batch's assembly, with fault injection + transient-error
+        retry (resilience.retry): an I/O hiccup in dataset[i] retries
+        under the backoff budget instead of killing the epoch; budget
+        exhaustion and terminal errors still propagate."""
+        from ..resilience import faults as _faults
+        from ..resilience import retry as _retry
+
+        def attempt():
+            if _faults.enabled():
+                _faults.maybe_raise("loader", step=batch_index)
+            if self._native is not None:
+                return self._native.gather(idx)
+            return self.collate_fn([self.dataset[i] for i in idx])
+
+        if self._retry_policy is None:
+            return attempt()
+        return _retry.retry_call(attempt, policy=self._retry_policy,
+                                 label="dataloader")
+
     def _produce(self, q, stop):
         try:
-            for idx in self.batch_sampler:
-                if self._native is not None:
-                    item = self._native.gather(idx)
-                else:
-                    item = self.collate_fn([self.dataset[i] for i in idx])
+            for bi, idx in enumerate(self.batch_sampler):
+                item = self._assemble(idx, bi)
                 if not self._guarded_put(q, item, stop):
                     return
             self._guarded_put(q, _SENTINEL, stop)
@@ -454,16 +622,16 @@ class DataLoader:
             if self.num_workers > 0 and self._native_epoch is None:
                 yield from self._iter_multiprocess()
                 return
-            if self._native_epoch is not None:
+            from ..resilience import faults as _faults
+            if self._native_epoch is not None and not _faults.enabled():
+                # the all-in-memory C++ batcher has no I/O to fail; with
+                # faults registered, take the _assemble path so chaos
+                # runs exercise injection + retry end-to-end
                 yield from self._native_epoch
                 return
             if self.num_workers == 0 and self.prefetch <= 1:
-                for idx in self.batch_sampler:
-                    if self._native is not None:
-                        yield self._native.gather(idx)
-                    else:
-                        yield self.collate_fn(
-                            [self.dataset[i] for i in idx])
+                for bi, idx in enumerate(self.batch_sampler):
+                    yield self._assemble(idx, bi)
                 return
             producer = self._produce
         q = _queue.Queue(maxsize=self.prefetch)

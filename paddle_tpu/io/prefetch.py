@@ -15,6 +15,15 @@ Monitor series (when ``paddle_tpu.monitor`` is enabled):
 * ``prefetch.stall_seconds`` — total seconds the CONSUMER waited on the
                                queue; ~0 means the input pipeline keeps
                                up and the device is never starved
+* ``prefetch.drops``         — batches abandoned after the transient
+                               retry budget (resilience.retry) ran out
+
+The producer survives transient source errors: a failure classified
+transient (resilience.retry.is_transient) is retried under a backoff
+budget, and when the budget is spent the batch is *dropped* (counted,
+never silently) and the stream continues — one bad batch no longer
+permanently stalls every consumer of ``prefetch_to_device``. Terminal
+errors still propagate to the consumer on its next ``next()``.
 """
 from __future__ import annotations
 
@@ -26,6 +35,9 @@ import numpy as np
 import jax
 
 from .. import monitor as _monitor
+from ..resilience import faults as _faults
+from ..resilience import retry as _retry
+from ..resilience._common import record as _record
 
 _SENTINEL = object()
 
@@ -80,7 +92,8 @@ def _guarded_put(q, item, stop):
 
 
 def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
-                       sharding=None, device=None):
+                       sharding=None, device=None, retry=None,
+                       max_drops=16):
     """Wrap a batch iterator so the next ``size`` batches are moved to
     device on a background thread while the current step computes.
 
@@ -91,22 +104,64 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
     ``sharding`` overrides the per-leaf inference; ``device`` pins a
     single device when no mesh is given.
 
+    ``retry`` (a resilience.RetryPolicy; default 3 attempts with short
+    backoff) bounds transient-error recovery per batch; after the
+    budget the batch is dropped (``prefetch.drops``) and the stream
+    continues, up to ``max_drops`` cumulative drops before the error is
+    surfaced as terminal. Terminal errors propagate immediately.
+
     The wrapper is a generator: closing it (break / .close() / GC) stops
     and joins the worker thread — no thread leaks across iterators.
     """
     it = iter(iterator)
     q = _queue.Queue(maxsize=max(1, int(size)))
     stop = threading.Event()
+    policy = retry or _retry.default_policy()
 
     def produce():
-        try:
-            for batch in it:
-                placed = _place(batch, mesh, axis_name, sharding, device)
-                if not _guarded_put(q, placed, stop):
+        drops = 0
+        i = 0  # slot index: advances per delivered-or-dropped batch
+        while not stop.is_set():
+            attempts = 0
+            placed = None
+            delivered = False
+            while True:  # per-batch transient-retry loop
+                try:
+                    if _faults.enabled():
+                        _faults.maybe_raise("loader", step=i)
+                    batch = next(it)
+                    placed = _place(batch, mesh, axis_name, sharding,
+                                    device)
+                    delivered = True
+                    break
+                except StopIteration:
+                    _guarded_put(q, _SENTINEL, stop)
                     return
-            _guarded_put(q, _SENTINEL, stop)
-        except BaseException as e:  # surface to the consumer
-            _guarded_put(q, _PrefetchError(e), stop)
+                except BaseException as e:
+                    if not policy.is_transient(e):
+                        _guarded_put(q, _PrefetchError(e), stop)
+                        return
+                    attempts += 1
+                    if attempts >= policy.max_attempts:
+                        drops += 1
+                        if _monitor.enabled():
+                            _monitor.counter("prefetch.drops").inc()
+                        _record("drop", where="prefetch", step=i,
+                                error=repr(e))
+                        if drops > max_drops:
+                            _guarded_put(q, _PrefetchError(RuntimeError(
+                                f"prefetch: {drops} dropped batches "
+                                f"(> max_drops={max_drops}); last "
+                                f"transient error: {e!r}")), stop)
+                            return
+                        break  # drop this slot, move to the next batch
+                    _record("retry", where="prefetch", step=i,
+                            attempt=attempts, error=repr(e))
+                    if stop.wait(policy.delay(attempts - 1)):
+                        return
+            i += 1
+            if delivered and not _guarded_put(q, placed, stop):
+                return
 
     t = threading.Thread(target=produce, name="paddle_tpu-prefetch",
                          daemon=True)

@@ -27,6 +27,21 @@ Step-pipelining series (docs/performance.md "Step pipelining"):
 * ``prefetch.batches`` / ``prefetch.stall_seconds`` — device-prefetch
   throughput and consumer starvation time
 
+Resilience series (docs/robustness.md; ``paddle_tpu.resilience``):
+
+* ``resilience.retry``          — transient-error retries (loader,
+  prefetch, checkpoint I/O), with per-site JSONL events
+* ``resilience.nan_skip`` / ``resilience.rollback`` /
+  ``resilience.nan_raise`` — NaN-guard policy applications
+* ``resilience.watchdog_stall`` — steps past the rolling deadline
+  (each also emits a ``watchdog_dump`` event with a counter snapshot)
+* ``resilience.preempt_save`` / ``resilience.auto_resume`` —
+  preemption checkpoints and resumed runs
+* ``resilience.ckpt_quarantine`` — corrupt checkpoints set aside
+* ``resilience.fault_injected`` / ``resilience.drop`` — chaos-test
+  injections and batches dropped after retry exhaustion
+  (``prefetch.drops`` counts the same at the prefetch site)
+
 Everything funnels into one process-global :class:`Registry` and,
 when a sink is configured (``PADDLE_TPU_MONITOR_DIR`` or an explicit
 path to ``enable()``), a JSONL event stream.

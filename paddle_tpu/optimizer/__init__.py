@@ -27,6 +27,7 @@ from ..tensor import Tensor, Parameter
 from ..regularizer import WeightDecayRegularizer, L2Decay
 from ..clip import ClipGradBase
 from .. import monitor as _monitor
+from ..resilience import guard as _rguard
 from . import lr as lr_sched
 from .lr import LRScheduler
 
@@ -152,6 +153,22 @@ class Optimizer:
             regularized.append((p, g))
         params_grads = regularized
         lr = self._lr_tensor.data
+        g = _rguard.active()
+        if g is not None:
+            # resilience NaN guard: snapshot / apply / where-select (the
+            # AMP scaler scheme — jit-safe, so a to_static-fused train
+            # step skips poisoned updates inside the compiled computation)
+            finite = _rguard.guarded_apply(
+                self, params_grads,
+                lambda: self._apply_update(params_grads, lr))
+            g.note_device_flag(finite, optimizer=self)
+            return
+        self._apply_update(params_grads, lr)
+
+    def _apply_update(self, params_grads, lr):
+        """The raw update: batched multi-tensor path or the per-param
+        _rule loop (split from step() so the resilience guard can
+        bracket it with its snapshot/select machinery)."""
         if self._batched_update(params_grads, lr):
             self._post_step()
             return

@@ -1,0 +1,58 @@
+"""paddle_tpu.resilience — the fault-tolerant training runtime.
+
+Long multi-chip runs die three ways: transient I/O kills the input
+pipeline, a NaN step silently poisons every parameter, and a scheduler
+preemption lands mid-checkpoint and leaves garbage on disk. This
+subsystem turns each into a bounded, observable recovery:
+
+* :mod:`~paddle_tpu.resilience.retry`    — exponential backoff with
+  deterministic jitter + max-attempt budgets (prefetch producer,
+  DataLoader assembly, checkpoint I/O)
+* :mod:`~paddle_tpu.resilience.guard`    — step-level NaN/Inf guard
+  (``skip`` / ``rollback_to_last_ckpt`` / ``raise``) built on the AMP
+  scaler's fused finite-check, jit-safe
+* :mod:`~paddle_tpu.resilience.watchdog` — hung-step detection against
+  a rolling p99 deadline, with a monitor state dump per stall
+* :mod:`~paddle_tpu.resilience.preempt`  — SIGTERM/SIGINT → one atomic
+  final checkpoint + clean stop; ``fit(auto_resume=True)`` /
+  ``train_from_dataset(auto_resume=True)`` continue at the right step
+* :mod:`~paddle_tpu.resilience.faults`   — deterministic fault
+  injection (the tests' and chaos CI gate's chaos source)
+
+Checkpoint hardening itself (tmp-file + ``os.replace``, sha256
+sidecars, corrupt-file quarantine) lives in
+:class:`paddle_tpu.io.CheckpointManager`.
+
+Every recovery emits a ``resilience.*`` monitor counter and JSONL
+event: ``retry``, ``drop``, ``nan_skip``, ``rollback``, ``nan_raise``,
+``watchdog_stall``, ``preempt_signal``, ``preempt_save``,
+``auto_resume``, ``ckpt_quarantine``, ``fault_injected``.
+
+See docs/robustness.md for the workflow guide.
+"""
+from __future__ import annotations
+
+from . import faults  # noqa: F401
+from . import retry  # noqa: F401
+from . import guard  # noqa: F401
+from . import watchdog  # noqa: F401
+from . import preempt  # noqa: F401
+from ._common import record  # noqa: F401
+from .retry import (RetryPolicy, RetryExhausted, TransientError,  # noqa: F401
+                    retry_call, retrying, is_transient)
+from .guard import NaNGuard, NonFiniteError  # noqa: F401
+from .watchdog import Watchdog  # noqa: F401
+from .preempt import PreemptionHandler  # noqa: F401
+
+__all__ = [
+    "faults", "retry", "guard", "watchdog", "preempt",
+    "RetryPolicy", "RetryExhausted", "TransientError", "retry_call",
+    "retrying", "is_transient", "NaNGuard", "NonFiniteError",
+    "Watchdog", "PreemptionHandler", "record",
+]
+
+# PADDLE_TPU_FAULTS='[{"kind":"loader","step":3}]' registers faults at
+# import time — chaos runs with zero code changes.
+import os as _os
+if _os.environ.get("PADDLE_TPU_FAULTS"):
+    faults.load_env()

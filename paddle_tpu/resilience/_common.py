@@ -1,0 +1,18 @@
+"""Shared event plumbing for paddle_tpu.resilience.
+
+Every recovery action funnels through :func:`record` so one grep over
+the monitor output answers "what did the runtime survive": a counter
+``resilience.<event>`` plus a JSONL record ``{"kind": "resilience",
+"event": <event>, ...}`` when the monitor sink is active.
+"""
+from __future__ import annotations
+
+from .. import monitor as _monitor
+
+
+def record(event, **fields):
+    """Count + emit one resilience event (no-op while the monitor is
+    disabled, matching the framework's zero-cost-when-off discipline)."""
+    if _monitor.enabled():
+        _monitor.counter(f"resilience.{event}").inc()
+        _monitor.emit(kind="resilience", event=event, **fields)

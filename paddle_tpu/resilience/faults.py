@@ -1,0 +1,155 @@
+"""paddle_tpu.resilience.faults — deterministic fault injection.
+
+The chaos layer the resilience tests and `scripts/chaos_smoke.sh` drive:
+a process-global registry of fault specs, each firing either at exact
+step numbers or with a seeded per-spec probability, with a bounded fire
+count. Injection sites sit inside the code paths the faults simulate
+(DataLoader/prefetch producers for ``loader``, the hapi/executor train
+loops for ``nan_grad`` / ``slow_step`` / ``preempt``) so recovery is
+exercised end-to-end, not unit-mocked.
+
+Well-known kinds (the registry itself is string-keyed and open):
+
+* ``loader``    — raise inside the batch producer (default: a
+                  :class:`~paddle_tpu.resilience.retry.TransientError`)
+* ``nan_grad``  — poison one training batch so loss/grads go NaN
+* ``slow_step`` — sleep ``delay`` seconds inside a step (watchdog food)
+* ``preempt``   — simulated SIGTERM: save-and-stop mid-run
+
+Every injection site is behind :func:`enabled` — an empty registry
+costs one truthiness check.
+
+Specs can also come from the environment for no-code chaos runs:
+``PADDLE_TPU_FAULTS='[{"kind":"loader","step":3}]'`` (a JSON list of
+:func:`inject` keyword dicts) is loaded on first import of
+``paddle_tpu.resilience``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+
+from ._common import record
+from .retry import TransientError
+
+
+class FaultSpec:
+    """One injected fault: where it fires (exact steps and/or seeded
+    probability), how often (``times`` budget), and what it does
+    (raise ``exc``, or sleep ``delay`` for slow-step faults)."""
+
+    def __init__(self, kind, step=None, probability=1.0, times=1,
+                 exc=None, delay=0.0, seed=0):
+        self.kind = kind
+        if step is None:
+            self.steps = None
+        elif isinstance(step, (list, tuple, set, frozenset)):
+            self.steps = frozenset(int(s) for s in step)
+        else:
+            self.steps = frozenset((int(step),))
+        self.probability = float(probability)
+        self.times = None if times is None else int(times)
+        self.exc = exc
+        self.delay = float(delay)
+        self._rng = random.Random(seed)
+        self.fired = 0
+
+    def should_fire(self, step):
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.steps is not None and (
+                step is None or int(step) not in self.steps):
+            return False
+        if self.probability >= 1.0:
+            return True
+        return self._rng.random() < self.probability
+
+    def make_exc(self):
+        e = self.exc
+        if e is None:
+            return TransientError(
+                f"injected {self.kind} fault (fire #{self.fired})")
+        if isinstance(e, type):
+            return e(f"injected {self.kind} fault")
+        if callable(e):
+            return e()
+        return e
+
+
+_lock = threading.Lock()
+_specs = {}   # kind -> [FaultSpec]
+
+
+def inject(kind, step=None, probability=1.0, times=1, exc=None,
+           delay=0.0, seed=0):
+    """Register a fault. Returns the spec (its ``.fired`` counter is the
+    test-side evidence the injection actually happened)."""
+    spec = FaultSpec(kind, step=step, probability=probability, times=times,
+                     exc=exc, delay=delay, seed=seed)
+    with _lock:
+        _specs.setdefault(kind, []).append(spec)
+    return spec
+
+
+def clear(kind=None):
+    """Drop all specs (or just one kind). Tests call this in teardown so
+    faults never leak across cases."""
+    with _lock:
+        if kind is None:
+            _specs.clear()
+        else:
+            _specs.pop(kind, None)
+
+
+def enabled():
+    """True when any fault is registered — the one check hot paths pay."""
+    return bool(_specs)
+
+
+def fire(kind, step=None):
+    """Consume one firing of `kind` at `step` if a spec matches.
+    Returns the spec (or None). Emits ``resilience.fault_injected``."""
+    specs = _specs.get(kind)
+    if not specs:
+        return None
+    with _lock:
+        for spec in specs:
+            if spec.should_fire(step):
+                spec.fired += 1
+                record("fault_injected", fault=kind, step=step,
+                       fire=spec.fired)
+                return spec
+    return None
+
+
+def maybe_raise(kind, step=None):
+    """Raise the spec's exception if a `kind` fault fires at `step`."""
+    spec = fire(kind, step)
+    if spec is not None:
+        raise spec.make_exc()
+
+
+def maybe_sleep(kind, step=None):
+    """Sleep the spec's ``delay`` if a `kind` fault fires at `step`
+    (slow-step simulation). Returns True when it slept."""
+    spec = fire(kind, step)
+    if spec is not None and spec.delay > 0:
+        time.sleep(spec.delay)
+        return True
+    return spec is not None
+
+
+def load_env(var="PADDLE_TPU_FAULTS"):
+    """Load a JSON list of inject() kwarg dicts from the environment
+    (no-code chaos runs). Returns the created specs."""
+    raw = os.environ.get(var, "")
+    if not raw:
+        return []
+    out = []
+    for entry in json.loads(raw):
+        kw = dict(entry)
+        out.append(inject(kw.pop("kind"), **kw))
+    return out

@@ -1,0 +1,184 @@
+"""paddle_tpu.resilience.guard — step-level NaN/Inf protection.
+
+One NaN step silently poisons every parameter; this guard makes the
+blast radius one *skipped* step instead. Three policies:
+
+* ``skip``                  — drop the poisoned update, keep training
+* ``rollback_to_last_ckpt`` — restore model+optimizer from the guard's
+                              CheckpointManager, keep training
+* ``raise``                 — fail fast with :class:`NonFiniteError`
+
+Two enforcement layers share the AMP scaler's finite-check machinery
+(``amp.tree_all_finite`` — ONE fused all-finite reduction, jit-safe):
+
+1. **Optimizer level** (`guarded_apply`, called by ``Optimizer.step``
+   while a guard is installed): snapshot params+slots, apply the
+   update, then ``jnp.where``-select the old state back when any grad
+   is non-finite. Pure device selects — it composes with
+   ``jit.to_static`` exactly like ``amp.GradScaler.step`` does, so the
+   fused hapi train step gets skip protection *inside* the compiled
+   computation.
+2. **Host level** (`check_host`, called by ``hapi.Model.fit`` /
+   ``Executor.run`` on the materialized loss): counts
+   ``resilience.nan_skip``, and applies the rollback / raise policies
+   that need host control flow.
+
+Install a guard for the optimizer layer with ``with guard:`` (or
+``guard.install()``); ``fit(nan_guard=...)`` does this for you.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+
+from ._common import record
+
+POLICIES = ("skip", "rollback_to_last_ckpt", "raise")
+
+
+class NonFiniteError(FloatingPointError):
+    """Raised by policy="raise" (and by skip/rollback guards when
+    ``max_consecutive`` poisoned steps arrive back to back)."""
+
+
+_state = threading.local()
+
+
+def active():
+    """The innermost installed guard, or None (checked by
+    Optimizer.step; one attribute read when no guard is in play)."""
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else None
+
+
+class NaNGuard:
+    """See module docstring. ``checkpoint_manager`` is required for the
+    rollback policy; ``max_consecutive`` (default 10) bounds how many
+    poisoned steps in a row skip/rollback will absorb before raising —
+    a permanently-NaN model should fail, not spin forever."""
+
+    def __init__(self, policy="skip", checkpoint_manager=None,
+                 max_consecutive=10):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"NaNGuard policy must be one of {POLICIES}, got {policy!r}")
+        self.policy = policy
+        self.checkpoint_manager = checkpoint_manager
+        self.max_consecutive = max_consecutive
+        self.consecutive = 0
+        self.total_nonfinite = 0
+
+    # -- install / uninstall (optimizer-level enforcement) -----------------
+
+    def install(self):
+        stack = getattr(_state, "stack", None)
+        if stack is None:
+            stack = _state.stack = []
+        stack.append(self)
+        return self
+
+    def uninstall(self):
+        stack = getattr(_state, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif stack and self in stack:
+            stack.remove(self)
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- host-level enforcement ---------------------------------------------
+
+    def check_host(self, value, step=None, model=None, optimizer=None,
+                   program=None, where="train"):
+        """Check a materialized loss/flag on the host. Returns True when
+        finite; on non-finite applies the policy and returns False (the
+        caller drops the step from its averages)."""
+        if value is None:
+            return True
+        v = float(np.asarray(value).ravel()[0]) if not isinstance(
+            value, float) else value
+        if math.isfinite(v):
+            self.consecutive = 0
+            return True
+        self._on_nonfinite(step=step, value=v, model=model,
+                           optimizer=optimizer, program=program, where=where)
+        return False
+
+    def note_device_flag(self, finite, step=None, model=None,
+                         optimizer=None, program=None, where="optimizer"):
+        """Host-sync a device finite flag when possible and apply the
+        policy. Under a jit trace the flag is a tracer — the select
+        machinery already handled skip semantics, so this quietly
+        returns None there; rollback/raise then happen at the host
+        level via check_host on the materialized loss."""
+        try:
+            ok = bool(finite)
+        except Exception:  # tracer: inside jit.to_static / Executor jit
+            return None
+        if ok:
+            self.consecutive = 0
+            return True
+        self._on_nonfinite(step=step, model=model, optimizer=optimizer,
+                           program=program, where=where)
+        return False
+
+    def _on_nonfinite(self, step=None, value=None, model=None,
+                      optimizer=None, program=None, where="train"):
+        self.consecutive += 1
+        self.total_nonfinite += 1
+        if self.policy == "raise":
+            record("nan_raise", step=step, where=where)
+            raise NonFiniteError(
+                f"non-finite loss/gradients at step {step} ({where}); "
+                "policy='raise'")
+        if self.max_consecutive and self.consecutive > self.max_consecutive:
+            raise NonFiniteError(
+                f"{self.consecutive} consecutive non-finite steps at step "
+                f"{step} ({where}) — model state is unrecoverable under "
+                f"policy={self.policy!r}")
+        if self.policy == "rollback_to_last_ckpt":
+            if self.checkpoint_manager is None:
+                raise ValueError(
+                    "NaNGuard(policy='rollback_to_last_ckpt') needs a "
+                    "checkpoint_manager")
+            state = self.checkpoint_manager.restore(
+                model=model, optimizer=optimizer, program=program)
+            record("rollback", step=step,
+                   restored_step=None if state is None else state.get("step"),
+                   where=where)
+            return
+        # skip: the poisoned update was already dropped (optimizer-level
+        # where-select, or never applied); just account for it
+        record("nan_skip", step=step, where=where, value=value)
+
+
+def guarded_apply(optimizer, params_grads, apply_fn):
+    """jit-safe skip enforcement for one optimizer update (the AMP
+    scaler's snapshot / apply / where-select scheme): run ``apply_fn()``
+    then select every param and slot back to its pre-step value when any
+    grad is non-finite. Returns the device finite flag."""
+    import jax.numpy as jnp
+    from ..amp import tree_all_finite
+
+    finite = tree_all_finite([g for _, g in params_grads if g is not None])
+    # slots must exist BEFORE the snapshot or a rolled-back step would
+    # leave lazily-created accumulators holding the poisoned update
+    optimizer._ensure_all_slots()
+    params = [p for p, g in params_grads if g is not None]
+    old_params = [p.data for p in params]
+    old_slots = [(t, t.data)
+                 for slots in optimizer._accumulators.values()
+                 for t in slots.values()]
+    apply_fn()
+    for p, old in zip(params, old_params):
+        p.data = jnp.where(finite, p.data, old)
+    for t, old in old_slots:
+        t.data = jnp.where(finite, t.data, old)
+    return finite

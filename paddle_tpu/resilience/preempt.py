@@ -1,0 +1,89 @@
+"""paddle_tpu.resilience.preempt — SIGTERM/SIGINT-safe training.
+
+TPU pools preempt: the scheduler sends SIGTERM and the process has
+seconds to persist state. The handler here converts that signal into a
+*cooperative* flag the training loop polls at step boundaries — the
+loop (``hapi.Model.fit`` / ``Executor.train_from_dataset``) then writes
+one atomic final checkpoint (``resilience.preempt_save``) and stops
+cleanly, so the next invocation's ``auto_resume=True`` continues at the
+right step. Doing the save at a step boundary rather than inside the
+signal handler keeps it off the async-signal path (no half-updated
+optimizer state, no reentrant pickling).
+
+Signal handlers are process-global and main-thread-only; installation
+from a worker thread is a silent no-op (the flag can still be set by
+:func:`request` — how simulated preemption and tests drive it).
+"""
+from __future__ import annotations
+
+import signal
+import threading
+
+from ._common import record
+
+
+class PreemptionHandler:
+    """Install with ``with PreemptionHandler() as p:`` (or
+    ``install()``/``uninstall()``); poll ``p.triggered`` at step
+    boundaries. Previous handlers are chained — an outer framework's
+    SIGTERM logic still runs — and restored on uninstall."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT),
+                 on_preempt=None):
+        self.signals = tuple(signals)
+        self.on_preempt = on_preempt
+        self._event = threading.Event()
+        self._previous = {}
+        self._installed = False
+
+    @property
+    def triggered(self):
+        return self._event.is_set()
+
+    def request(self, signum=None):
+        """Mark preemption requested (the signal handler body; also the
+        entry point for simulated preemption)."""
+        first = not self._event.is_set()
+        self._event.set()
+        if first:
+            record("preempt_signal", signum=signum)
+            if self.on_preempt is not None:
+                self.on_preempt(signum)
+
+    def _handle(self, signum, frame):
+        self.request(signum)
+        prev = self._previous.get(signum)
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL,
+                                           signal.default_int_handler):
+            prev(signum, frame)
+
+    def install(self):
+        if self._installed:
+            return self
+        try:
+            for s in self.signals:
+                self._previous[s] = signal.signal(s, self._handle)
+            self._installed = True
+        except ValueError:
+            # not the main thread: signals can't be installed here; the
+            # cooperative flag still works via request()
+            self._previous.clear()
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        for s, prev in self._previous.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._previous.clear()
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

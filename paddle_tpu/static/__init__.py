@@ -410,8 +410,12 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, scope=None, bucket=False, buckets=None,
-            pad_mode="repeat", async_fetch=False, fetch_period=None):
+            pad_mode="repeat", async_fetch=False, fetch_period=None,
+            nan_guard=None):
         program = program or default_main_program()
+        if isinstance(nan_guard, str):
+            from ..resilience.guard import NaNGuard
+            nan_guard = NaNGuard(nan_guard)
         dp_mesh = None
         dp_requested = False
         if isinstance(program, CompiledProgram):
@@ -482,7 +486,8 @@ class Executor:
             self._param_slot_names(program)
 
         base_key = (program.id, program.version, tuple(fetch_names),
-                    self._mesh_sig(dp_mesh, dp_requested))
+                    self._mesh_sig(dp_mesh, dp_requested),
+                    nan_guard is not None)
         key = base_key + (tuple(sorted((k, tuple(a.shape), str(a.dtype))
                                        for k, a in feed_arrays.items())),)
         if _monitor.enabled():
@@ -497,7 +502,8 @@ class Executor:
             self._seen_base.add(base_key)
             self._cache[key] = self._compile(program, fetch_names,
                                              sorted(feed_arrays),
-                                             param_names, slot_names)
+                                             param_names, slot_names,
+                                             nan_guard=nan_guard is not None)
         compiled = self._cache[key]
 
         param_vals = [program.param_vars[n].data for n in param_names]
@@ -510,14 +516,27 @@ class Executor:
         rng_vals = (list(prandom.split_keys(len(program.rng_vars)))
                     if program.rng_vars else [])
 
-        fetches, new_params, new_slots = compiled(feed_vals, param_vals,
-                                                  slot_vals, lr_vals,
-                                                  rng_vals)
+        finite_flag = None
+        if nan_guard is not None:
+            fetches, new_params, new_slots, finite_flag = compiled(
+                feed_vals, param_vals, slot_vals, lr_vals, rng_vals)
+        else:
+            fetches, new_params, new_slots = compiled(feed_vals, param_vals,
+                                                      slot_vals, lr_vals,
+                                                      rng_vals)
 
         for n, v in zip(param_names, new_params):
             program.param_vars[n].data = v
         for (oi, pid, sn), v in zip(slot_names, new_slots):
             opt_entries[oi][0]._accumulators[pid][sn].data = v
+
+        if finite_flag is not None:
+            # the compiled step already where-selected the old params back
+            # on a non-finite step (skip semantics in-jit); the host sync
+            # here accounts for it and drives rollback/raise policies.
+            nan_guard.note_device_flag(
+                bool(np.asarray(jax.device_get(finite_flag))),
+                program=program, where="executor")
 
         if async_fetch or fetch_period:
             # non-blocking fetch path: hand back the PREVIOUS step's
@@ -571,7 +590,9 @@ class Executor:
     def train_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
                            fetch_info=None, print_period=100,
-                           prefetch=0, bucket=False, buckets=None):
+                           prefetch=0, bucket=False, buckets=None,
+                           checkpoint=None, save_steps=None,
+                           auto_resume=False, nan_guard=None):
         """reference executor.py:train_from_dataset — run the program
         over every batch a fluid.dataset yields. The reference spawns
         C++ DataFeed threads; here each host-assembled MultiSlot batch
@@ -581,23 +602,77 @@ class Executor:
         ``prefetch=N`` stages the next N feed dicts on device via a
         background thread while the current step runs; ``bucket=True``
         pads ragged final batches up to the bucket set so the epoch
-        doesn't recompile on its tail."""
+        doesn't recompile on its tail.
+
+        Resilience: ``checkpoint`` (an io.CheckpointManager or a
+        directory path) enables atomic program checkpoints every
+        ``save_steps`` batches and on SIGTERM/SIGINT; ``auto_resume=True``
+        restores the newest valid checkpoint and skips already-trained
+        batches; ``nan_guard`` (a resilience.NaNGuard or policy string)
+        guards every step."""
         if dataset is None:
             raise RuntimeError("dataset is required for train_from_dataset")
         fetch_list = fetch_list or []
         fetch_info = fetch_info or [getattr(v, "name", str(v))
                                     for v in fetch_list]
+
+        prog = program or default_main_program()
+        real_prog = prog.program if isinstance(prog, CompiledProgram) else prog
+        cm = None
+        if checkpoint is not None:
+            from ..io import CheckpointManager
+            cm = (checkpoint if isinstance(checkpoint, CheckpointManager)
+                  else CheckpointManager(checkpoint))
+        if isinstance(nan_guard, str):
+            from ..resilience.guard import NaNGuard
+            nan_guard = NaNGuard(nan_guard, checkpoint_manager=cm)
+        if nan_guard is not None and \
+                nan_guard.checkpoint_manager is None and cm is not None:
+            nan_guard.checkpoint_manager = cm
+
+        from ..resilience import faults as _faults
+        from ..resilience._common import record as _rrecord
+        start_step = 0
+        if auto_resume and cm is not None:
+            latest = cm.latest_step()
+            if latest is not None:
+                state = cm.restore(program=real_prog, step=latest)
+                start_step = int(state.get("step", latest)) + 1
+                _rrecord("auto_resume", step=start_step,
+                         checkpoint_step=latest, where="executor")
+
+        handler = None
+        if cm is not None:
+            from ..resilience.preempt import PreemptionHandler
+            handler = PreemptionHandler().install()
+
         batches = dataset._batches()
         if prefetch:
             from ..io.prefetch import prefetch_to_device
             batches = prefetch_to_device(batches, size=prefetch)
-        for i, batch in enumerate(batches):
-            outs = self.run(program, feed=batch, fetch_list=fetch_list,
-                            scope=scope, bucket=bucket, buckets=buckets)
-            if debug and fetch_list and i % max(print_period, 1) == 0:
-                msg = ", ".join(f"{n}={np.asarray(o).ravel()[:1]}"
-                                for n, o in zip(fetch_info, outs))
-                print(f"batch {i}: {msg}", flush=True)
+        try:
+            for i, batch in enumerate(batches):
+                if i < start_step:
+                    continue  # auto_resume fast-forward
+                outs = self.run(program, feed=batch, fetch_list=fetch_list,
+                                scope=scope, bucket=bucket, buckets=buckets,
+                                nan_guard=nan_guard)
+                if debug and fetch_list and i % max(print_period, 1) == 0:
+                    msg = ", ".join(f"{n}={np.asarray(o).ravel()[:1]}"
+                                    for n, o in zip(fetch_info, outs))
+                    print(f"batch {i}: {msg}", flush=True)
+                preempted = (handler is not None and handler.triggered) or \
+                    (_faults.enabled() and _faults.fire("preempt", i))
+                if cm is not None and (
+                        preempted or (save_steps and (i + 1) % save_steps == 0)):
+                    cm.save(i, program=real_prog)
+                    if preempted:
+                        _rrecord("preempt_save", step=i, where="executor")
+                if preempted:
+                    break
+        finally:
+            if handler is not None:
+                handler.uninstall()
 
     def infer_from_dataset(self, program=None, dataset=None, scope=None,
                            thread=0, debug=False, fetch_list=None,
@@ -652,7 +727,7 @@ class Executor:
         param_names, opt_entries, slot_names = \
             self._param_slot_names(program)
         base_key = (program.id, program.version, tuple(fetch_names),
-                    self._mesh_sig(dp_mesh, dp_requested))
+                    self._mesh_sig(dp_mesh, dp_requested), False)
         key = base_key + (tuple(sorted((k, s, str(d))
                                        for k, (s, d) in specs.items())),)
         if key in self._cache:
@@ -702,7 +777,7 @@ class Executor:
         return key
 
     def _compile(self, program, fetch_names, feed_order, param_names,
-                 slot_names):
+                 slot_names, nan_guard=False):
         if _monitor.enabled():
             _monitor.counter("executor.compile").inc()
             _monitor.emit(kind="executor_compile", program_id=program.id,
@@ -741,6 +816,7 @@ class Executor:
             new_params = list(param_vals)
             new_slots = list(slot_vals)
             fetches = None
+            finite = jnp.asarray(True) if nan_guard else None
             for oi, (opt, loss_name) in enumerate(opt_entries):
                 # grads of loss wrt trainable params via jax.grad over the
                 # interpreter (replaces reference append_backward grad ops);
@@ -757,6 +833,11 @@ class Executor:
                 grads, env = jax.grad(loss_of, has_aux=True)(tp)
                 if fetches is None:
                     fetches = [env[n] for n in fetch_names]
+                if nan_guard:
+                    from ..amp import tree_all_finite
+                    finite = jnp.logical_and(
+                        finite, tree_all_finite(
+                            list(grads) + [env[loss_name]]))
 
                 # reference order: clip raw grads first, then regularize
                 params_grads = [(i, program.param_vars[param_names[i]],
@@ -788,6 +869,18 @@ class Executor:
             if fetches is None:
                 env = forward(feed_vals, param_vals, rng_vals)
                 fetches = [env[n] for n in fetch_names]
+                if nan_guard:
+                    from ..amp import tree_all_finite
+                    finite = tree_all_finite(fetches)
+            if nan_guard:
+                # in-jit skip: a non-finite step keeps the pre-step state
+                # (same select scheme as amp.GradScaler.step), and the
+                # flag rides out for host-level policy enforcement
+                new_params = [jnp.where(finite, nv, ov)
+                              for nv, ov in zip(new_params, param_vals)]
+                new_slots = [jnp.where(finite, nv, ov)
+                             for nv, ov in zip(new_slots, slot_vals)]
+                return fetches, new_params, new_slots, finite
             return fetches, new_params, new_slots
 
         return jax.jit(run_fn, donate_argnums=(1, 2))

@@ -1,0 +1,131 @@
+"""Chaos smoke gate for the fault-tolerant training runtime
+(paddle_tpu.resilience). Tier-1-safe: tiny MLP, CPU, seconds end to end.
+
+One training run absorbs every injected fault class and a second run
+resumes from the wreckage; the gates assert the ISSUE's acceptance
+criteria from the monitor JSONL stream:
+
+* a transient loader fault at one batch retries (``resilience.retry``)
+  and the epoch still yields every batch
+* a NaN-poisoned step is skipped (``resilience.nan_skip``) and the run's
+  epoch losses stay finite
+* a mid-run preemption writes one atomic checkpoint
+  (``resilience.preempt_save``) and stops cleanly
+* a truncated checkpoint planted at a NEWER step never wins
+  ``latest_step()`` and is quarantined on restore
+* the resumed run continues at exactly the step after the preemption
+  save (``resilience.auto_resume``) and finishes with finite loss
+
+Writes the monitor JSONL to --out-dir as the CI artifact and prints one
+JSON result line. Exit code 0 iff every gate passes.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="/tmp/paddle_tpu_chaos_smoke")
+    ap.add_argument("--epochs", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+
+    import paddle_tpu as pt
+    from paddle_tpu import hapi, monitor, nn, optimizer as opt
+    from paddle_tpu.io import CheckpointManager, TensorDataset
+    from paddle_tpu.resilience import NaNGuard, faults
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    jsonl = monitor.enable(os.path.join(args.out_dir, "chaos_smoke.jsonl"))
+    ckpt_dir = os.path.join(args.out_dir, "ckpts")
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 3)
+    x = rng.randn(64, 8).astype("f4")
+    y = (x @ w).argmax(-1).astype("i4")
+    ds = TensorDataset(x, y)
+    steps_per_epoch = 64 // args.batch
+
+    def model():
+        pt.seed(7)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+        m = hapi.Model(net)
+        m.prepare(optimizer=opt.SGD(learning_rate=0.05,
+                                    parameters=m.parameters()),
+                  loss_function=hapi.CrossEntropy())
+        return m
+
+    # -- run 1: loader fault + NaN step + mid-run preemption ----------------
+    preempt_step = steps_per_epoch + 2  # epoch 1, batch 2
+    loader_spec = faults.inject("loader", step=1, times=2)
+    nan_spec = faults.inject("nan_grad", step=3)
+    faults.inject("preempt", step=preempt_step)
+
+    guard = NaNGuard("skip")
+    cm = CheckpointManager(ckpt_dir)
+    m1 = model()
+    h1 = m1.fit(ds, batch_size=args.batch, epochs=args.epochs, verbose=0,
+                shuffle=False, checkpoint=cm, nan_guard=guard)
+    faults.clear()
+
+    # a truncated checkpoint at a NEWER step (simulated SIGKILL mid-write
+    # without the atomic rename) must never win latest_step()
+    bogus = cm._path(99)
+    with open(bogus, "wb") as f:
+        f.write(b"\x80truncated-checkpoint")
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        latest_after_truncation = cm.latest_step()
+
+    # -- run 2: auto-resume from the preemption checkpoint ------------------
+    m2 = model()
+    h2 = m2.fit(ds, batch_size=args.batch, epochs=args.epochs, verbose=0,
+                shuffle=False, checkpoint=cm, auto_resume=True,
+                nan_guard="skip")
+    monitor.disable()
+
+    records = [r for r in monitor.read_jsonl(jsonl)
+               if r.get("kind") == "resilience"]
+    events = {}
+    for r in records:
+        events.setdefault(r["event"], []).append(r)
+    resume_steps = [r.get("step") for r in events.get("auto_resume", [])]
+
+    finite_losses = [float(v) for v in h1["loss"] + h2["loss"]]
+    gates = {
+        "loader_fault_fired_twice": loader_spec.fired == 2,
+        "nan_fault_fired": nan_spec.fired == 1,
+        "retry_events": len(events.get("retry", [])) >= 2,
+        "nan_skip_events": len(events.get("nan_skip", [])) == 1,
+        "losses_all_finite": all(np.isfinite(finite_losses)),
+        "preempted_and_stopped": bool(m1.stop_training),
+        "preempt_save_at_right_step": [
+            r.get("step") for r in events.get("preempt_save", [])
+        ] == [preempt_step],
+        "truncated_ckpt_never_wins": latest_after_truncation == preempt_step,
+        "corrupt_ckpt_quarantined": os.path.exists(bogus + ".corrupt")
+        and not os.path.exists(bogus),
+        "resumed_at_next_step": resume_steps == [preempt_step + 1],
+    }
+    result = {
+        "gates": gates,
+        "ok": all(gates.values()),
+        "run1_loss": h1["loss"],
+        "run2_loss": h2["loss"],
+        "jsonl": jsonl,
+    }
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# CI gate for the fault-tolerant training runtime (paddle_tpu.resilience):
+# one run absorbs an injected loader fault, a NaN step and a mid-run
+# preemption; a second run auto-resumes from the atomic checkpoint at the
+# right step; a planted truncated checkpoint must never win latest_step().
+# Tier-1-safe: tiny MLP, CPU backend, seconds end to end.
+#
+# Usage: scripts/chaos_smoke.sh [out_dir]
+# The monitor JSONL stream lands in out_dir (default
+# /tmp/paddle_tpu_chaos_smoke) as the CI artifact; the last stdout line
+# is one JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_chaos_smoke}"
+rm -rf "$OUT_DIR"
+JAX_PLATFORMS=cpu python scripts/chaos_smoke.py --out-dir "$OUT_DIR"
