@@ -120,7 +120,8 @@ def _probe_pallas_kernels():
             P.configure(**{name: False})
 
 
-def bench_bert(batch=64, seq=128, steps=32, inner=8, **cfg_kw):
+def bench_bert(batch=64, seq=128, steps=32, inner=8, measured_key=None,
+               **cfg_kw):
     """`inner` REAL optimizer steps (distinct resident batches) run per
     compiled call — one dispatch covers `inner` steps, so the tunnel /
     host-dispatch round-trip amortizes instead of flooring the step
@@ -170,6 +171,10 @@ def bench_bert(batch=64, seq=128, steps=32, inner=8, **cfg_kw):
         loss = fn(t_ids, t_mlm, t_nsp)
     loss.numpy()
     dt = (time.perf_counter() - t0) / (n_calls * inner)
+    if measured_key:
+        m = _measured_mfu(dt, per_call_steps=inner)
+        if m is not None:
+            _RESULTS[measured_key] = m
     return batch * seq / dt, float(loss.numpy())
 
 
@@ -179,7 +184,8 @@ def bench_bert(batch=64, seq=128, steps=32, inner=8, **cfg_kw):
 RESNET_FORMAT = "NCHW"
 
 
-def bench_resnet(batch=128, steps=12, inner=4, data_format=None):
+def bench_resnet(batch=128, steps=12, inner=4, data_format=None,
+                 measured_key=None):
     """`inner` real steps per compiled call (distinct resident uint8
     batches, normalized on device) — see bench_bert."""
     import paddle_tpu as pt
@@ -223,6 +229,10 @@ def bench_resnet(batch=128, steps=12, inner=4, data_format=None):
         loss = fn(tx, ty)
     loss.numpy()
     dt = (time.perf_counter() - t0) / (n_calls * inner)
+    if measured_key:
+        m = _measured_mfu(dt, per_call_steps=inner)
+        if m is not None:
+            _RESULTS[measured_key] = m
     return batch / dt, float(loss.numpy())
 
 
@@ -293,6 +303,7 @@ def bench_bert_long(batch=4, seq=2048, steps=8):
     scores matters on HBM. inner=2 keeps the unrolled 12-layer seq-2048
     graph's compile time bounded."""
     return bench_bert(batch=batch, seq=seq, steps=steps, inner=2,
+                      measured_key="bert_seq2048_mfu_measured",
                       max_position_embeddings=2048)
 
 
@@ -301,7 +312,8 @@ def bench_bert_seq512(batch=16, seq=512, steps=16, inner=4):
     smallest shape the flash gate routes to the Pallas kernel, and
     batch 16 x seq 512 keeps tokens/step identical to the seq-128
     headline (8,192) so tok/s is directly comparable."""
-    return bench_bert(batch=batch, seq=seq, steps=steps, inner=inner)
+    return bench_bert(batch=batch, seq=seq, steps=steps, inner=inner,
+                      measured_key="bert_seq512_mfu_measured")
 
 
 _RESULTS = {}  # metrics banked as each stage finishes (partial-credit)
@@ -320,6 +332,35 @@ def _mfu(rate_per_s, flops_per_item):
     if not peak or not rate_per_s:
         return None
     return round(rate_per_s * flops_per_item / peak, 4)
+
+
+def _measured_mfu(step_time_s, label="jit.step", per_call_steps=1):
+    """MFU from the XLA-counted flops of the bench's compiled step
+    (monitor.xla captures the executable on first compile): flops per
+    call ÷ steps-per-call, over the measured step time × peak.
+    Complements _mfu's analytic 6N figure — agreement within ~20%
+    validates the analytic denominator; a bigger gap means remat, a
+    miscounted model, or a fused step doing extra work. None off-TPU
+    or when no capture landed (absent beats fabricated)."""
+    try:
+        from paddle_tpu import monitor
+        f = monitor.xla.flops(label)
+        peak = monitor.peak_flops_for_device()
+    except Exception:
+        return None
+    if not f or not peak or not step_time_s:
+        return None
+    return round(f / per_call_steps / step_time_s / peak, 4)
+
+
+def _note_mfu_divergence(prefix):
+    """Bank an explicit flag when analytic and XLA-measured MFU disagree
+    by >20% — the ratio rides the perf line so a drifting denominator
+    is visible in the ledger, not just in a warning on stderr."""
+    a = _RESULTS.get(f"{prefix}_mfu")
+    m = _RESULTS.get(f"{prefix}_mfu_measured")
+    if a and m and abs(m / a - 1.0) > 0.2:
+        _RESULTS[f"{prefix}_mfu_divergence"] = round(m / a, 3)
 
 
 def _bert_flops_per_token():
@@ -534,7 +575,7 @@ def main():
     _RESULTS["provenance"] = _provenance(with_device=True)
     _enable_monitoring_and_cache()
     _probe_pallas_kernels()
-    bert_tps, bert_loss = bench_bert()
+    bert_tps, bert_loss = bench_bert(measured_key="bert_mfu_measured")
     _record_stage_compiles("bert_seq128")
     # partial lines are deliberately NOT json (exactly one JSON line at
     # the end) — they leave evidence if the harness kills us mid-run
@@ -544,7 +585,8 @@ def main():
                                       3),
                     bert_loss=round(bert_loss, 4),
                     bert_mfu=_mfu(bert_tps, _bert_flops_per_token()))
-    rn_ips, rn_loss = bench_resnet()
+    _note_mfu_divergence("bert")
+    rn_ips, rn_loss = bench_resnet(measured_key="resnet50_mfu_measured")
     _record_stage_compiles("resnet50")
     print(f"partial resnet_images_per_sec={rn_ips:.1f}", flush=True)
     from paddle_tpu import monitor as _mon
@@ -553,6 +595,7 @@ def main():
         resnet50_vs_baseline=round(rn_ips / RESNET_BASELINE_IMG_S, 3),
         resnet50_loss=round(rn_loss, 4),
         resnet50_mfu=_mfu(rn_ips, _mon.RESNET50_TRAIN_FLOPS_PER_IMAGE))
+    _note_mfu_divergence("resnet50")
     if not args.fast:
         try:
             pipe_ips, loader_ips = bench_resnet_pipeline()
@@ -579,6 +622,7 @@ def main():
             _RESULTS[key] = round(tps, 1)
             _RESULTS[key.replace("_tokens_per_sec", "_mfu")] = \
                 _mfu(tps, _bert_flops_per_token())
+            _note_mfu_divergence(key.replace("_tokens_per_sec", ""))
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

@@ -32,6 +32,9 @@ _in_static_mode = False
 # Monitor hook, installed by paddle_tpu.monitor.enable(). None (the
 # default) keeps the fast path at a single `is None` check — the
 # disabled-mode cost contract asserted by tests/test_monitor.py.
+# With time_ops, the hook's t0 stamp also feeds per-op `dispatch.<op>`
+# complete events into monitor.trace (the span timeline reuses the one
+# perf_counter() pair time_dispatch already pays — no extra cost here).
 _monitor_hook = None
 _monitor_time = False
 

@@ -15,6 +15,7 @@ import numpy as np
 
 from .. import io as pio
 from .. import jit
+from .. import monitor as _monitor
 from ..nn import Layer
 from .callbacks import CallbackList, ProgBarLogger, ModelCheckpoint
 from .metrics import Metric
@@ -291,7 +292,12 @@ class Model(Layer):
                             wd_ctx.__enter__()
                         if _faults.enabled():
                             _faults.maybe_sleep("slow_step", global_step)
-                        (loss,) = self.train_batch(ins, labs)
+                        # the step-loop span: runs on the main thread,
+                        # overlapping prefetch.produce spans on the
+                        # producer track when prefetch= is on
+                        with _monitor.trace.span("fit.step",
+                                                 step=global_step):
+                            (loss,) = self.train_batch(ins, labs)
                     finally:
                         if wd_ctx is not None:
                             wd_ctx.__exit__(None, None, None)
@@ -337,6 +343,12 @@ class Model(Layer):
                 cblist.call("on_epoch_end", epoch, logs)
                 if self.stop_training:
                     break
+        except BaseException:
+            # unhandled crash in the train loop: leave a flight-recorder
+            # dump (last spans + counters + active HLO) then re-raise
+            if _monitor.enabled():
+                _monitor.trace.flight_record("fit_crash", step=global_step)
+            raise
         finally:
             if wd is not None:
                 wd.stop()

@@ -28,6 +28,7 @@ import jax
 
 from ..tensor import Tensor, Parameter
 from ..nn.layer import Layer
+from .. import monitor as _monitor
 from . import bucketing  # noqa: F401  (shape bucketing / pad-and-mask)
 from .bucketing import next_bucket, pad_to_bucket, batch_mask  # noqa: F401
 from .prefetch import prefetch_to_device  # noqa: F401
@@ -225,14 +226,15 @@ class CheckpointManager:
                 f.flush()
                 os.fsync(f.fileno())
 
-        _retry.retry_call(_write, label="ckpt_save")
-        digest = _sha256_file(tmp)
-        os.replace(tmp, path)
-        # sidecar lands AFTER the data: a crash in between leaves a
-        # checkpoint without a sidecar, which validation falls back to
-        # verifying by unpickling
-        with open(path + ".sha256", "w", encoding="utf-8") as f:
-            f.write(digest + "\n")
+        with _monitor.trace.span("checkpoint.save", step=step):
+            _retry.retry_call(_write, label="ckpt_save")
+            digest = _sha256_file(tmp)
+            os.replace(tmp, path)
+            # sidecar lands AFTER the data: a crash in between leaves a
+            # checkpoint without a sidecar, which validation falls back
+            # to verifying by unpickling
+            with open(path + ".sha256", "w", encoding="utf-8") as f:
+                f.write(digest + "\n")
         self._valid_cache.pop(step, None)
         self._gc()
 
@@ -332,8 +334,9 @@ class CheckpointManager:
                 self._quarantine(s, "failed validation during restore")
             if chosen is None:
                 return None
-        state = _retry.retry_call(
-            load, self._path(chosen), label="ckpt_load")
+        with _monitor.trace.span("checkpoint.restore", step=chosen):
+            state = _retry.retry_call(
+                load, self._path(chosen), label="ckpt_load")
         if model is not None and "model" in state:
             model.set_state_dict(state["model"])
         if optimizer is not None and "optimizer" in state:
@@ -581,9 +584,12 @@ class DataLoader:
             return self.collate_fn([self.dataset[i] for i in idx])
 
         if self._retry_policy is None:
-            return attempt()
-        return _retry.retry_call(attempt, policy=self._retry_policy,
-                                 label="dataloader")
+            with _monitor.trace.span("dataloader.assemble",
+                                     batch=batch_index):
+                return attempt()
+        with _monitor.trace.span("dataloader.assemble", batch=batch_index):
+            return _retry.retry_call(attempt, policy=self._retry_policy,
+                                     label="dataloader")
 
     def _produce(self, q, stop):
         try:

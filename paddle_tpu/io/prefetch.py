@@ -129,9 +129,12 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
                 try:
                     if _faults.enabled():
                         _faults.maybe_raise("loader", step=i)
-                    batch = next(it)
-                    placed = _place(batch, mesh, axis_name, sharding,
-                                    device)
+                    # the producer-thread span: its track overlapping the
+                    # main thread's step spans IS the pipelining evidence
+                    with _monitor.trace.span("prefetch.produce", batch=i):
+                        batch = next(it)
+                        placed = _place(batch, mesh, axis_name, sharding,
+                                        device)
                     delivered = True
                     break
                 except StopIteration:
@@ -157,8 +160,11 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
                         break  # drop this slot, move to the next batch
                     _record("retry", where="prefetch", step=i,
                             attempt=attempts, error=repr(e))
-                    if stop.wait(policy.delay(attempts - 1)):
-                        return
+                    with _monitor.trace.span("resilience.backoff",
+                                             where="prefetch",
+                                             attempt=attempts):
+                        if stop.wait(policy.delay(attempts - 1)):
+                            return
             i += 1
             if delivered and not _guarded_put(q, placed, stop):
                 return
@@ -169,7 +175,8 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
     try:
         while True:
             t0 = time.perf_counter()
-            item = q.get()
+            with _monitor.trace.span("prefetch.wait"):
+                item = q.get()
             if _monitor.enabled():
                 _monitor.counter("prefetch.stall_seconds").inc(
                     time.perf_counter() - t0)

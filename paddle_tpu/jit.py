@@ -214,21 +214,33 @@ class StaticFunction:
                 tuple(state_names), ast_on)
         key = base + (tuple((a.shape, str(a.dtype)) for a in arrays),)
 
+        fn_label = getattr(self, "__name__", "fn")
+        is_new = key not in self._cache
         if _monitor.enabled():
-            if key in self._cache:
+            if not is_new:
                 _monitor.counter("jit.cache_hit").inc()
             else:
                 _monitor.counter("jit.compile").inc()
                 if base in self._seen_base:
                     _monitor.counter("jit.recompile").inc()
-        if key not in self._cache:
+        if is_new:
             self._seen_base.add(base)
-            self._cache[key] = self._make_entry(treedef, arr_idx, statics,
-                                                state_names)
+            with _monitor.trace.span(f"jit.compile.{fn_label}"):
+                self._cache[key] = self._make_entry(treedef, arr_idx,
+                                                    statics, state_names)
         entry = self._cache[key]
 
         state_vals = [holders[n].data for n in state_names]
-        out_arrays, new_state = entry["jitted"](state_vals, arrays)
+        if is_new and _monitor.enabled():
+            # AOT the fresh entry (the compile the first call pays
+            # anyway) so monitor.xla records its measured flops/bytes;
+            # any failure keeps the original jitted callable
+            with _monitor.trace.span("jit.aot_capture", fn=fn_label):
+                entry["jitted"] = _monitor.xla.aot_capture(
+                    entry["jitted"], f"jit.{fn_label}",
+                    (state_vals, arrays))
+        with _monitor.trace.span(f"jit.{fn_label}"):
+            out_arrays, new_state = entry["jitted"](state_vals, arrays)
 
         for name, new in zip(state_names, new_state):
             holders[name].data = new
@@ -255,6 +267,7 @@ class StaticFunction:
 
     def _make_entry(self, treedef, arr_idx, statics, state_names):
         fn = self._fn
+        fn_scope = getattr(self, "__name__", None) or "to_static"
         models, optimizers = self._models, self._optimizers
         scalers = self._scalers or []
         meta = {}
@@ -273,7 +286,10 @@ class StaticFunction:
                 for name, v in zip(state_names, state_vals):
                     saved[name] = hs[name].data
                     hs[name].data = v
-                out = fn(*args, **kwargs)
+                # tag the whole step's HLO with the function name (shows
+                # up in XLA profiles / the flight recorder's HLO dump)
+                with jax.named_scope(fn_scope):
+                    out = fn(*args, **kwargs)
                 new_state = [hs[n].data for n in state_names]
                 # flatten outputs treating Tensors as leaves (don't let the
                 # pytree registration split them — we need to tag them)

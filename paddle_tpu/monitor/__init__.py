@@ -42,6 +42,17 @@ Resilience series (docs/robustness.md; ``paddle_tpu.resilience``):
   injections and batches dropped after retry exhaustion
   (``prefetch.drops`` counts the same at the prefetch site)
 
+Span tracing & XLA-measured cost (this PR's additions):
+
+* ``monitor.trace``  — thread-aware span tracer (``span()`` context
+  managers, ring buffer, Chrome-trace/Perfetto export, flight
+  recorder). ``PADDLE_TPU_TRACE=1`` arms it alongside ``enable()``.
+* ``monitor.xla``    — ``cost_analysis()``/``memory_analysis()`` of
+  compiled executables as ``xla.flops.<label>`` /
+  ``xla.bytes_accessed.<label>`` / ``xla.peak_memory.<label>`` gauges
+  plus ``xla_cost`` JSONL records; feeds the measured-MFU columns in
+  StepMonitor and bench.py.
+
 Everything funnels into one process-global :class:`Registry` and,
 when a sink is configured (``PADDLE_TPU_MONITOR_DIR`` or an explicit
 path to ``enable()``), a JSONL event stream.
@@ -78,7 +89,7 @@ __all__ = [
     "histogram", "emit", "snapshot", "reset", "jsonl_path",
     "record_collective", "StepMonitor", "mfu", "peak_flops_for_device",
     "transformer_train_flops_per_token", "device_memory_stats",
-    "read_jsonl",
+    "read_jsonl", "trace", "xla",
 ]
 
 _registry = Registry()
@@ -130,10 +141,16 @@ def enable(path=None, time_dispatch=None):
     if target:
         fp = _resolve_sink_path(target)
         if _sink is None or _sink.path != os.path.abspath(fp):
-            if _sink is not None:
-                _sink.close()
+            # close the previous sink BEFORE installing the new one — a
+            # re-enable with a new path must not leak the old file handle
+            old, _sink = _sink, None
+            if old is not None:
+                old.close()
             _sink = JsonlSink(fp)
     _enabled = True
+
+    if os.environ.get("PADDLE_TPU_TRACE", "") not in ("", "0"):
+        trace.enable()
 
     from .. import dispatch
     dispatch.install_monitor_hook(_dispatch_hook, time_ops=_time_dispatch)
@@ -179,6 +196,7 @@ def snapshot(prefix=""):
 
 def reset():
     _registry.reset()
+    xla.reset()
 
 
 def emit(kind="event", **fields):
@@ -195,8 +213,8 @@ def emit(kind="event", **fields):
 
 def _dispatch_hook(name, grad, t0, static=False):
     """Installed into paddle_tpu.dispatch while enabled. Must stay
-    allocation-light: two counter incs, plus one histogram observe when
-    host timing is on."""
+    allocation-light: two counter incs, plus one histogram observe (and
+    one trace event when span tracing is on) when host timing is on."""
     op = name or "anon"
     _registry.counter(f"dispatch.{op}").inc()
     if static:
@@ -204,8 +222,11 @@ def _dispatch_hook(name, grad, t0, static=False):
     elif grad:
         _registry.counter(f"dispatch.grad.{op}").inc()
     if t0 is not None:
-        _registry.histogram(f"dispatch_ms.{op}").observe(
-            (time.perf_counter() - t0) * 1e3)
+        t1 = time.perf_counter()
+        _registry.histogram(f"dispatch_ms.{op}").observe((t1 - t0) * 1e3)
+        # per-op timeline rides the same time_dispatch opt-in: the t0
+        # stamp already paid the clock read the span needs
+        trace.complete(f"dispatch.{op}", t0, t1)
 
 
 def record_collective(op, axis_name, nbytes):
@@ -217,4 +238,10 @@ def record_collective(op, axis_name, nbytes):
     axis = axis_name or "none"
     _registry.counter(f"collective.{op}.{axis}.calls").inc()
     _registry.counter(f"collective.{op}.{axis}.bytes").inc(int(nbytes))
+    trace.instant(f"collective.{op}", axis=axis, bytes=int(nbytes))
     emit(kind="collective", op=op, axis=axis, bytes=int(nbytes))
+
+
+# imported last: both submodules reach back into this namespace
+# (gauge/emit/snapshot), which is fully populated by this point
+from . import trace, xla  # noqa: E402,F401

@@ -156,8 +156,14 @@ class Registry:
         return self._metrics.get(name)
 
     def value(self, name, default=0):
+        """Current scalar for a counter/gauge; a histogram (which has no
+        single value) returns its snapshot dict. Missing -> default."""
         m = self._metrics.get(name)
-        return default if m is None else m.value
+        if m is None:
+            return default
+        if isinstance(m, Histogram):
+            return m.snapshot()
+        return m.value
 
     def names(self, prefix=""):
         with self._lock:
@@ -205,11 +211,20 @@ class JsonlSink:
 
 def read_jsonl(path):
     """Parse a sink file back into a list of dicts (the test/tooling
-    round-trip helper)."""
+    round-trip helper). A run killed mid-write leaves a truncated final
+    line — any unparseable line is skipped with a warning instead of
+    raising, so post-mortem tooling can always read what DID land."""
+    import warnings
     out = []
     with open(path, encoding="utf-8") as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, 1):
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError:
+                warnings.warn(
+                    f"read_jsonl: skipping unparseable line {lineno} of "
+                    f"{path} (truncated write from a killed run?)")
     return out

@@ -110,7 +110,8 @@ class StepMonitor:
 
     def __init__(self, items_per_step=None, flops_per_step=None,
                  peak_flops=None, item="items", label="train", window=1,
-                 memory_every=10):
+                 memory_every=10, measured_flops_per_step=None,
+                 xla_label=None):
         self.items_per_step = items_per_step
         self.flops_per_step = flops_per_step
         self.peak_flops = (peak_flops if peak_flops is not None
@@ -119,10 +120,16 @@ class StepMonitor:
         self.label = label
         self.window = max(1, int(window))
         self.memory_every = max(1, int(memory_every))
+        # XLA-measured flops: explicit value, or pulled per step from
+        # monitor.xla (xla_label=None means "most recently captured
+        # executable" — right for a loop driving one compiled step)
+        self.measured_flops_per_step = measured_flops_per_step
+        self.xla_label = xla_label
         self.steps = 0
         self.total_time = 0.0
         self.records = []
         self._last = None
+        self._divergence_warned = False
 
     def __enter__(self):
         self.start()
@@ -156,6 +163,30 @@ class StepMonitor:
                f"{self.item}_per_sec": round(rate, 2) if rate else None,
                "items_per_sec": round(rate, 2) if rate else None,
                "mfu": round(step_mfu, 4) if step_mfu is not None else None}
+        measured = self._measured_flops()
+        mfu_measured = None
+        if measured:
+            mfu_measured = mfu(measured, dt, self.peak_flops)
+            if mfu_measured is not None:
+                rec["mfu_measured"] = round(mfu_measured, 4)
+            if self.flops_per_step:
+                ratio = measured / self.flops_per_step
+                if abs(ratio - 1.0) > 0.2:
+                    # the analytic convention and XLA's count disagree
+                    # by >20% — one of them is lying; say so once
+                    rec["flops_measured_ratio"] = round(ratio, 3)
+                    if not self._divergence_warned:
+                        self._divergence_warned = True
+                        import warnings
+                        warnings.warn(
+                            f"StepMonitor[{self.label}]: XLA-measured "
+                            f"flops/step ({measured:.3e}) diverges "
+                            f"{(ratio - 1.0):+.0%} from the analytic "
+                            f"figure ({self.flops_per_step:.3e}); the "
+                            f"reported mfu uses the analytic number")
+                        if enabled():
+                            from . import counter
+                            counter("xla.mfu_divergence").inc()
         if loss is not None:
             try:
                 rec["loss"] = float(loss.numpy() if hasattr(loss, "numpy")
@@ -174,9 +205,19 @@ class StepMonitor:
                 gauge(f"step.{self.label}.items_per_sec").set(rate)
             if step_mfu is not None:
                 gauge(f"step.{self.label}.mfu").set(step_mfu)
+            if mfu_measured is not None:
+                gauge(f"step.{self.label}.mfu_measured").set(mfu_measured)
             if self.steps % self.window == 0:
                 emit(**rec)
         return rec
+
+    def _measured_flops(self):
+        """XLA-counted flops/step: the explicit override, else the
+        monitor.xla capture for xla_label (None -> newest)."""
+        if self.measured_flops_per_step is not None:
+            return self.measured_flops_per_step
+        from . import xla as _xla
+        return _xla.flops(self.xla_label)
 
     # -- summary ------------------------------------------------------------
     def summary(self):
@@ -185,7 +226,7 @@ class StepMonitor:
         avg_dt = self.total_time / self.steps
         rate = (self.items_per_step / avg_dt
                 if self.items_per_step and avg_dt > 0 else None)
-        return {
+        out = {
             "label": self.label, "steps": self.steps,
             "avg_step_time_s": round(avg_dt, 6),
             f"{self.item}_per_sec": round(rate, 2) if rate else None,
@@ -195,6 +236,13 @@ class StepMonitor:
                            self.peak_flops) is not None else None),
             "peak_flops_ceiling": self.peak_flops,
         }
+        measured = self._measured_flops()
+        if measured:
+            m = mfu(measured, avg_dt, self.peak_flops)
+            if m is not None:
+                out["mfu_measured"] = round(m, 4)
+            out["flops_per_step_measured"] = measured
+        return out
 
     def report(self, print_table=True):
         """Print the summary table and emit it (plus a full counters
@@ -207,6 +255,9 @@ class StepMonitor:
                     (f"{self.item}/sec", f"{rate:,.1f}" if rate else "n/a"),
                     ("mfu", f"{s['mfu']:.1%}" if s["mfu"] is not None
                      else "n/a (no flops ceiling)")]
+            if s.get("mfu_measured") is not None:
+                rows.append(("mfu (xla-measured)",
+                             f"{s['mfu_measured']:.1%}"))
             width = max(len(k) for k, _ in rows)
             print(f"[paddle_tpu.monitor] {self.label}")
             for k, v in rows:

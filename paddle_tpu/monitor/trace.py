@@ -1,0 +1,378 @@
+"""paddle_tpu.monitor.trace — thread-aware span tracing + flight recorder.
+
+The reference stack answered "where did the step's time go" with a
+per-op CUDA timeline (reference: paddle/fluid/platform/profiler.cc,
+device_tracer.cc, exported through chrome://tracing). This is the TPU
+rebuild's equivalent: nested ``span("name")`` context managers record
+begin/end events into a bounded ring buffer, one logical track per
+thread, and :func:`export_chrome_trace` writes Chrome trace-event JSON
+that Perfetto / chrome://tracing loads directly — the prefetch producer
+thread, the host step loop and the watchdog each get their own track,
+so pipeline overlap is *observed*, not inferred from counters.
+
+Cost discipline (same contract as the dispatch hook): when tracing is
+disabled — the default — ``span()`` does ONE module-flag check and
+returns a shared null context manager; no event tuple, no clock read,
+no dict. Enabling costs one ``perf_counter()`` + one deque append per
+span edge (appends on ``collections.deque`` are atomic in CPython, so
+producer threads never contend on a lock).
+
+Usage::
+
+    from paddle_tpu.monitor import trace
+
+    trace.enable()                       # or PADDLE_TPU_TRACE=1
+    with trace.span("epoch", epoch=0):
+        ...
+    trace.export_chrome_trace("/tmp/run.trace.json")   # open in Perfetto
+
+Span sites wired by this package: ``Executor.run`` phases
+(feed_prep/compile/execute/fetch), ``jit.<fn>`` compiled-step calls,
+``prefetch.produce`` producer iterations, ``dataloader.assemble``,
+``optimizer.step``, ``checkpoint.save``/``restore``,
+``resilience.backoff`` waits, ``fit.step``; ``dispatch.<op>`` complete
+events ride the existing ``time_dispatch`` opt-in, and collectives
+appear as instant events. With ``bridge=True`` (or
+``PADDLE_TPU_TRACE_BRIDGE=1``) each span additionally enters a
+``jax.profiler.TraceAnnotation`` so the same names show up inside a
+captured XLA device trace.
+
+The flight recorder (:func:`flight_record`) turns "it hung at step
+4017" into an artifact: on a watchdog stall, a NaN-guard rollback or an
+unhandled crash in ``fit``/``Executor.run`` it dumps the last buffered
+spans (as a loadable Chrome trace), the full counter snapshot, and the
+HLO text of the most recently captured executable (monitor.xla) into a
+timestamped directory.
+"""
+from __future__ import annotations
+
+import collections
+import functools
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+
+__all__ = [
+    "enable", "disable", "enabled", "clear", "span", "complete",
+    "instant", "traced", "events", "export_chrome_trace",
+    "flight_record",
+]
+
+DEFAULT_BUFFER = 65536
+
+_CLOCK = time.perf_counter
+
+_active = False
+_bridge = False
+_events = collections.deque(maxlen=DEFAULT_BUFFER)
+_thread_names = {}          # thread ident -> name (first event wins)
+_t0 = 0.0                   # perf_counter origin for export timestamps
+_wall0 = 0.0                # wall clock at enable (for correlation)
+_flight_lock = threading.Lock()
+_flight_dumps = 0
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+
+def enabled():
+    return _active
+
+
+def enable(buffer_size=None, bridge=None):
+    """Turn span recording on. ``buffer_size`` resizes the ring buffer
+    (default 65536 events ≈ 32k spans — old events fall off the front);
+    ``bridge=True`` additionally enters a jax.profiler.TraceAnnotation
+    per span (``PADDLE_TPU_TRACE_BRIDGE=1``). Idempotent."""
+    global _active, _bridge, _events, _t0, _wall0
+    if buffer_size:
+        _events = collections.deque(_events, maxlen=int(buffer_size))
+    if bridge is None:
+        bridge = os.environ.get(
+            "PADDLE_TPU_TRACE_BRIDGE", "") not in ("", "0")
+    _bridge = bool(bridge)
+    if not _active:
+        _t0 = _CLOCK()
+        _wall0 = time.time()
+        _active = True
+    _note_thread(threading.get_ident())
+
+
+def disable():
+    """Stop recording. The buffer is KEPT so a post-run
+    export_chrome_trace() still works; clear() empties it."""
+    global _active
+    _active = False
+
+
+def clear():
+    global _flight_dumps
+    _events.clear()
+    _thread_names.clear()
+    _flight_dumps = 0
+
+
+def _note_thread(tid):
+    if tid not in _thread_names:
+        _thread_names[tid] = threading.current_thread().name
+
+
+# ---------------------------------------------------------------------------
+# recording
+
+class _NullSpan:
+    """The shared disabled-mode context manager: nothing allocated,
+    nothing recorded."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+def _annotation(name):
+    import jax.profiler
+    return jax.profiler.TraceAnnotation(name)
+
+
+class _Span:
+    __slots__ = ("name", "args", "_ann")
+
+    def __init__(self, name, args):
+        self.name = name
+        self.args = args
+        self._ann = None
+
+    def __enter__(self):
+        tid = threading.get_ident()
+        if tid not in _thread_names:
+            _note_thread(tid)
+        _events.append(("B", self.name, tid, _CLOCK(), self.args))
+        if _bridge:
+            try:
+                self._ann = _annotation(self.name)
+                self._ann.__enter__()
+            except Exception:
+                self._ann = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(*exc)
+            except Exception:
+                pass
+            self._ann = None
+        _events.append(("E", self.name, threading.get_ident(), _CLOCK()))
+        return False
+
+
+def span(name, **args):
+    """``with trace.span("executor.execute", step=i): ...`` — records a
+    begin/end event pair on the calling thread's track. Disabled mode
+    returns the shared null context manager after one flag check."""
+    if not _active:
+        return _NULL
+    return _Span(name, args or None)
+
+
+def complete(name, t0, t1=None, **args):
+    """Record an already-timed interval (the dispatch hook's path: t0
+    was stamped by the time_dispatch machinery, so the span costs no
+    extra clock read at the start)."""
+    if not _active:
+        return
+    t1 = _CLOCK() if t1 is None else t1
+    tid = threading.get_ident()
+    if tid not in _thread_names:
+        _note_thread(tid)
+    _events.append(("X", name, tid, t0, t1 - t0, args or None))
+
+
+def instant(name, **args):
+    """A zero-duration marker (collective issue sites, fault firings)."""
+    if not _active:
+        return
+    tid = threading.get_ident()
+    if tid not in _thread_names:
+        _note_thread(tid)
+    _events.append(("I", name, tid, _CLOCK(), args or None))
+
+
+def traced(name=None):
+    """Decorator form: ``@trace.traced`` or ``@trace.traced("label")``.
+    Disabled mode adds one flag check per call."""
+    def deco(fn):
+        label = name if isinstance(name, str) else \
+            getattr(fn, "__qualname__", getattr(fn, "__name__", "fn"))
+
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            if not _active:
+                return fn(*a, **k)
+            with _Span(label, None):
+                return fn(*a, **k)
+        return wrapped
+    if callable(name):       # bare @traced
+        return deco(name)
+    return deco
+
+
+def events(last=None):
+    """Snapshot of the ring buffer (tuples; newest last). ``last=N``
+    returns only the trailing N events."""
+    evs = list(_events)
+    return evs[-int(last):] if last else evs
+
+
+# ---------------------------------------------------------------------------
+# export
+
+def _us(t):
+    return round((t - _t0) * 1e6, 3)
+
+
+def export_chrome_trace(path=None, last=None):
+    """Render the buffer as Chrome trace-event JSON (the "JSON Array
+    Format" with metadata): one ``pid`` per process, one ``tid`` track
+    per thread (named via ``thread_name`` metadata events), ``B``/``E``
+    pairs for spans, ``X`` complete events for pre-timed intervals
+    (dispatch ops), ``i`` instants for markers. Load the file in
+    https://ui.perfetto.dev or chrome://tracing.
+
+    ``path=None`` returns the dict; a directory gets a
+    ``trace-<pid>.json`` inside; any other path is written verbatim.
+    Returns the written path (or the dict)."""
+    pid = os.getpid()
+    out = [{"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": f"paddle_tpu[{pid}]"}}]
+    for tid, tname in sorted(_thread_names.items()):
+        out.append({"ph": "M", "pid": pid, "tid": tid,
+                    "name": "thread_name", "args": {"name": tname}})
+    for ev in events(last=last):
+        kind = ev[0]
+        if kind == "B":
+            _, name, tid, t, args = ev
+            rec = {"ph": "B", "pid": pid, "tid": tid, "name": name,
+                   "ts": _us(t), "cat": "span"}
+        elif kind == "E":
+            _, name, tid, t = ev
+            rec = {"ph": "E", "pid": pid, "tid": tid, "name": name,
+                   "ts": _us(t), "cat": "span"}
+            args = None
+        elif kind == "X":
+            _, name, tid, t, dur, args = ev
+            rec = {"ph": "X", "pid": pid, "tid": tid, "name": name,
+                   "ts": _us(t), "dur": round(max(0.0, dur) * 1e6, 3),
+                   "cat": "op"}
+        else:
+            _, name, tid, t, args = ev
+            rec = {"ph": "i", "pid": pid, "tid": tid, "name": name,
+                   "ts": _us(t), "s": "t", "cat": "marker"}
+        if args:
+            rec["args"] = args
+        out.append(rec)
+    doc = {"traceEvents": out, "displayTimeUnit": "ms",
+           "otherData": {"epoch_wall_s": _wall0, "pid": pid}}
+    if path is None:
+        return doc
+    p = str(path)
+    if not p.endswith(".json"):
+        os.makedirs(p, exist_ok=True)
+        p = os.path.join(p, f"trace-{pid}.json")
+    else:
+        parent = os.path.dirname(os.path.abspath(p))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+    with open(p, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, default=str)
+    return os.path.abspath(p)
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+
+def flight_record(reason, step=None, directory=None, extra=None):
+    """Dump post-mortem evidence to a timestamped directory and return
+    its path (None when rate-capped or anything fails — the recorder
+    must never add a second crash on top of the first).
+
+    Layout::
+
+        <base>/<stamp>-<reason>-<pid>-<n>/
+            meta.json       reason / step / pid / sink path / extra
+            counters.json   full registry snapshot
+            trace.json      the span ring buffer as a Chrome trace
+            hlo-<label>.txt HLO of the last captured executable (if any)
+
+    ``base`` is ``directory=``, else $PADDLE_TPU_FLIGHT_DIR, else a
+    ``flight/`` sibling of the monitor JSONL sink, else the system temp
+    dir. At most $PADDLE_TPU_FLIGHT_MAX (default 8) dumps per process —
+    a crash loop leaves evidence, not a full disk. Triggered by the
+    resilience watchdog (stall), NaNGuard (rollback), and the crash
+    handlers in ``hapi.Model.fit`` / ``Executor.run``."""
+    global _flight_dumps
+    try:
+        from . import emit as _memit
+        from . import jsonl_path as _mpath
+        from . import snapshot as _msnap
+        try:
+            cap = int(os.environ.get("PADDLE_TPU_FLIGHT_MAX", "8") or 8)
+        except ValueError:
+            cap = 8
+        with _flight_lock:
+            if _flight_dumps >= cap:
+                return None
+            _flight_dumps += 1
+            n = _flight_dumps
+        base = directory or os.environ.get("PADDLE_TPU_FLIGHT_DIR")
+        if not base:
+            jp = _mpath()
+            base = (os.path.join(os.path.dirname(jp), "flight") if jp
+                    else os.path.join(tempfile.gettempdir(),
+                                      "paddle_tpu_flight"))
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        safe_reason = re.sub(r"[^A-Za-z0-9_.-]+", "_", str(reason))
+        d = os.path.join(base, f"{stamp}-{safe_reason}-{os.getpid()}-{n}")
+        os.makedirs(d, exist_ok=True)
+
+        meta = {"reason": str(reason), "step": step, "ts": time.time(),
+                "pid": os.getpid(), "jsonl": _mpath(),
+                "trace_enabled": _active, "events_buffered": len(_events)}
+        if extra:
+            meta["extra"] = {str(k): v for k, v in dict(extra).items()}
+        with open(os.path.join(d, "meta.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(meta, fh, default=str, indent=1)
+        with open(os.path.join(d, "counters.json"), "w",
+                  encoding="utf-8") as fh:
+            json.dump(_msnap(), fh, default=str, indent=1)
+        export_chrome_trace(os.path.join(d, "trace.json"))
+
+        try:
+            from . import xla as _xla
+            hlo = _xla.hlo_text()
+            if hlo:
+                last = _xla.last()
+                label = re.sub(r"[^A-Za-z0-9_.-]+", "_",
+                               last[0] if last else "executable")
+                with open(os.path.join(d, f"hlo-{label}.txt"), "w",
+                          encoding="utf-8") as fh:
+                    fh.write(hlo)
+        except Exception:
+            pass
+
+        _memit(kind="flight_record", reason=str(reason), step=step,
+               path=d)
+        return d
+    except Exception:
+        return None

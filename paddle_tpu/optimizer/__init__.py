@@ -119,6 +119,11 @@ class Optimizer:
         minimize path in optimizer.py:Optimizer.apply_gradients)."""
         if _monitor.enabled():
             _monitor.counter(f"optimizer.step.{type(self).__name__}").inc()
+        with _monitor.trace.span("optimizer.step",
+                                 cls=type(self).__name__):
+            self._step_body()
+
+    def _step_body(self):
         if self._lr_decay is not None:
             # host-side schedule: advance + refresh the device lr tensor
             # (under jit the tensor is input state, so no retrace)

@@ -128,10 +128,13 @@ def _maybe(axis_name):
 
 def _account(op, x, axis_name):
     """Monitor accounting for one issued collective: op count + payload
-    bytes by mesh axis. Runs AFTER the SPMD gate, so eager identity
-    fallbacks don't count. Shapes are static under shard_map tracing, so
-    this works on tracers; bytes are the per-shard payload, and inside a
-    jitted region the record is per trace, not per device execution."""
+    bytes by mesh axis, plus a ``collective.<op>`` instant marker on the
+    monitor.trace timeline (so collective issue sites line up against
+    the executor/step spans in the Perfetto export). Runs AFTER the
+    SPMD gate, so eager identity fallbacks don't count. Shapes are
+    static under shard_map tracing, so this works on tracers; bytes are
+    the per-shard payload, and inside a jitted region the record is per
+    trace, not per device execution."""
     if not _monitor.enabled():
         return
     a = x.data if isinstance(x, Tensor) else x

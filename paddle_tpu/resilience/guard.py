@@ -148,6 +148,13 @@ class NaNGuard:
                 raise ValueError(
                     "NaNGuard(policy='rollback_to_last_ckpt') needs a "
                     "checkpoint_manager")
+            # evidence BEFORE the restore overwrites live state: which
+            # spans/counters led into the poisoned step
+            from .. import monitor as _monitor
+            if _monitor.enabled():
+                _monitor.trace.flight_record(
+                    "nan_rollback", step=step,
+                    extra={"where": where, "value": value})
             state = self.checkpoint_manager.restore(
                 model=model, optimizer=optimizer, program=program)
             record("rollback", step=step,

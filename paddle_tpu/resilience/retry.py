@@ -110,7 +110,12 @@ def retry_call(fn, *args, policy=None, label="", on_retry=None, **kwargs):
                    attempt=attempt + 1, error=repr(e))
             if on_retry is not None:
                 on_retry(e, attempt)
-            time.sleep(policy.delay(attempt))
+            from .. import monitor as _monitor
+            with _monitor.trace.span(
+                    "resilience.backoff",
+                    where=label or getattr(fn, "__name__", "call"),
+                    attempt=attempt + 1):
+                time.sleep(policy.delay(attempt))
     raise RetryExhausted(
         f"{label or getattr(fn, '__name__', 'call')}: "
         f"{policy.max_attempts} attempts exhausted (last: {last!r})"

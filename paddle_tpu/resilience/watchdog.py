@@ -130,9 +130,16 @@ class Watchdog:
                 record("watchdog_stall", step=step_id, elapsed=elapsed,
                        deadline=deadline)
                 if _monitor.enabled():
-                    # the post-mortem payload: everything the run was doing
+                    # the post-mortem payload: everything the run was
+                    # doing — counters inline, plus a flight-recorder
+                    # directory (spans + counters + active HLO) whose
+                    # path rides in the same JSONL record
+                    flight = _monitor.trace.flight_record(
+                        "watchdog_stall", step=step_id,
+                        extra={"elapsed": elapsed, "deadline": deadline})
                     _monitor.emit(kind="watchdog_dump", step=step_id,
                                   elapsed=elapsed, deadline=deadline,
+                                  flight_dir=flight,
                                   counters=_monitor.snapshot())
                 if self.on_stall is not None:
                     try:

@@ -412,6 +412,20 @@ class Executor:
             return_numpy=True, scope=None, bucket=False, buckets=None,
             pad_mode="repeat", async_fetch=False, fetch_period=None,
             nan_guard=None):
+        try:
+            return self._run_impl(program, feed, fetch_list, return_numpy,
+                                  scope, bucket, buckets, pad_mode,
+                                  async_fetch, fetch_period, nan_guard)
+        except BaseException:
+            # unhandled crash: leave the flight-recorder artifact (last
+            # spans + counters + active HLO) before the stack unwinds
+            if _monitor.enabled():
+                _monitor.trace.flight_record("executor_crash")
+            raise
+
+    def _run_impl(self, program, feed, fetch_list, return_numpy, scope,
+                  bucket, buckets, pad_mode, async_fetch, fetch_period,
+                  nan_guard):
         program = program or default_main_program()
         if isinstance(nan_guard, str):
             from ..resilience.guard import NaNGuard
@@ -435,6 +449,8 @@ class Executor:
         # come straight from the numpy/jax arrays — no jnp.asarray (and
         # its device transfer) before we know whether this is a cache
         # hit. jit/device_put convert on the way in exactly once.
+        feed_span = _monitor.trace.span("executor.feed_prep")
+        feed_span.__enter__()
         feed_arrays = {}
         for k, v in feed.items():
             if isinstance(v, Tensor):
@@ -482,6 +498,8 @@ class Executor:
                 if cur != rep:
                     holder.data = jax.device_put(holder.data, rep)
 
+        feed_span.__exit__(None, None, None)
+
         param_names, opt_entries, slot_names = \
             self._param_slot_names(program)
 
@@ -498,12 +516,16 @@ class Executor:
                 # same program+fetches+mesh, new feed shapes: the
                 # avoidable-recompile series bucketing exists to flatten
                 _monitor.counter("executor.recompile").inc()
-        if key not in self._cache:
+        new_key = key not in self._cache
+        if new_key:
             self._seen_base.add(base_key)
-            self._cache[key] = self._compile(program, fetch_names,
-                                             sorted(feed_arrays),
-                                             param_names, slot_names,
-                                             nan_guard=nan_guard is not None)
+            with _monitor.trace.span("executor.compile",
+                                     program=program.id,
+                                     version=program.version):
+                self._cache[key] = self._compile(
+                    program, fetch_names, sorted(feed_arrays),
+                    param_names, slot_names,
+                    nan_guard=nan_guard is not None)
         compiled = self._cache[key]
 
         param_vals = [program.param_vars[n].data for n in param_names]
@@ -516,14 +538,26 @@ class Executor:
         rng_vals = (list(prandom.split_keys(len(program.rng_vars)))
                     if program.rng_vars else [])
 
+        if new_key and _monitor.enabled():
+            # the first call pays the XLA compile either way; doing it
+            # AOT (lower+compile) yields a Compiled whose
+            # cost_analysis()/memory_analysis() feed the xla.* gauges
+            # and the flight recorder's HLO dump. Falls back to the
+            # jitted entry untouched if anything goes wrong.
+            with _monitor.trace.span("executor.aot_capture"):
+                compiled = self._cache[key] = _monitor.xla.aot_capture(
+                    compiled, f"exec.p{program.id}v{program.version}",
+                    (feed_vals, param_vals, slot_vals, lr_vals, rng_vals))
+
         finite_flag = None
-        if nan_guard is not None:
-            fetches, new_params, new_slots, finite_flag = compiled(
-                feed_vals, param_vals, slot_vals, lr_vals, rng_vals)
-        else:
-            fetches, new_params, new_slots = compiled(feed_vals, param_vals,
-                                                      slot_vals, lr_vals,
-                                                      rng_vals)
+        with _monitor.trace.span("executor.execute",
+                                 program=program.id):
+            if nan_guard is not None:
+                fetches, new_params, new_slots, finite_flag = compiled(
+                    feed_vals, param_vals, slot_vals, lr_vals, rng_vals)
+            else:
+                fetches, new_params, new_slots = compiled(
+                    feed_vals, param_vals, slot_vals, lr_vals, rng_vals)
 
         for n, v in zip(param_names, new_params):
             program.param_vars[n].data = v
@@ -555,14 +589,16 @@ class Executor:
                 if _monitor.enabled():
                     _monitor.counter("executor.fetch_skipped").inc()
                 return None
-            return self._materialize(prev)
+            with _monitor.trace.span("executor.fetch", mode="async"):
+                return self._materialize(prev)
 
         if _monitor.enabled() and return_numpy and fetches:
             # the blocking device_get this sits in is exactly what
             # async_fetch removes from the per-step path
             _monitor.counter("executor.fetch_blocking").inc()
-        return self._materialize((fetches, real_n, padded_n,
-                                  return_numpy))
+        with _monitor.trace.span("executor.fetch", mode="sync"):
+            return self._materialize((fetches, real_n, padded_n,
+                                      return_numpy))
 
     @staticmethod
     def _materialize(pending):
@@ -774,6 +810,8 @@ class Executor:
         self._cache[key] = compiled
         if _monitor.enabled():
             _monitor.counter("executor.aot_warmup").inc()
+            _monitor.xla.capture(
+                f"exec.p{program.id}v{program.version}", compiled)
         return key
 
     def _compile(self, program, fetch_names, feed_order, param_names,
@@ -793,7 +831,10 @@ class Executor:
         def interpret(env):
             for op in ops:
                 ins = [env[n] for n in op.inputs]
-                outs = op.impl(*ins, **op.attrs)
+                # named_scope tags the lowered HLO ops with the graph op
+                # type, so an XLA profile/HLO dump reads as the Program
+                with jax.named_scope(op.type or "op"):
+                    outs = op.impl(*ins, **op.attrs)
                 if isinstance(outs, (tuple, list)):
                     for n, o in zip(op.outputs, outs):
                         env[n] = o

@@ -24,6 +24,14 @@ python -m pytest tests/ -q -m "" \
     "$@"
 rc=$?
 
+# span-tracer gate: Perfetto-valid export, separate producer/step
+# tracks, overlapping prefetch/step spans, disabled mode records nothing
+echo ""
+echo "-- trace smoke gate --"
+bash scripts/trace_smoke.sh "$MONITOR_DIR/trace_smoke"
+trc=$?
+[ $trc -ne 0 ] && rc=$((rc == 0 ? trc : rc))
+
 latest=$(ls -t "$MONITOR_DIR"/events-*.jsonl 2>/dev/null | head -1)
 echo ""
 echo "monitor JSONL: ${latest:-<none written>} (dir: $MONITOR_DIR)"

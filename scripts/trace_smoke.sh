@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# CI gate for the span tracer: a short traced hapi fit must export a
+# Perfetto-loadable Chrome trace with the prefetch producer and the
+# step loop on separate thread tracks, at least one overlapping
+# prefetch.produce/fit.step span pair, and a disabled-mode tracer that
+# records nothing. Tier-1-safe: tiny MLP, CPU backend, seconds.
+#
+# Usage: scripts/trace_smoke.sh [out_dir]
+# trace.json + the monitor JSONL land in out_dir (default
+# /tmp/paddle_tpu_trace_smoke) as CI artifacts; the last stdout line is
+# one JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_trace_smoke}"
+JAX_PLATFORMS=cpu python scripts/trace_smoke.py --out-dir "$OUT_DIR"

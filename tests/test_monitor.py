@@ -104,6 +104,39 @@ def test_jsonl_sink_round_trip(tmp_path):
     assert recs[1]["v"] == [1, 2]
 
 
+def test_read_jsonl_skips_truncated_final_line(tmp_path):
+    """A run killed mid-write leaves a torn last line; reading the
+    stream back must keep every complete record and warn, not raise."""
+    path = tmp_path / "ev.jsonl"
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write(json.dumps({"kind": "a", "v": 1}) + "\n")
+        fh.write(json.dumps({"kind": "b", "v": 2}) + "\n")
+        fh.write('{"kind": "c", "v"')       # killed mid-write
+    with pytest.warns(UserWarning, match="line 3"):
+        recs = read_jsonl(str(path))
+    assert [r["kind"] for r in recs] == ["a", "b"]
+
+
+def test_registry_value_counter_gauge_histogram_matrix():
+    """Registry.value must answer for every metric kind: scalar for
+    counter/gauge, snapshot dict for histogram (which has no single
+    value), default for a missing name."""
+    r = Registry()
+    r.counter("c").inc(5)
+    r.gauge("g").set(2.5)
+    h = r.histogram("h")
+    h.observe(1.0)
+    h.observe(3.0)
+    assert r.value("c") == 5
+    assert r.value("g") == 2.5
+    hv = r.value("h")
+    assert isinstance(hv, dict)
+    assert hv["count"] == 2 and hv["sum"] == pytest.approx(4.0)
+    assert hv["min"] == 1.0 and hv["max"] == 3.0
+    assert r.value("missing") == 0
+    assert r.value("missing", default=None) is None
+
+
 # -- dispatch hook ------------------------------------------------------------
 
 def test_dispatch_counts_known_op_sequence(mon):
@@ -152,6 +185,25 @@ def test_enable_disable_installs_and_removes_hook(tmp_path):
     assert dispatch._monitor_hook is not None
     monitor.disable()
     assert dispatch._monitor_hook is None
+
+
+def test_enable_twice_closes_previous_sink(tmp_path):
+    """Re-enabling with a new path must close the old sink's file
+    handle (the leak: N enables -> N open fds) and route subsequent
+    events to the new file only."""
+    import paddle_tpu.monitor as M
+    p1 = monitor.enable(str(tmp_path / "one.jsonl"))
+    first_sink = M._sink
+    assert first_sink is not None and first_sink._fh is not None
+    p2 = monitor.enable(str(tmp_path / "two.jsonl"))
+    assert p1 != p2
+    assert first_sink._fh is None          # old handle closed
+    monitor.emit(kind="after_switch")
+    monitor.disable(flush_counters=False)
+    assert not any(r.get("kind") == "after_switch"
+                   for r in read_jsonl(p1))
+    assert any(r.get("kind") == "after_switch"
+               for r in read_jsonl(p2))
 
 
 # -- collectives --------------------------------------------------------------
@@ -304,6 +356,38 @@ def test_step_monitor_mfu_math():
     assert monitor.mfu(100e12, 1.0, peak_flops=None) is None
     assert monitor.transformer_train_flops_per_token(110e6) == \
         pytest.approx(6.6e8)
+
+
+class _FakeDevice:
+    def __init__(self, kind):
+        self.device_kind = kind
+
+
+@pytest.mark.parametrize("kind,peak", [
+    ("TPU v5 lite", 197e12),     # must NOT match the "TPU v5p" entry
+    ("TPU v5e", 197e12),
+    ("TPU v5p", 459e12),
+    ("TPU v4", 275e12),
+    ("TPU v6e", 918e12),
+    ("TPU v2", 46e12),
+    ("NVIDIA A100", None),       # unknown kind -> None, never invented
+    ("", None),
+])
+def test_peak_flops_device_kind_substring_ordering(kind, peak, monkeypatch):
+    """The table is substring-matched in order: 'TPU v5 lite' and
+    'TPU v5e' are distinct spellings of the same 197e12 chip and neither
+    may fall through to the v5p row."""
+    monkeypatch.delenv("PADDLE_TPU_FLOPS_CEILING", raising=False)
+    assert monitor.peak_flops_for_device(_FakeDevice(kind)) == peak
+
+
+def test_peak_flops_ceiling_env_override(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLOPS_CEILING", "123e9")
+    assert monitor.peak_flops_for_device(_FakeDevice("TPU v4")) == 123e9
+    # empty string is "unset", not a parse error: table takes over
+    monkeypatch.setenv("PADDLE_TPU_FLOPS_CEILING", "")
+    assert monitor.peak_flops_for_device(_FakeDevice("TPU v4")) == 275e12
+    assert monitor.peak_flops_for_device(_FakeDevice("mystery")) is None
 
 
 def test_toy_training_loop_jsonl_stream(tmp_path, mesh8):
