@@ -316,6 +316,63 @@ def bench_bert_seq512(batch=16, seq=512, steps=16, inner=4):
                       measured_key="bert_seq512_mfu_measured")
 
 
+def bench_serving(requests=400, clients=8, max_batch=32,
+                  timeout_ms=2.0, dim=256):
+    """Online-serving stage: the latency/QPS face of the ledger, next
+    to training MFU. A warmed ServingEngine over a (dim -> 4*dim ->
+    dim) MLP absorbs ragged concurrent requests (sizes 1/3/7/13) from
+    `clients` threads; dynamic batching coalesces them into bucket
+    shapes, so the numbers measure the serving tier itself, not a
+    compile storm. Returns (p50_ms, p99_ms, qps, mean_batch_fill)."""
+    import threading
+    import paddle_tpu as pt
+    from paddle_tpu import inference, monitor, nn, serving
+
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(dim, 4 * dim), nn.ReLU(),
+                          nn.Linear(4 * dim, dim))
+    eng = serving.ServingEngine(
+        inference.Predictor(model), buckets=[8, max_batch],
+        max_batch=max_batch, timeout_ms=timeout_ms, queue_depth=2048)
+    eng.warmup([((dim,), "float32")])
+
+    sizes = [1, 3, 7, 13]
+    per_client = requests // clients
+    latencies = []
+    lock = threading.Lock()
+    barrier = threading.Barrier(clients)
+
+    def client(k):
+        rng = np.random.RandomState(k)
+        barrier.wait()
+        for i in range(per_client):
+            x = rng.rand(sizes[(k + i) % len(sizes)], dim).astype("f4")
+            t0 = time.perf_counter()
+            eng.run(x, timeout=60)
+            with lock:
+                latencies.append((time.perf_counter() - t0) * 1e3)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(clients)]
+    t_start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_start
+    eng.close()
+
+    fill = monitor.registry().value("serving.batch_fill") or {}
+    mean_fill = (fill.get("sum", 0.0) / fill["count"]) \
+        if isinstance(fill, dict) and fill.get("count") else 0.0
+    lat = sorted(latencies)
+
+    def pct(p):
+        return lat[min(int(len(lat) * p), len(lat) - 1)] if lat else 0.0
+
+    return pct(0.50), pct(0.99), len(lat) / wall, mean_fill
+
+
 _RESULTS = {}  # metrics banked as each stage finishes (partial-credit)
 
 
@@ -553,7 +610,9 @@ def _record_stage_compiles(stage):
         from paddle_tpu import monitor
         reg = monitor.registry()
         total = int(reg.value("jit.compile", 0)) + \
-            int(reg.value("executor.compile", 0))
+            int(reg.value("executor.compile", 0)) + \
+            int(reg.value("inference.compile", 0)) + \
+            int(reg.value("inference.aot_warmup", 0))
     except Exception:
         return
     delta, _COMPILES_SEEN["n"] = total - _COMPILES_SEEN["n"], total
@@ -596,6 +655,19 @@ def main():
         resnet50_loss=round(rn_loss, 4),
         resnet50_mfu=_mfu(rn_ips, _mon.RESNET50_TRAIN_FLOPS_PER_IMAGE))
     _note_mfu_divergence("resnet50")
+    try:
+        s50, s99, sqps, sfill = bench_serving()
+    except Exception as e:
+        print(f"serving bench failed: {type(e).__name__}: {e}",
+              flush=True)
+    else:
+        print(f"partial serving_qps={sqps:.1f} p99_ms={s99:.2f}",
+              flush=True)
+        _RESULTS.update(serving_p50_ms=round(s50, 3),
+                        serving_p99_ms=round(s99, 3),
+                        serving_qps=round(sqps, 1),
+                        serving_batch_fill=round(sfill, 2))
+    _record_stage_compiles("serving")
     if not args.fast:
         try:
             pipe_ips, loader_ips = bench_resnet_pipeline()

@@ -58,6 +58,7 @@ from . import fleet
 from . import dataset
 from . import monitor
 from . import resilience
+from . import serving
 
 # PADDLE_TPU_MONITOR=1 turns the metrics runtime on for the whole
 # process (sink location via PADDLE_TPU_MONITOR_DIR); default stays

@@ -10,12 +10,60 @@ TensorRT-precision analogue.
 """
 from __future__ import annotations
 
+import threading
+import warnings
+
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from .tensor import Tensor
 from .nn.layer import Layer, functional_call, state_pytree
+
+_monitor = None
+_COST_WARNED = False
+
+# Tracing binds the state pytree into the (possibly shared) Layer IN
+# PLACE (nn.layer.bind_state), so two concurrent traces would read each
+# other's tracers and compile executables with phantom inputs. One
+# process-wide lock serializes compilation — serving makes concurrent
+# first-compiles an everyday event (N client threads + the batcher
+# drain thread), and steady state never takes this path.
+_BUILD_LOCK = threading.Lock()
+
+
+def _mon():
+    # lazy: paddle_tpu/__init__ imports inference before monitor
+    global _monitor
+    if _monitor is None:
+        from . import monitor
+        _monitor = monitor
+    return _monitor
+
+
+def _infer_fn(model, state=None):
+    """The one functionalized, no-grad inference body both compile paths
+    share. With ``state=None`` the returned fn takes ``(state, *xs)`` —
+    the jit path, where weights stay arguments so one executable serves
+    updated states; with a concrete ``state`` it is closed over — the
+    export path, where weights bake into the artifact as constants."""
+
+    def call(st, xs):
+        from . import autograd as _ag
+        with _ag.no_grad():
+            out, _ = functional_call(model, st, *[Tensor(x) for x in xs])
+        flat, _tree = jax.tree_util.tree_flatten(
+            out, is_leaf=lambda t: isinstance(t, Tensor))
+        arr = [t.data if isinstance(t, Tensor) else t for t in flat]
+        return tuple(arr) if len(arr) > 1 else arr[0]
+
+    if state is None:
+        def fn(st, *xs):
+            return call(st, xs)
+    else:
+        def fn(*xs):
+            return call(state, xs)
+    return fn
 
 
 class Config:
@@ -84,47 +132,114 @@ class Predictor:
     def _signature(self, args):
         return tuple((a.shape, str(a.dtype)) for a in args)
 
-    def run(self, *inputs):
+    def run(self, *inputs, buckets=None):
         """Run inference; inputs are numpy arrays / Tensors. Returns
-        numpy outputs (list when the model returns several)."""
-        out = self.run_device(*inputs)
+        numpy outputs (list when the model returns several). With
+        ``buckets`` (True for powers of two, or an explicit size list)
+        the batch dim is padded up to the next bucket before dispatch
+        and per-example outputs are sliced back — ragged request sizes
+        stop minting fresh executables (see docs/serving.md)."""
+        out = self.run_device(*inputs, buckets=buckets)
         if isinstance(out, (tuple, list)):
             return [np.asarray(jax.device_get(o)) for o in out]
         return np.asarray(jax.device_get(out))
 
-    def run_device(self, *inputs):
+    def run_device(self, *inputs, buckets=None):
         """Like run() but returns DEVICE arrays (jax.Array) without the
         device→host copy: for pipelined serving, feeding one predictor's
         output to another, or batched scoring loops where only the final
         result (or a reduction) leaves the device. Inputs may be numpy,
         Tensors, or device arrays — device inputs skip the host→device
-        copy too."""
+        copy too. ``buckets`` as in :meth:`run`."""
         arrays = []
         for x in inputs:
             if isinstance(x, Tensor):
                 x = x.data
             arrays.append(jnp.asarray(x))
+        real_n = None
+        if buckets and arrays and getattr(arrays[0], "ndim", 0) >= 1:
+            from .io.bucketing import next_bucket, pad_to_bucket
+            bset = None if buckets is True else buckets
+            n = arrays[0].shape[0]
+            target = next_bucket(n, bset)
+            if target != n:
+                real_n = n
+                arrays = [pad_to_bucket(a, target)
+                          if getattr(a, "ndim", 0) >= 1
+                          and a.shape[0] == n else a
+                          for a in arrays]
+                m = _mon()
+                if m.enabled():
+                    m.counter("inference.bucket_pad").inc()
+        out = self._get_compiled(arrays)(self.state, *arrays)
+        if real_n is not None:
+            from .io.bucketing import unpad
+            if isinstance(out, (tuple, list)):
+                out = tuple(unpad(o, real_n) for o in out)
+            else:
+                out = unpad(out, real_n)
+        return out
+
+    def _get_compiled(self, arrays):
+        """Cache lookup keyed on (shape, dtype) only — numpy, Tensor and
+        device-array inputs of one signature share one executable.
+        Thread-safe: misses serialize on the build lock (double-checked,
+        so a signature another thread just compiled becomes a hit)."""
         key = self._signature(arrays)
-        if key not in self._compiled:
-            self._compiled[key] = self._build(arrays)
-        return self._compiled[key](self.state, *arrays)
+        exe = self._compiled.get(key)
+        m = _mon()
+        if exe is None:
+            with _BUILD_LOCK:
+                exe = self._compiled.get(key)
+                if exe is None:
+                    if m.enabled():
+                        m.counter("inference.compile").inc()
+                    with m.trace.span("inference.compile",
+                                      model=type(self.model).__name__):
+                        exe = self._compiled[key] = self._build(arrays)
+                    return exe
+        if m.enabled():
+            m.counter("inference.cache_hit").inc()
+        return exe
 
     def _build(self, arrays):
-        model = self.model
-
-        def fn(state, *xs):
-            from . import autograd as _ag
-            with _ag.no_grad():
-                out, _ = functional_call(model, state,
-                                         *[Tensor(x) for x in xs])
-            flat, tree = jax.tree_util.tree_flatten(
-                out, is_leaf=lambda t: isinstance(t, Tensor))
-            arr = [t.data if isinstance(t, Tensor) else t for t in flat]
-            return tuple(arr) if len(arr) > 1 else arr[0]
-
-        # AOT: lower + compile now, not on first call
-        lowered = jax.jit(fn).lower(self.state, *arrays)
+        # AOT: lower + compile now, not on first call (arrays may be
+        # concrete values or ShapeDtypeStructs — warmup's path). Callers
+        # hold _BUILD_LOCK.
+        lowered = jax.jit(_infer_fn(self.model)).lower(self.state, *arrays)
         return lowered.compile()
+
+    def warmup(self, *signatures):
+        """AOT-compile ahead of traffic: each signature is a list with
+        one ``(shape, dtype)`` pair (or template array) per model input.
+        Compiles via ``lower().compile()`` over ShapeDtypeStructs — no
+        example data needed, same cache key :meth:`run` computes, so the
+        first real request of that shape starts on a warm executable
+        (``Executor.warmup``'s discipline, applied to inference).
+        Returns the cache keys."""
+        keys = []
+        for sig in signatures:
+            specs = []
+            for item in sig:
+                if hasattr(item, "shape") and hasattr(item, "dtype"):
+                    shape, dtype = item.shape, item.dtype
+                else:
+                    shape, dtype = item
+                dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
+                specs.append(jax.ShapeDtypeStruct(
+                    tuple(int(s) for s in shape), dtype))
+            key = self._signature(specs)
+            if key not in self._compiled:
+                with _BUILD_LOCK:
+                    if key not in self._compiled:
+                        m = _mon()
+                        if m.enabled():
+                            m.counter("inference.aot_warmup").inc()
+                        with m.trace.span("inference.warmup",
+                                          shape=str(specs[0].shape)):
+                            self._compiled[key] = self._build(specs)
+            keys.append(key)
+        return keys
 
     def export(self, path, *example_inputs):
         """Serialize the model as a portable StableHLO artifact
@@ -137,19 +252,7 @@ class Predictor:
 
         arrays = [jnp.asarray(x.data if isinstance(x, Tensor) else x)
                   for x in example_inputs]
-        model = self.model
-        state = self.state
-
-        def fn(*xs):
-            from . import autograd as _ag
-            with _ag.no_grad():
-                out, _ = functional_call(model, state,
-                                         *[Tensor(x) for x in xs])
-            flat, _tree = jax.tree_util.tree_flatten(
-                out, is_leaf=lambda t: isinstance(t, Tensor))
-            arr = [t.data if isinstance(t, Tensor) else t for t in flat]
-            return tuple(arr) if len(arr) > 1 else arr[0]
-
+        fn = _infer_fn(self.model, state=self.state)
         exported = jexport.export(jax.jit(fn))(
             *[jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays])
         with open(path, "wb") as f:
@@ -157,18 +260,27 @@ class Predictor:
         return path
 
     def compile_report(self, *inputs):
-        """Expose the compiled executable's cost analysis (profiling
-        aid)."""
+        """The compiled executable's XLA-measured cost (flops, bytes,
+        peak memory), extracted through ``monitor.xla`` — the same
+        normalization ``aot_capture`` applies everywhere else, so the
+        numbers also land in the ``xla.*`` gauges / ``xla_cost`` JSONL
+        when the monitor is enabled. Warns once (rather than silently
+        returning ``{}``) when the backend exposes no cost analysis."""
         arrays = [jnp.asarray(x.data if isinstance(x, Tensor) else x)
                   for x in inputs]
-        key = self._signature(arrays)
-        if key not in self._compiled:
-            self._compiled[key] = self._build(arrays)
-        exe = self._compiled[key]
-        try:
-            return exe.cost_analysis()
-        except Exception:
-            return {}
+        exe = self._get_compiled(arrays)
+        from .monitor import xla as _xla
+        label = f"predictor.{type(self.model).__name__}"
+        info = _xla.capture(label, exe)
+        if not info:
+            global _COST_WARNED
+            if not _COST_WARNED:
+                _COST_WARNED = True
+                warnings.warn(
+                    "Predictor.compile_report: this backend exposes no "
+                    "cost/memory analysis for compiled executables; "
+                    "returning an empty report", RuntimeWarning)
+        return info
 
 
 def load_exported(path):

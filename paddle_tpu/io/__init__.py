@@ -30,7 +30,8 @@ from ..tensor import Tensor, Parameter
 from ..nn.layer import Layer
 from .. import monitor as _monitor
 from . import bucketing  # noqa: F401  (shape bucketing / pad-and-mask)
-from .bucketing import next_bucket, pad_to_bucket, batch_mask  # noqa: F401
+from .bucketing import (next_bucket, pad_to_bucket, batch_mask,  # noqa: F401
+                        unpad, split_rows)
 from .prefetch import prefetch_to_device  # noqa: F401
 
 

@@ -80,6 +80,41 @@ def batch_mask(real_n, padded_n, dtype="float32"):
     return m
 
 
+def unpad(array, real_n, axis=0):
+    """Drop the pad rows again: the first ``real_n`` rows along
+    ``axis``. The inverse of :func:`pad_to_bucket` for per-example
+    outputs (no-op when the array is already ``real_n`` long, or has no
+    batch dimension to slice)."""
+    if real_n is None or getattr(array, "ndim", 0) < 1:
+        return array
+    if array.shape[axis] <= int(real_n):
+        return array
+    idx = [slice(None)] * array.ndim
+    idx[axis] = slice(0, int(real_n))
+    return array[tuple(idx)]
+
+
+def split_rows(array, sizes, axis=0):
+    """Split the leading real rows of a (possibly bucket-padded) batch
+    back into per-request chunks of ``sizes`` rows each; trailing pad
+    rows past ``sum(sizes)`` are dropped. The scatter half of dynamic
+    batching: requests of 1/3/7/13 rows coalesced and padded to a
+    32-bucket come back as four correctly-sized outputs."""
+    out = []
+    off = 0
+    for n in sizes:
+        n = int(n)
+        idx = [slice(None)] * array.ndim
+        idx[axis] = slice(off, off + n)
+        out.append(array[tuple(idx)])
+        off += n
+    if off > array.shape[axis]:
+        raise ValueError(
+            f"split_rows: sizes sum to {off} but axis {axis} has only "
+            f"{array.shape[axis]} rows")
+    return out
+
+
 def pad_feed_dict(feed, buckets=None, axis=0, mode="repeat"):
     """Bucket-pad every array in a name→array feed dict along ``axis``.
 

@@ -42,7 +42,25 @@ Resilience series (docs/robustness.md; ``paddle_tpu.resilience``):
   injections and batches dropped after retry exhaustion
   (``prefetch.drops`` counts the same at the prefetch site)
 
-Span tracing & XLA-measured cost (this PR's additions):
+Serving series (docs/serving.md; ``paddle_tpu.serving``):
+
+* ``serving.requests`` / ``serving.rows`` / ``serving.batches`` —
+  submitted requests, their example rows, and coalesced batches
+* ``serving.qps`` (gauge) / ``serving.latency_ms`` (histogram) —
+  rolling completed-requests/sec and submit→resolve latency
+* ``serving.queue_depth`` / ``serving.rejected`` /
+  ``serving.deadline_expired`` — admission control in action
+* ``serving.batch_fill`` (requests per batch) /
+  ``serving.batch_occupancy`` (real rows ÷ bucket rows) /
+  ``serving.pad_rows`` — how well dynamic batching amortizes
+* ``serving.compiles`` — executables minted by the serving path
+  (must stop growing after ``ServingEngine.warmup``)
+* ``serving.retries`` / ``serving.isolated`` / ``serving.poisoned`` —
+  the RetryPolicy-classified failure path
+* ``inference.{compile,cache_hit,aot_warmup,bucket_pad}`` — the
+  underlying Predictor's executable-cache accounting
+
+Span tracing & XLA-measured cost (PR 4's additions):
 
 * ``monitor.trace``  — thread-aware span tracer (``span()`` context
   managers, ring buffer, Chrome-trace/Perfetto export, flight

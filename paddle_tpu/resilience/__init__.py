@@ -18,6 +18,9 @@ subsystem turns each into a bounded, observable recovery:
   ``train_from_dataset(auto_resume=True)`` continue at the right step
 * :mod:`~paddle_tpu.resilience.faults`   — deterministic fault
   injection (the tests' and chaos CI gate's chaos source)
+* :mod:`~paddle_tpu.resilience.deadline` — monotonic wall-time budgets
+  (:class:`Deadline`); the serving tier's admission controller drops
+  expired requests at dequeue so they never occupy a batch slot
 
 Checkpoint hardening itself (tmp-file + ``os.replace``, sha256
 sidecars, corrupt-file quarantine) lives in
@@ -37,7 +40,9 @@ from . import retry  # noqa: F401
 from . import guard  # noqa: F401
 from . import watchdog  # noqa: F401
 from . import preempt  # noqa: F401
+from . import deadline  # noqa: F401
 from ._common import record  # noqa: F401
+from .deadline import Deadline  # noqa: F401
 from .retry import (RetryPolicy, RetryExhausted, TransientError,  # noqa: F401
                     retry_call, retrying, is_transient)
 from .guard import NaNGuard, NonFiniteError  # noqa: F401
@@ -45,10 +50,10 @@ from .watchdog import Watchdog  # noqa: F401
 from .preempt import PreemptionHandler  # noqa: F401
 
 __all__ = [
-    "faults", "retry", "guard", "watchdog", "preempt",
+    "faults", "retry", "guard", "watchdog", "preempt", "deadline",
     "RetryPolicy", "RetryExhausted", "TransientError", "retry_call",
     "retrying", "is_transient", "NaNGuard", "NonFiniteError",
-    "Watchdog", "PreemptionHandler", "record",
+    "Watchdog", "PreemptionHandler", "Deadline", "record",
 ]
 
 # PADDLE_TPU_FAULTS='[{"kind":"loader","step":3}]' registers faults at

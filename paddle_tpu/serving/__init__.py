@@ -1,0 +1,61 @@
+"""paddle_tpu.serving — the online inference runtime.
+
+The stack below this package ends at a single-request ``Predictor``:
+every caller pays its own dispatch, every ragged request shape mints a
+fresh XLA executable, and overload turns into unbounded latency. This
+subsystem is the serving tier that production TPU inference actually
+needs (PAPERS.md: Gemma serving on Cloud TPU; "Operator Fusion in
+XLA"): keep a small set of large compiled executables hot and coalesce
+traffic into them.
+
+* :mod:`~paddle_tpu.serving.batcher`   — bounded request queue +
+  background drain thread; coalesces same-signature requests, flushes
+  on ``max_batch`` rows or ``timeout_ms``
+* :mod:`~paddle_tpu.serving.engine`    — :class:`ServingEngine`:
+  ``submit()`` (future-returning) / ``run()`` (blocking) /
+  ``warmup()`` (AOT-compiles every (bucket, signature) pair so steady
+  state never compiles); pads to ``io.bucketing`` buckets and slices
+  per-request outputs back bit-exactly
+* :mod:`~paddle_tpu.serving.admission` — backpressure
+  (:class:`QueueFullError` fast-reject), per-request SLA deadlines
+  (dropped at dequeue, never occupying a batch slot), and
+  ``RetryPolicy``-classified failure triage (one poisoned request
+  fails its own future, not the whole batch)
+* :mod:`~paddle_tpu.serving.metrics`   — ``serving.*`` counter /
+  gauge / histogram series + ``serving.{enqueue,batch_assemble,
+  execute,scatter}`` trace spans
+* :mod:`~paddle_tpu.serving.multi`     — :class:`MultiDeviceEngine`:
+  round-robin fan-out over per-device state replicas
+
+Quickstart::
+
+    from paddle_tpu import inference, serving
+
+    pred = inference.Predictor(model)
+    eng = serving.ServingEngine(pred, buckets=[8, 32], max_batch=32,
+                                timeout_ms=5.0, deadline_ms=100.0)
+    eng.warmup([((16,), "float32")])       # per-example input spec
+    fut = eng.submit(x)                    # x: (n, 16), n <= 32
+    y = fut.result()                       # == Predictor(model).run(x)
+    eng.close()
+
+See docs/serving.md for architecture and tuning.
+"""
+from __future__ import annotations
+
+from . import batcher  # noqa: F401
+from . import admission  # noqa: F401
+from . import metrics  # noqa: F401
+from . import engine  # noqa: F401
+from . import multi  # noqa: F401
+from .admission import (AdmissionController, QueueFullError,  # noqa: F401
+                        DeadlineExpired)
+from .batcher import DynamicBatcher, Request  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .multi import MultiDeviceEngine, replicate  # noqa: F401
+
+__all__ = [
+    "batcher", "admission", "metrics", "engine", "multi",
+    "ServingEngine", "MultiDeviceEngine", "replicate", "DynamicBatcher",
+    "Request", "AdmissionController", "QueueFullError", "DeadlineExpired",
+]

@@ -1,0 +1,112 @@
+"""paddle_tpu.serving.admission — backpressure, SLAs, and blast radius.
+
+An online endpoint fails three ways a training loop never sees:
+
+* **Overload.** An unbounded queue converts overload into unbounded
+  latency for *everyone*. The controller bounds queue depth and
+  fast-rejects at submit time (:class:`QueueFullError`) — the caller
+  learns in microseconds and can shed load or retry elsewhere.
+* **Stale work.** A request past its deadline is pure waste: the caller
+  is gone, but executing it still burns a batch slot. Deadlines are
+  checked **at dequeue** (:meth:`AdmissionController.sweep_expired`),
+  so an expired request is resolved with :class:`DeadlineExpired` and
+  never occupies a slot in the batch it would have ridden.
+* **Poison.** One malformed request inside a coalesced batch fails the
+  whole executable call. The error path is classified with
+  ``resilience.retry.RetryPolicy``: transient failures retry the batch
+  (bounded, backed off); terminal failures re-run the batch
+  request-by-request (:meth:`AdmissionController.isolate`) so exactly
+  the poisoned request's future carries the exception and every
+  innocent neighbour still resolves.
+"""
+from __future__ import annotations
+
+from ..resilience.deadline import Deadline
+from ..resilience.retry import RetryPolicy
+from . import metrics
+
+
+class QueueFullError(RuntimeError):
+    """Fast-reject: the serving queue is at ``max_queue_depth``. Raised
+    synchronously from ``submit()`` — no future is created."""
+
+
+class DeadlineExpired(TimeoutError):
+    """Set on a request's future when its SLA deadline passed before a
+    batch slot opened (the request was dropped at dequeue, unexecuted)."""
+
+
+class AdmissionController:
+    """Enqueue-time backpressure + dequeue-time SLA + failure triage.
+
+    ``default_deadline_ms`` stamps a deadline on every request that
+    didn't bring its own; ``None`` means requests without explicit
+    deadlines never expire. ``retry_policy`` classifies batch-execution
+    failures (transient → retry, terminal → isolate); the default is a
+    fast two-attempt policy suited to in-process serving.
+    """
+
+    def __init__(self, max_queue_depth=256, default_deadline_ms=None,
+                 retry_policy=None):
+        if max_queue_depth < 1:
+            raise ValueError(
+                f"max_queue_depth must be >= 1, got {max_queue_depth}")
+        self.max_queue_depth = int(max_queue_depth)
+        self.default_deadline_ms = default_deadline_ms
+        self.retry_policy = retry_policy or RetryPolicy(
+            max_attempts=2, base_delay=0.01, max_delay=0.2)
+        # optional observer (the engine's stats dict): called with
+        # "rejected" / "expired" / "poisoned"
+        self.on_event = None
+
+    def _note(self, event):
+        if self.on_event is not None:
+            self.on_event(event)
+
+    # -- enqueue ----------------------------------------------------------
+
+    def admit(self, request, depth):
+        """Called under the queue lock before enqueue. Raises
+        :class:`QueueFullError` at capacity; otherwise stamps the
+        default deadline on an undeadlined request."""
+        if depth >= self.max_queue_depth:
+            metrics.record_reject()
+            self._note("rejected")
+            raise QueueFullError(
+                f"serving queue full ({depth}/{self.max_queue_depth} "
+                f"requests waiting)")
+        if request.deadline is None and self.default_deadline_ms is not None:
+            request.deadline = Deadline.after_ms(self.default_deadline_ms)
+
+    # -- dequeue ----------------------------------------------------------
+
+    @staticmethod
+    def is_expired(request, now=None):
+        return request.deadline is not None and request.deadline.expired(now)
+
+    def expire(self, request):
+        """Resolve an expired request's future (called after it was
+        removed from the queue, before any batch slot was assigned)."""
+        metrics.record_expired()
+        self._note("expired")
+        request.resolve_exception(DeadlineExpired(
+            f"deadline expired {-request.deadline.remaining() * 1e3:.1f}ms "
+            f"ago before a batch slot opened"))
+
+    # -- failure triage ----------------------------------------------------
+
+    def isolate(self, requests, run_one, batch_error):
+        """Terminal (or retry-exhausted) batch failure: re-run each
+        request on its own so one poisoned request fails only its own
+        future. ``run_one(request)`` must execute AND resolve the
+        request; any exception it raises is routed to that request's
+        future here."""
+        metrics.record_isolated(len(requests))
+        for r in requests:
+            try:
+                run_one(r)
+            except BaseException as e:  # noqa: BLE001 - routed to future
+                metrics.record_poisoned(error=repr(e))
+                self._note("poisoned")
+                e.__context__ = batch_error
+                r.resolve_exception(e)

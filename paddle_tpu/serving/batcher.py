@@ -1,0 +1,215 @@
+"""paddle_tpu.serving.batcher — dynamic request coalescing.
+
+The throughput argument (PAPERS.md: Gemma-on-TPU serving; "Operator
+Fusion in XLA"): a TPU earns its keep on a few large, hot, pre-compiled
+executables — not thousands of single-row dispatches. The batcher is
+the mechanism: callers submit ragged requests (1, 3, 7, 13 rows …) into
+a bounded queue; a background thread drains it, coalesces
+same-signature requests along the batch axis, and flushes when either
+``max_batch`` rows accumulate or the oldest request has waited
+``timeout_ms`` — whichever comes first. The engine pads the coalesced
+rows up to the next ``io.bucketing`` bucket so every flush hits a
+pre-compiled shape, and slices per-request outputs back out.
+
+Queueing discipline:
+
+* FIFO by arrival. A flush takes the oldest request's signature and
+  collects its same-signature successors in order (no reordering
+  within a signature; a different signature never blocks behind a
+  full flush of another).
+* Admission runs at enqueue (fast-reject on a full queue) and expiry
+  at dequeue (an expired request is resolved with ``DeadlineExpired``
+  and never counted toward a flush) — see ``admission.py``.
+* Futures are resolved OUTSIDE the queue lock: a done-callback that
+  immediately re-submits must not deadlock the drain thread.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import threading
+import time
+
+from .. import monitor as _monitor
+from . import metrics
+
+
+class Request:
+    """One in-flight unit of work: ``n`` example rows across one or
+    more input arrays, a future the caller holds, and an optional
+    deadline. Created by ``ServingEngine.submit``."""
+
+    __slots__ = ("inputs", "n", "signature", "future", "deadline",
+                 "t_enqueue")
+
+    def __init__(self, inputs, n, signature, deadline=None):
+        self.inputs = inputs              # tuple of host arrays
+        self.n = int(n)                   # rows along the batch axis
+        self.signature = signature        # per-example (shape, dtype) tuple
+        self.future = concurrent.futures.Future()
+        self.deadline = deadline
+        self.t_enqueue = time.monotonic()
+
+    def age(self, now=None):
+        return (now if now is not None else time.monotonic()) \
+            - self.t_enqueue
+
+    # concurrent.futures raises InvalidStateError on a cancelled future;
+    # a caller cancelling mid-flight must not crash the drain thread.
+    def resolve_result(self, value):
+        try:
+            self.future.set_result(value)
+        except concurrent.futures.InvalidStateError:
+            pass
+
+    def resolve_exception(self, exc):
+        try:
+            self.future.set_exception(exc)
+        except concurrent.futures.InvalidStateError:
+            pass
+
+
+class DynamicBatcher:
+    """Bounded queue + drain thread. ``process(requests)`` — supplied by
+    the engine — executes one coalesced, same-signature group; the
+    batcher owns *when* and *what* to flush, the engine owns *how*."""
+
+    def __init__(self, process, admission, max_batch=32, timeout_ms=5.0,
+                 name="paddle_tpu-serving"):
+        self._process = process
+        self._admission = admission
+        self.max_batch = int(max_batch)
+        self.timeout_s = float(timeout_ms) / 1e3
+        self._name = name
+        self._queue = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._running = False     # drain thread active
+        self._closed = False      # no further submits
+        self._draining = False
+        self._thread = None
+
+    # -- producer side ----------------------------------------------------
+
+    def submit(self, request):
+        """Admit + enqueue; returns the request's future. Raises
+        ``QueueFullError`` synchronously when the queue is at depth.
+        Valid before :meth:`start` — requests queue up for the first
+        flush."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("serving engine is closed")
+            self._admission.admit(request, len(self._queue))
+            self._queue.append(request)
+            depth = len(self._queue)
+            self._cond.notify()
+        metrics.record_submit(request.n)
+        metrics.record_queue_depth(depth)
+        return request.future
+
+    def depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self):
+        with self._lock:
+            if self._running or self._closed:
+                return
+            self._running = True
+            self._draining = False
+            self._thread = threading.Thread(
+                target=self._worker, name=self._name, daemon=True)
+            self._thread.start()
+
+    def close(self, drain=True, timeout=None):
+        """Stop accepting work and stop the drain thread. With
+        ``drain=True`` (default) queued requests are flushed first;
+        anything still queued afterwards (``drain=False``, or no thread
+        ever started) fails with RuntimeError — a future is never
+        silently lost."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._running = False
+            self._draining = bool(drain)
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
+        with self._lock:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for r in leftovers:
+            r.resolve_exception(RuntimeError("serving engine closed"))
+
+    # -- drain thread -----------------------------------------------------
+
+    def _worker(self):
+        while True:
+            expired, group, wait_s = self._pick_locked()
+            for r in expired:
+                self._admission.expire(r)
+            if group:
+                with _monitor.trace.span("serving.batch",
+                                         requests=len(group)):
+                    self._process(group)
+                continue
+            with self._cond:
+                if not self._running:
+                    if self._queue and self._draining:
+                        continue        # re-pick: drain flushes the rest
+                    return
+                # re-checks hold the lock, so a submit that landed after
+                # _pick_locked released it is visible here — only the
+                # flush-threshold race can delay, bounded by timeout_s
+                if not self._queue:
+                    self._cond.wait(0.1)
+                elif wait_s > 0:
+                    self._cond.wait(wait_s)
+
+    def _pick_locked(self):
+        """Under the lock: sweep expired requests out of the whole
+        queue, then decide whether the head signature's group should
+        flush now. Returns (expired, group, seconds_to_wait)."""
+        with self._lock:
+            now = time.monotonic()
+            expired, kept = [], collections.deque()
+            while self._queue:
+                r = self._queue.popleft()
+                if self._admission.is_expired(r, now):
+                    expired.append(r)
+                else:
+                    kept.append(r)
+            self._queue = kept
+            if not self._queue:
+                metrics.record_queue_depth(0)
+                return expired, [], 0.0
+
+            head = self._queue[0]
+            sig = head.signature
+            cand, rows, overflow = [], 0, False
+            for r in self._queue:
+                if r.signature != sig:
+                    continue
+                if rows + r.n > self.max_batch:
+                    # keep FIFO within a signature: stop rather than
+                    # skip-fill with later, smaller requests
+                    overflow = True
+                    break
+                cand.append(r)
+                rows += r.n
+
+            flush_now = (overflow or rows >= self.max_batch
+                         or head.age(now) >= self.timeout_s
+                         or self._draining or not self._running)
+            if not flush_now:
+                return expired, [], max(self.timeout_s - head.age(now),
+                                        1e-4)
+            taken = set(map(id, cand))
+            self._queue = collections.deque(
+                r for r in self._queue if id(r) not in taken)
+            metrics.record_queue_depth(len(self._queue))
+            return expired, cand, 0.0

@@ -1,0 +1,122 @@
+"""paddle_tpu.serving.metrics — the serving tier's observability surface.
+
+Every record_* helper is a no-op while the monitor is disabled (the
+framework's zero-cost-when-off discipline); with ``monitor.enable()``
+the serving pipeline shows up as:
+
+* ``serving.requests`` / ``serving.rows``    — submitted requests and
+  their total example rows
+* ``serving.qps``        — completed requests/sec, gauge over a rolling
+  window (:data:`QPS_WINDOW_S`)
+* ``serving.queue_depth`` — requests waiting, gauge set at every
+  enqueue/dequeue edge
+* ``serving.batches``    — coalesced batches executed
+* ``serving.batch_fill`` — histogram: requests coalesced per batch
+  (> 1 means dynamic batching is actually amortizing dispatch)
+* ``serving.batch_occupancy`` — histogram: real rows ÷ bucket rows
+  (the ``io.bucketing.batch_mask`` mean — how much MXU work is real
+  vs. pad)
+* ``serving.pad_rows``   — pad rows shipped to the device
+* ``serving.latency_ms`` — histogram: submit→resolve per request
+* ``serving.rejected``   — fast-rejects at a full queue
+* ``serving.deadline_expired`` — requests dropped at dequeue past SLA
+* ``serving.compiles``   — executables minted by the serving path
+  (warmup included; steady state must hold this flat)
+* ``serving.retries`` / ``serving.isolated`` / ``serving.poisoned`` —
+  transient batch retries, batches re-run request-by-request after a
+  terminal failure, and the requests that individually failed
+
+Span sites (``monitor.trace``): ``serving.enqueue``,
+``serving.batch_assemble``, ``serving.execute``, ``serving.scatter``,
+``serving.warmup`` — the Perfetto view of queue→batch→MXU.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+from .. import monitor as _monitor
+from ..io.bucketing import batch_mask
+
+#: rolling window for the serving.qps gauge
+QPS_WINDOW_S = 10.0
+
+_qps_lock = threading.Lock()
+_qps_window = collections.deque()   # (t_monotonic, n_completed)
+
+
+def record_submit(n_rows):
+    if _monitor.enabled():
+        _monitor.counter("serving.requests").inc()
+        _monitor.counter("serving.rows").inc(int(n_rows))
+
+
+def record_queue_depth(depth):
+    if _monitor.enabled():
+        _monitor.gauge("serving.queue_depth").set(int(depth))
+
+
+def record_reject():
+    if _monitor.enabled():
+        _monitor.counter("serving.rejected").inc()
+        _monitor.emit(kind="serving", event="rejected")
+
+
+def record_expired():
+    if _monitor.enabled():
+        _monitor.counter("serving.deadline_expired").inc()
+        _monitor.emit(kind="serving", event="deadline_expired")
+
+
+def record_batch(real_rows, bucket_rows, n_requests):
+    if not _monitor.enabled():
+        return
+    _monitor.counter("serving.batches").inc()
+    _monitor.histogram("serving.batch_fill").observe(float(n_requests))
+    occupancy = float(batch_mask(real_rows, bucket_rows).mean())
+    _monitor.histogram("serving.batch_occupancy").observe(occupancy)
+    if bucket_rows > real_rows:
+        _monitor.counter("serving.pad_rows").inc(int(bucket_rows - real_rows))
+
+
+def record_completed(n_requests, latencies_ms):
+    """Per-batch completion: latency histogram per request + the rolling
+    QPS gauge."""
+    if not _monitor.enabled():
+        return
+    h = _monitor.histogram("serving.latency_ms")
+    for ms in latencies_ms:
+        h.observe(float(ms))
+    now = time.monotonic()
+    with _qps_lock:
+        _qps_window.append((now, int(n_requests)))
+        while _qps_window and now - _qps_window[0][0] > QPS_WINDOW_S:
+            _qps_window.popleft()
+        total = sum(k for _, k in _qps_window)
+        elapsed = max(now - _qps_window[0][0], 0.5)
+    _monitor.gauge("serving.qps").set(round(total / elapsed, 3))
+
+
+def record_compiles(n=1):
+    if _monitor.enabled():
+        _monitor.counter("serving.compiles").inc(int(n))
+
+
+def record_retry(where=""):
+    if _monitor.enabled():
+        _monitor.counter("serving.retries").inc()
+        _monitor.emit(kind="serving", event="retry", where=where)
+
+
+def record_isolated(n_requests):
+    if _monitor.enabled():
+        _monitor.counter("serving.isolated").inc(int(n_requests))
+        _monitor.emit(kind="serving", event="isolated",
+                      requests=int(n_requests))
+
+
+def record_poisoned(error=""):
+    if _monitor.enabled():
+        _monitor.counter("serving.poisoned").inc()
+        _monitor.emit(kind="serving", event="poisoned", error=error)

@@ -1,0 +1,98 @@
+"""paddle_tpu.serving.multi — data-parallel replica fan-out.
+
+A multi-chip inference host serves best as N independent replicas, not
+one sharded model: each device holds a full copy of the state
+(``jax.device_put`` — the serving analogue of data parallelism), runs
+its own dynamic batcher, and a round-robin front door spreads request
+streams across them. No collectives on the request path, so per-replica
+latency is identical to single-device serving and aggregate QPS scales
+with chip count until the host-side queue becomes the bottleneck.
+
+:func:`replicate` is the state mechanic (one Predictor view per device,
+sharing the model object, with a per-device executable cache);
+:class:`MultiDeviceEngine` is the operational wrapper (one
+``ServingEngine`` per replica + the round-robin ``submit``).
+"""
+from __future__ import annotations
+
+import copy
+import threading
+
+from .engine import ServingEngine
+
+
+def replicate(predictor, devices=None):
+    """One ``Predictor`` view per device: the frozen eval-state pytree
+    is ``device_put`` onto each device; the model object and config are
+    shared (read-only at serving time); each replica gets its own
+    executable cache (XLA executables are device-committed). Default
+    devices: every local device."""
+    import jax
+    devices = list(devices) if devices is not None else jax.local_devices()
+    if not devices:
+        raise ValueError("replicate: no devices")
+    replicas = []
+    for d in devices:
+        p = copy.copy(predictor)
+        p.state = jax.device_put(predictor.state, d)
+        p._compiled = {}
+        p.device = d
+        replicas.append(p)
+    return replicas
+
+
+class MultiDeviceEngine:
+    """Round-robin fan-out over per-device :class:`ServingEngine`
+    replicas. Same client surface (``submit``/``run``/``warmup``/
+    ``stats``/context manager); engine kwargs apply per replica, so
+    ``queue_depth`` and ``max_batch`` are per-device limits."""
+
+    def __init__(self, predictor, devices=None, **engine_kwargs):
+        self.replicas = replicate(predictor, devices)
+        self.engines = [ServingEngine(p, **engine_kwargs)
+                        for p in self.replicas]
+        self._rr_lock = threading.Lock()
+        self._rr = 0
+
+    def _next_engine(self):
+        with self._rr_lock:
+            e = self.engines[self._rr]
+            self._rr = (self._rr + 1) % len(self.engines)
+        return e
+
+    def submit(self, *inputs, deadline_ms=None):
+        return self._next_engine().submit(*inputs, deadline_ms=deadline_ms)
+
+    def run(self, *inputs, deadline_ms=None, timeout=None):
+        return self.submit(*inputs, deadline_ms=deadline_ms).result(timeout)
+
+    def warmup(self, *signatures):
+        """Warm every replica (each compiles its own device-committed
+        executables). Returns total fresh executables."""
+        return sum(e.warmup(*signatures) for e in self.engines)
+
+    def start(self):
+        for e in self.engines:
+            e.start()
+
+    def close(self, drain=True, timeout=None):
+        for e in self.engines:
+            e.close(drain=drain, timeout=timeout)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def stats(self):
+        """Aggregate across replicas, with the per-replica breakdown
+        under ``"replicas"``."""
+        per = [e.stats() for e in self.engines]
+        agg = {k: sum(s[k] for s in per)
+               for k in per[0] if isinstance(per[0][k], (int, float))}
+        agg["replicas"] = per
+        agg["devices"] = [str(getattr(p, "device", "?"))
+                          for p in self.replicas]
+        return agg

@@ -32,6 +32,14 @@ bash scripts/trace_smoke.sh "$MONITOR_DIR/trace_smoke"
 trc=$?
 [ $trc -ne 0 ] && rc=$((rc == 0 ? trc : rc))
 
+# serving gate: 200 concurrent requests must coalesce (batch_fill > 1),
+# mint zero post-warmup executables, lose no futures, record p99 JSONL
+echo ""
+echo "-- serving smoke gate --"
+bash scripts/serving_smoke.sh "$MONITOR_DIR/serving_smoke"
+srv=$?
+[ $srv -ne 0 ] && rc=$((rc == 0 ? srv : rc))
+
 latest=$(ls -t "$MONITOR_DIR"/events-*.jsonl 2>/dev/null | head -1)
 echo ""
 echo "monitor JSONL: ${latest:-<none written>} (dir: $MONITOR_DIR)"

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# CI gate for the serving tier: 200 concurrent ragged requests through
+# a warmed ServingEngine must coalesce (mean batch_fill > 1), perform
+# zero post-warmup XLA compiles, lose no futures, and record p50/p99
+# latency to the monitor JSONL. Tier-1-safe: tiny MLP, CPU, seconds.
+#
+# Usage: scripts/serving_smoke.sh [out_dir]
+# The monitor JSONL (with the serving_smoke record) lands in out_dir
+# (default /tmp/paddle_tpu_serving_smoke) as the CI artifact; the last
+# stdout line is one JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_serving_smoke}"
+JAX_PLATFORMS=cpu python scripts/serving_smoke.py --out-dir "$OUT_DIR"
