@@ -464,6 +464,23 @@ def _provenance(with_device=False):
     return prov
 
 
+def _append_result_jsonl(out):
+    """Append the result line to $PADDLE_TPU_BENCH_JSONL (one JSON
+    object per line) — the running artifact scripts/perf_sentinel.py
+    audits for regressions. Best-effort: the bench's one guaranteed
+    output stays the stdout line."""
+    import os
+    path = os.environ.get("PADDLE_TPU_BENCH_JSONL", "")
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(path, "a") as fh:
+            fh.write(json.dumps(out) + "\n")
+    except Exception:
+        pass
+
+
 def _fail_json(msg):
     """Emit the SAME JSON schema as a successful run so the driver always
     records a parseable line (r3's backend-init exception escaped main()
@@ -501,6 +518,7 @@ def _fail_json(msg):
                 break
     except Exception:
         pass  # the pointer is best-effort; never break the fail line
+    _append_result_jsonl(out)
     print(json.dumps(out), flush=True)
 
 
@@ -699,6 +717,7 @@ def main():
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",
               **_RESULTS}
+    _append_result_jsonl(result)
     print(json.dumps(result))
 
 

@@ -186,7 +186,7 @@ class Model(Layer):
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, prefetch=0, bucket=False, checkpoint=None,
             save_steps=None, auto_resume=False, nan_guard=None,
-            watchdog=None):
+            watchdog=None, metrics_port=None):
         """reference hapi/model.py:1128 fit.
 
         TPU pipelining extensions: ``prefetch=N`` stages the next N
@@ -208,7 +208,14 @@ class Model(Layer):
         non-finite update steps inside the compiled train step and
         applies skip/rollback/raise on the host; ``watchdog`` (True or a
         resilience.Watchdog) flags steps that exceed a rolling
-        p99-based deadline and dumps monitor state."""
+        p99-based deadline and dumps monitor state.
+
+        Telemetry extension: ``metrics_port`` starts the live HTTP
+        telemetry plane (``monitor.serve``) before the first step —
+        ``/metrics`` (OpenMetrics), ``/healthz`` (watchdog/NaN-guard
+        state), ``/snapshot``; use 0 for an ephemeral port
+        (``monitor.export.port()`` reports it). The server outlives
+        fit() — ``monitor.disable()`` tears it down."""
         assert self._optimizer is not None, "call prepare() first"
         from ..resilience import faults as _faults
         from ..resilience._common import record as _rrecord
@@ -232,6 +239,8 @@ class Model(Layer):
         if watchdog is not None and watchdog is not False:
             from ..resilience.watchdog import Watchdog
             wd = watchdog if isinstance(watchdog, Watchdog) else Watchdog()
+        if metrics_port is not None:
+            _monitor.serve(port=metrics_port)
 
         loader = self._loader(train_data, batch_size, shuffle, num_workers,
                               drop_last=drop_last)

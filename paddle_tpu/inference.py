@@ -128,6 +128,19 @@ class Predictor:
                               else v)
                           for k, v in self.state.items()}
         self._compiled = {}
+        # telemetry sampler provider: compiled-executable count as a
+        # live gauge; weakref so a dead Predictor self-unregisters
+        import weakref
+        from .monitor import sampler as _sampler
+        ref = weakref.ref(self)
+
+        def _exe_series():
+            p = ref()
+            if p is None:
+                return None
+            return {"inference.executables": len(p._compiled)}
+
+        _sampler.register_provider(f"predictor-{id(self)}", _exe_series)
 
     def _signature(self, args):
         return tuple((a.shape, str(a.dtype)) for a in args)

@@ -172,6 +172,12 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
     t = threading.Thread(target=produce, name="paddle_tpu-prefetch",
                          daemon=True)
     t.start()
+    # live queue-depth gauge for the telemetry sampler (cold path: one
+    # dict write per iterator; the provider dies with the generator)
+    from ..monitor import sampler as _sampler
+    _provider_key = _sampler.register_provider(
+        f"prefetch-{id(q)}",
+        lambda: {"prefetch.queue_depth": q.qsize()})
     try:
         while True:
             t0 = time.perf_counter()
@@ -188,6 +194,7 @@ def prefetch_to_device(iterator, size=2, mesh=None, axis_name="dp",
                 _monitor.counter("prefetch.batches").inc()
             yield item
     finally:
+        _sampler.unregister_provider(_provider_key)
         stop.set()
         try:  # unblock a producer parked on a full queue
             while True:

@@ -107,7 +107,7 @@ __all__ = [
     "histogram", "emit", "snapshot", "reset", "jsonl_path",
     "record_collective", "StepMonitor", "mfu", "peak_flops_for_device",
     "transformer_train_flops_per_token", "device_memory_stats",
-    "read_jsonl", "trace", "xla",
+    "read_jsonl", "trace", "xla", "serve", "export", "sampler",
 ]
 
 _registry = Registry()
@@ -174,23 +174,39 @@ def enable(path=None, time_dispatch=None):
     dispatch.install_monitor_hook(_dispatch_hook, time_ops=_time_dispatch)
     emit(kind="monitor", action="enable", pid=os.getpid(),
          time_dispatch=_time_dispatch)
+
+    # zero-code telemetry plane: PADDLE_TPU_METRICS_PORT=9464 (or =0
+    # for ephemeral) arms the /metrics HTTP server + sampler from env
+    if os.environ.get("PADDLE_TPU_METRICS_PORT", "") != "":
+        serve()
     return jsonl_path()
 
 
 def disable(flush_counters=True):
     """Turn monitoring off: uninstall the dispatch hook (restoring the
-    zero-overhead fast path), emit a final counters snapshot, and close
-    the sink. The registry keeps its values for post-run inspection —
-    reset() clears them."""
+    zero-overhead fast path), tear down the telemetry plane (export
+    server socket closed + thread joined, sampler joined), emit a final
+    counters snapshot, and close the sink. The registry keeps its
+    values for post-run inspection — reset() clears them."""
     global _enabled, _sink
     if flush_counters and _enabled:
         emit(kind="counters", counters=snapshot())
     from .. import dispatch
     dispatch.install_monitor_hook(None)
+    sampler.stop()
+    export.stop()
     _enabled = False
     if _sink is not None:
         _sink.close()
         _sink = None
+
+
+def serve(port=None, host="127.0.0.1", **kw):
+    """Start the live telemetry HTTP server (/metrics /healthz
+    /snapshot) + periodic sampler. port=None reads
+    $PADDLE_TPU_METRICS_PORT, else binds port 0 (ephemeral; read
+    ``.port`` off the returned server). See monitor/export.py."""
+    return export.serve(port=port, host=host, **kw)
 
 
 # ---------------------------------------------------------------------------
@@ -260,6 +276,6 @@ def record_collective(op, axis_name, nbytes):
     emit(kind="collective", op=op, axis=axis, bytes=int(nbytes))
 
 
-# imported last: both submodules reach back into this namespace
+# imported last: the submodules reach back into this namespace
 # (gauge/emit/snapshot), which is fully populated by this point
-from . import trace, xla  # noqa: E402,F401
+from . import trace, xla, export, sampler  # noqa: E402,F401

@@ -1,0 +1,286 @@
+"""paddle_tpu.monitor.export — the live telemetry HTTP plane.
+
+Everything the monitor produced before this module is *post-hoc*: JSONL
+files and Perfetto dumps you read after the run dies. Production TPU
+serving (PAPERS.md: Gemma on Cloud TPU) and any SLO accounting need the
+*pull* model instead — Prometheus scrapes a ``/metrics`` endpoint, a
+load balancer probes ``/healthz``, an operator curls ``/snapshot`` —
+all while the run is alive. This is that surface, stdlib-only
+(``http.server``), off by default, and torn down by
+``monitor.disable()``:
+
+* ``GET /metrics``  — the whole Registry rendered live as
+  OpenMetrics/Prometheus text: counters → ``<name>_total``, gauges →
+  ``<name>``, histograms → cumulative ``_bucket{le=...}`` rows +
+  ``_sum``/``_count``. Dotted series names sanitize to underscores
+  (``executor.run`` → ``executor_run``).
+* ``GET /healthz``  — liveness + the resilience plane's verdicts:
+  watchdog stall state (HTTP 503 while a step is past its deadline),
+  NaN-guard trip counts, preemption flag. JSON body either way.
+* ``GET /snapshot`` — ``monitor.snapshot()`` as JSON plus the newest
+  flight-recorder directory, the JSONL sink path, and uptime.
+
+Arming it::
+
+    from paddle_tpu import monitor
+    monitor.enable()
+    srv = monitor.serve(port=9464)      # or port=0 for an ephemeral one
+    print(srv.url)                      # http://127.0.0.1:9464
+    ...
+    monitor.disable()                   # joins the server + sampler
+
+or zero-code via ``PADDLE_TPU_METRICS_PORT=9464`` (checked by
+``monitor.enable()``, so ``PADDLE_TPU_MONITOR=1`` + the port variable
+arm the whole plane from the environment).
+
+Cost discipline: until ``serve()`` is called there is no thread, no
+socket, and no hot-path check at all — the exporter reads the same
+Registry the instrumentation already writes; scrapes cost the writers
+nothing beyond normal lock acquisition.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import os
+import re
+import threading
+import time
+
+__all__ = [
+    "serve", "stop", "active", "port", "render_openmetrics",
+    "health_payload", "snapshot_payload", "MetricsServer",
+    "OPENMETRICS_CONTENT_TYPE",
+]
+
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+_lock = threading.Lock()
+_server = None
+_t_started = None
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name):
+    """Dotted registry name -> a legal Prometheus metric name."""
+    n = _NAME_RE.sub("_", str(name))
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _fmt(v):
+    """Prometheus float formatting: integers render bare (1, not 1.0)."""
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def render_openmetrics(registry=None):
+    """The whole registry as OpenMetrics text (ends with ``# EOF``).
+    Histograms render the full cumulative bucket ladder with a final
+    ``+Inf`` equal to ``_count``; sanitized-name collisions keep the
+    first metric and drop later ones (a scrape must stay parseable)."""
+    from .. import monitor as _mon
+    reg = registry if registry is not None else _mon.registry()
+    lines, seen = [], set()
+    for name, kind, payload in reg.collect():
+        n = _sanitize(name)
+        if n in seen:
+            continue
+        seen.add(n)
+        if kind == "counter":
+            lines.append(f"# TYPE {n} counter")
+            lines.append(f"{n}_total {_fmt(payload)}")
+        elif kind == "gauge":
+            if payload is None:
+                continue
+            lines.append(f"# TYPE {n} gauge")
+            lines.append(f"{n} {_fmt(payload)}")
+        elif kind == "histogram":
+            lines.append(f"# TYPE {n} histogram")
+            for bound, cum in payload["buckets"]:
+                lines.append(f'{n}_bucket{{le="{_fmt(bound)}"}} {cum}')
+            lines.append(f'{n}_bucket{{le="+Inf"}} {payload["inf"]}')
+            lines.append(f"{n}_sum {_fmt(payload['sum'])}")
+            lines.append(f"{n}_count {payload['count']}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def health_payload():
+    """(http_status, dict) for /healthz: 200 while healthy, 503 while
+    any running watchdog's in-flight step is past its deadline."""
+    from .. import monitor as _mon
+    from ..resilience import guard as _guard
+    from ..resilience import watchdog as _watchdog
+    from . import trace as _trace
+
+    wds = _watchdog.health()
+    stalled = any(h.get("stalled") for h in wds)
+    reg = _mon.registry()
+    payload = {
+        "status": "stalled" if stalled else "ok",
+        "pid": os.getpid(),
+        "uptime_s": (round(time.monotonic() - _t_started, 3)
+                     if _t_started is not None else None),
+        "monitor_enabled": _mon.enabled(),
+        "watchdogs": wds,
+        "watchdog_stalls": int(reg.value("resilience.watchdog_stall", 0)),
+        "nan_guard": {
+            "trips": _guard.total_trips(),
+            "nan_skip": int(reg.value("resilience.nan_skip", 0)),
+            "rollback": int(reg.value("resilience.rollback", 0)),
+            "nan_raise": int(reg.value("resilience.nan_raise", 0)),
+        },
+        "flight_dir": _trace.last_flight(),
+    }
+    return (503 if stalled else 200), payload
+
+
+def snapshot_payload():
+    """The /snapshot body: full registry snapshot + evidence pointers."""
+    from .. import monitor as _mon
+    from . import trace as _trace
+    return {
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "monitor_enabled": _mon.enabled(),
+        "jsonl": _mon.jsonl_path(),
+        "flight_dir": _trace.last_flight(),
+        "counters": _mon.snapshot(),
+    }
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-telemetry/1.0"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # a scrape per second is not a log line
+        pass
+
+    def _send(self, code, body, content_type):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, render_openmetrics(),
+                           OPENMETRICS_CONTENT_TYPE)
+            elif path == "/healthz":
+                code, payload = health_payload()
+                self._send(code, json.dumps(payload, default=str),
+                           "application/json")
+            elif path == "/snapshot":
+                self._send(200, json.dumps(snapshot_payload(),
+                                           default=str),
+                           "application/json")
+            elif path == "/":
+                self._send(200, "paddle_tpu telemetry: "
+                                "/metrics /healthz /snapshot\n",
+                           "text/plain; charset=utf-8")
+            else:
+                self._send(404, "not found\n",
+                           "text/plain; charset=utf-8")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-write
+        except Exception as e:  # noqa: BLE001 - a scrape must not crash
+            try:
+                self._send(500, f"telemetry error: {e!r}\n",
+                           "text/plain; charset=utf-8")
+            except Exception:
+                pass
+
+
+class MetricsServer:
+    """A ThreadingHTTPServer on a daemon thread. ``port=0`` binds an
+    ephemeral port (read it back from ``.port`` — the test-friendly
+    path). ``stop()`` shuts down, closes the socket, and joins."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = int(self._httpd.server_address[1])
+        self._thread = None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="paddle_tpu-metrics", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        try:
+            self._httpd.shutdown()
+        finally:
+            self._httpd.server_close()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+
+
+def serve(port=None, host="127.0.0.1", sampler=True,
+          sample_interval_s=None):
+    """Start (or return) the process's telemetry server. ``port=None``
+    reads $PADDLE_TPU_METRICS_PORT, else 0 (ephemeral). By default
+    also arms the periodic sampler so ``mem.*``/``slo.*`` gauges are
+    live. Returns the :class:`MetricsServer` (``.port``/``.url``).
+    Idempotent: a second call returns the running server unchanged."""
+    global _server, _t_started
+    with _lock:
+        if _server is not None:
+            return _server
+        if port is None:
+            env = os.environ.get("PADDLE_TPU_METRICS_PORT", "")
+            port = int(env) if env else 0
+        srv = MetricsServer(port=port, host=host).start()
+        _server = srv
+        _t_started = time.monotonic()
+    if sampler:
+        from . import sampler as _sampler
+        _sampler.start(interval_s=sample_interval_s)
+    from .. import monitor as _mon
+    _mon.emit(kind="metrics_server", action="serve", host=srv.host,
+              port=srv.port)
+    return srv
+
+
+def stop(timeout=5.0):
+    """Tear the server down (idempotent): shutdown + close socket +
+    join, so enable/disable cycles can't leak threads or ports. The
+    sampler singleton is stopped by ``monitor.disable()`` alongside
+    this."""
+    global _server
+    with _lock:
+        srv, _server = _server, None
+    if srv is not None:
+        srv.stop(timeout=timeout)
+
+
+def active():
+    """The running MetricsServer, or None."""
+    return _server
+
+
+def port():
+    """The bound port of the running server, or None — how tests (and
+    the export smoke gate) find an ephemeral ``port=0`` server."""
+    srv = _server
+    return srv.port if srv is not None else None

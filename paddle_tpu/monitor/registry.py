@@ -115,6 +115,20 @@ class Histogram:
     def mean(self):
         return self.sum / self.count if self.count else 0.0
 
+    def openmetrics(self):
+        """Cumulative-bucket view for the /metrics exporter: ordered
+        ``[(upper_bound, cumulative_count), ...]`` (every bound, even
+        empty ones — OpenMetrics `le` buckets must be monotonic and end
+        at +Inf) plus sum/count, read atomically under the lock."""
+        with self._lock:
+            counts = list(self._counts)
+            total, cum = 0, []
+            for i, b in enumerate(self.buckets):
+                total += counts[i]
+                cum.append((b, total))
+            return {"buckets": cum, "inf": total + counts[-1],
+                    "sum": self.sum, "count": self.count}
+
     def snapshot(self):
         out = {"count": self.count, "sum": self.sum, "min": self.min,
                "max": self.max}
@@ -174,6 +188,23 @@ class Registry:
         with self._lock:
             return {n: m.snapshot() for n, m in sorted(self._metrics.items())
                     if n.startswith(prefix)}
+
+    def collect(self):
+        """Exporter feed: ``[(name, kind, payload), ...]`` sorted by
+        name — scalar value for counters/gauges, the ``openmetrics()``
+        dict for histograms. The metric list is snapshotted under the
+        lock; per-metric reads then re-take it, so a scrape racing N
+        writer threads always sees each metric at some consistent
+        point (counters monotonic scrape-over-scrape)."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = []
+        for name, m in items:
+            if isinstance(m, Histogram):
+                out.append((name, m.kind, m.openmetrics()))
+            else:
+                out.append((name, m.kind, m.value))
+        return out
 
     def reset(self):
         with self._lock:

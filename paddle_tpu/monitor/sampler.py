@@ -1,0 +1,222 @@
+"""paddle_tpu.monitor.sampler — the periodic device/host/SLO sampler.
+
+Everything else in the monitor is *event-driven*: a counter ticks when
+a step runs, a gauge moves when a request completes. But the questions
+an operator asks a live run — "how close is HBM to the limit?", "is the
+host leaking?", "what's the queue depth *right now*?", "did qps really
+drop to zero or did the gauge just go stale?" — are about state, not
+events, and state must be *sampled*. This daemon publishes, every
+``interval_s`` (default 1s):
+
+* ``mem.device.<id>.{bytes_in_use,peak_bytes_in_use,bytes_limit}`` and
+  the cross-device totals ``mem.hbm_bytes_in_use`` /
+  ``mem.hbm_peak_bytes_in_use`` — the HBM watermarks, via
+  ``step.device_memory_stats()`` (empty per-device dicts on backends
+  that expose nothing, e.g. CPU)
+* ``mem.host.rss_bytes`` — resident set size of this process
+  (/proc/self/status VmRSS, falling back to getrusage peak)
+* registered queue-depth providers — ``prefetch.queue_depth`` (each
+  active ``prefetch_to_device``), ``serving.queue_depth`` (each live
+  ``ServingEngine``), ``inference.executables`` (each Predictor's
+  compiled-executable count)
+* the serving tier's derived series — the decaying ``serving.qps``
+  re-publish and the ``slo.{goodput,p50_ms,p99_ms}`` rollups — but
+  only when ``paddle_tpu.serving`` is already imported; the sampler
+  never drags the serving stack in
+
+Cost discipline: nothing here runs unless :func:`monitor.serve` (or an
+explicit :func:`start`) armed it — no thread, no provider calls, zero
+hot-path presence. Providers register on the cold path (one dict write
+per prefetch iterator / engine construction).
+
+Provider contract: ``fn() -> {series_name: number}`` publishes gauges;
+``fn() -> None`` (or raising) means the owner is gone and the provider
+is dropped. Register/unregister with
+:func:`register_provider` / :func:`unregister_provider`.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = [
+    "Sampler", "start", "stop", "active", "sample_once",
+    "register_provider", "unregister_provider",
+]
+
+DEFAULT_INTERVAL_S = 1.0
+
+_providers_lock = threading.Lock()
+_providers = {}           # key -> fn() -> {series: value} | None
+
+_lock = threading.Lock()
+_sampler = None           # the singleton started by monitor.serve()
+
+
+# ---------------------------------------------------------------------------
+# providers
+
+def register_provider(key, fn):
+    """Register a per-tick gauge source. Returns ``key`` (hand it to
+    :func:`unregister_provider`); re-registering a key replaces it."""
+    with _providers_lock:
+        _providers[str(key)] = fn
+    return str(key)
+
+
+def unregister_provider(key):
+    with _providers_lock:
+        _providers.pop(str(key), None)
+
+
+def _poll_providers(reg):
+    with _providers_lock:
+        items = list(_providers.items())
+    dead = []
+    for key, fn in items:
+        try:
+            series = fn()
+        except Exception:
+            series = None
+        if series is None:
+            dead.append(key)
+            continue
+        for name, value in series.items():
+            if value is not None:
+                reg.gauge(name).set(value)
+    if dead:
+        with _providers_lock:
+            for key in dead:
+                _providers.pop(key, None)
+
+
+# ---------------------------------------------------------------------------
+# the samples themselves
+
+def _host_rss_bytes():
+    """Linux VmRSS (current), else getrusage ru_maxrss (peak — still a
+    usable leak watermark), else None."""
+    try:
+        with open("/proc/self/status", encoding="ascii",
+                  errors="replace") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except Exception:
+        pass
+    try:
+        import resource
+        rss_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        # linux reports KiB, macOS bytes; normalize heuristically
+        return int(rss_kb) * (1 if rss_kb > 1 << 30 else 1024)
+    except Exception:
+        return None
+
+
+def sample_once(registry=None):
+    """One sampler tick (also callable synchronously from tests): HBM
+    watermarks, host RSS, registered queue depths, serving rollups.
+    Publishes into the process registry regardless of ``enabled()`` —
+    the scrape endpoint renders from the registry, and a pull-based
+    surface must answer even when event instrumentation is off."""
+    from .. import monitor as _mon
+    from .step import device_memory_stats
+    reg = registry if registry is not None else _mon.registry()
+
+    mem = device_memory_stats()
+    total_use = total_peak = 0
+    have_hbm = False
+    for did, stats in mem.items():
+        for key, value in stats.items():
+            reg.gauge(f"mem.device.{did}.{key}").set(value)
+        if "bytes_in_use" in stats:
+            have_hbm = True
+            total_use += stats["bytes_in_use"]
+            total_peak += stats.get("peak_bytes_in_use",
+                                    stats["bytes_in_use"])
+    if have_hbm:
+        reg.gauge("mem.hbm_bytes_in_use").set(total_use)
+        reg.gauge("mem.hbm_peak_bytes_in_use").set(total_peak)
+
+    rss = _host_rss_bytes()
+    if rss is not None:
+        reg.gauge("mem.host.rss_bytes").set(rss)
+
+    _poll_providers(reg)
+
+    # serving rollups only if the serving tier is actually loaded
+    import sys
+    smetrics = sys.modules.get("paddle_tpu.serving.metrics")
+    if smetrics is not None:
+        try:
+            smetrics.publish_rollups()
+        except Exception:
+            pass
+
+
+class Sampler:
+    """Daemon thread calling :func:`sample_once` every ``interval_s``.
+    ``stop()`` joins with a timeout so enable/disable cycles in tests
+    can't leak threads."""
+
+    def __init__(self, interval_s=DEFAULT_INTERVAL_S):
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="paddle_tpu-sampler", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self):
+        # first sample immediately: a scrape right after serve() should
+        # already see mem.* gauges, not wait out an interval
+        while True:
+            try:
+                sample_once()
+            except Exception:
+                pass  # a flaky backend must not kill the sampler
+            if self._stop.wait(self.interval_s):
+                return
+
+
+# ---------------------------------------------------------------------------
+# module-level singleton (owned by monitor.serve / monitor.disable)
+
+def start(interval_s=None):
+    """Start (or return) the process sampler singleton."""
+    global _sampler
+    if interval_s is None:
+        env = os.environ.get("PADDLE_TPU_SAMPLER_INTERVAL_S", "")
+        interval_s = float(env) if env else DEFAULT_INTERVAL_S
+    with _lock:
+        if _sampler is None:
+            _sampler = Sampler(interval_s=interval_s).start()
+        return _sampler
+
+
+def stop(timeout=5.0):
+    """Stop and join the singleton (idempotent)."""
+    global _sampler
+    with _lock:
+        s, _sampler = _sampler, None
+    if s is not None:
+        s.stop(timeout=timeout)
+
+
+def active():
+    return _sampler

@@ -69,9 +69,17 @@ def mfu(flops_per_step, step_time_s, peak_flops=None):
     return flops_per_step / step_time_s / peak
 
 
+_mem_stats_warned = False
+
+
 def device_memory_stats():
-    """bytes_in_use / peak_bytes_in_use per local device; {} where the
-    backend exposes nothing (CPU returns None)."""
+    """bytes_in_use / peak_bytes_in_use / bytes_limit per local device.
+    A backend that exposes nothing (CPU's ``memory_stats()`` returns
+    None; some return dicts missing the HBM keys) contributes an EMPTY
+    per-device dict instead of being dropped or raising — callers can
+    still enumerate devices, and the degradation is warned exactly once
+    per process."""
+    global _mem_stats_warned
     import jax
     out = {}
     try:
@@ -79,15 +87,24 @@ def device_memory_stats():
     except Exception:
         return out
     for d in devices:
+        entry = {}
         try:
             stats = d.memory_stats()
+            if stats:
+                entry = {k: stats[k]
+                         for k in ("bytes_in_use", "peak_bytes_in_use",
+                                   "bytes_limit") if k in stats}
         except Exception:
-            stats = None
-        if not stats:
-            continue
-        out[str(d.id)] = {
-            k: stats[k] for k in ("bytes_in_use", "peak_bytes_in_use",
-                                  "bytes_limit") if k in stats}
+            entry = {}
+        if not entry and not _mem_stats_warned:
+            _mem_stats_warned = True
+            import warnings
+            warnings.warn(
+                f"device_memory_stats: device {d} "
+                f"({getattr(d, 'device_kind', '?')}) exposes no memory "
+                "stats (expected on CPU backends); its entries will be "
+                "empty dicts")
+        out[str(d.id)] = entry
     return out
 
 
@@ -196,7 +213,7 @@ class StepMonitor:
         rec.update(extra)
         if self.steps % self.memory_every == 0 or self.steps == 1:
             mem = device_memory_stats()
-            if mem:
+            if any(mem.values()):  # all-empty dicts (CPU) stay out
                 rec["device_memory"] = mem
         self.records.append(rec)
         if enabled():

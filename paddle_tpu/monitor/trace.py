@@ -58,7 +58,7 @@ import time
 __all__ = [
     "enable", "disable", "enabled", "clear", "span", "complete",
     "instant", "traced", "events", "export_chrome_trace",
-    "flight_record",
+    "flight_record", "last_flight",
 ]
 
 DEFAULT_BUFFER = 65536
@@ -73,6 +73,14 @@ _t0 = 0.0                   # perf_counter origin for export timestamps
 _wall0 = 0.0                # wall clock at enable (for correlation)
 _flight_lock = threading.Lock()
 _flight_dumps = 0
+_last_flight = None         # newest flight-recorder dir (/snapshot shows it)
+
+
+def last_flight():
+    """Path of the most recent flight-recorder dump this process wrote,
+    or None — the /snapshot health endpoint's pointer to post-mortem
+    evidence."""
+    return _last_flight
 
 
 # ---------------------------------------------------------------------------
@@ -109,10 +117,11 @@ def disable():
 
 
 def clear():
-    global _flight_dumps
+    global _flight_dumps, _last_flight
     _events.clear()
     _thread_names.clear()
     _flight_dumps = 0
+    _last_flight = None
 
 
 def _note_thread(tid):
@@ -373,6 +382,8 @@ def flight_record(reason, step=None, directory=None, extra=None):
 
         _memit(kind="flight_record", reason=str(reason), step=step,
                path=d)
+        global _last_flight
+        _last_flight = d
         return d
     except Exception:
         return None

@@ -45,6 +45,17 @@ class NonFiniteError(FloatingPointError):
 
 _state = threading.local()
 
+# process-wide non-finite trips across every guard instance (guards are
+# per-loop and thread-local; /healthz needs the whole process's count)
+_trips_lock = threading.Lock()
+_total_trips = 0
+
+
+def total_trips():
+    """Total non-finite events any NaNGuard in this process has seen
+    (skip + rollback + raise), for the /healthz endpoint."""
+    return _total_trips
+
 
 def active():
     """The innermost installed guard, or None (checked by
@@ -133,6 +144,9 @@ class NaNGuard:
                       optimizer=None, program=None, where="train"):
         self.consecutive += 1
         self.total_nonfinite += 1
+        global _total_trips
+        with _trips_lock:
+            _total_trips += 1
         if self.policy == "raise":
             record("nan_raise", step=step, where=where)
             raise NonFiniteError(

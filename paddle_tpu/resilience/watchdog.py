@@ -26,9 +26,21 @@ from __future__ import annotations
 import collections
 import threading
 import time
+import weakref
 
 from .. import monitor as _monitor
 from ._common import record
+
+# every started watchdog, for the /healthz endpoint — weak so an
+# abandoned watchdog never outlives its owner through this set
+_ACTIVE = weakref.WeakSet()
+
+
+def health():
+    """Health snapshots of every running watchdog (the monitor export
+    server's /healthz feed): a list of :meth:`Watchdog.health` dicts.
+    Empty list = no watchdog armed (liveness only, no stall signal)."""
+    return [wd.health() for wd in list(_ACTIVE)]
 
 
 class Watchdog:
@@ -90,6 +102,31 @@ class Watchdog:
         """Context manager bracketing one training step."""
         return Watchdog._StepScope(self, step_id)
 
+    # -- health introspection -------------------------------------------------
+
+    def health(self):
+        """Point-in-time health: whether a step is in flight, how long
+        it has run vs the current deadline, and the cumulative stall
+        count. ``stalled`` is live (the in-flight step is past deadline
+        RIGHT NOW), independent of whether the watcher thread has
+        flagged it yet — /healthz must flip the moment the SLA is
+        blown, not a poll interval later."""
+        with self._lock:
+            cur = self._current
+        deadline = self.deadline()
+        out = {"running": self._thread is not None
+               and self._thread.is_alive(),
+               "stall_count": self.stall_count,
+               "deadline_s": deadline,
+               "in_step": cur is not None,
+               "stalled": False}
+        if cur is not None:
+            step_id, t0 = cur
+            elapsed = time.monotonic() - t0
+            out.update(step=step_id, elapsed_s=elapsed,
+                       stalled=elapsed > deadline)
+        return out
+
     # -- the watcher thread ---------------------------------------------------
 
     def start(self):
@@ -98,6 +135,7 @@ class Watchdog:
             self._thread = threading.Thread(
                 target=self._watch, name="paddle_tpu-watchdog", daemon=True)
             self._thread.start()
+        _ACTIVE.add(self)
         return self
 
     def stop(self):
@@ -105,6 +143,7 @@ class Watchdog:
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None
+        _ACTIVE.discard(self)
 
     def __enter__(self):
         return self.start()

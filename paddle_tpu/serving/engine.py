@@ -72,11 +72,16 @@ class ServingEngine:
         failures.
     start : launch the drain thread now (False = tests drive it
         manually via ``.start()``).
+    metrics_port : also start ``monitor.serve(port=metrics_port)`` —
+        the live /metrics + /healthz + /snapshot endpoint (0 picks an
+        ephemeral port; ``monitor.export.port()`` tells you which).
+        The server is process-global and outlives this engine;
+        ``monitor.disable()`` tears it down.
     """
 
     def __init__(self, predictor, buckets=None, max_batch=32,
                  timeout_ms=5.0, queue_depth=256, deadline_ms=None,
-                 retry_policy=None, start=True):
+                 retry_policy=None, start=True, metrics_port=None):
         self.predictor = predictor
         self.max_batch = int(max_batch)
         if self.max_batch < 1:
@@ -103,6 +108,26 @@ class ServingEngine:
                        "rejected": 0, "expired": 0, "batches": 0,
                        "coalesced_rows": 0, "padded_rows": 0,
                        "compiles": 0, "retries": 0, "isolated": 0}
+        # live-telemetry wiring: the sampler republishes this engine's
+        # queue depth each tick (a gauge set only at enqueue/dequeue
+        # edges goes stale the moment traffic stops), weakly so an
+        # un-closed engine can still be collected
+        import weakref
+        from ..monitor import sampler as _sampler
+        ref = weakref.ref(self)
+
+        def _depth_series():
+            eng = ref()
+            if eng is None:
+                return None  # provider dies with the engine
+            return {"serving.queue_depth": eng._batcher.depth()}
+
+        self._sampler_key = _sampler.register_provider(
+            f"serving-engine-{id(self)}", _depth_series)
+        if metrics_port is not None:
+            # serve-while-serving: expose /metrics + /healthz for the
+            # lifetime of the process (monitor.disable() tears it down)
+            _monitor.serve(port=metrics_port)
         if start:
             self.start()
 
@@ -180,6 +205,8 @@ class ServingEngine:
 
     def close(self, drain=True, timeout=None):
         self._batcher.close(drain=drain, timeout=timeout)
+        from ..monitor import sampler as _sampler
+        _sampler.unregister_provider(self._sampler_key)
 
     def __enter__(self):
         self.start()
@@ -312,11 +339,16 @@ class ServingEngine:
                 # the whole thing — documented in docs/serving.md
                 per_out_chunks.append([a] * len(requests))
         now = time.monotonic()
-        latencies = []
+        latencies, within = [], []
         for j, r in enumerate(requests):
             vals = [chunks[j] for chunks in per_out_chunks]
             r.resolve_result(list(vals) if multi else vals[0])
             latencies.append(r.age(now) * 1e3)
-        metrics.record_completed(len(requests), latencies)
+            # the slo.* goodput numerator: resolved before its SLA ran
+            # out (no deadline = always within)
+            within.append(r.deadline is None
+                          or not r.deadline.expired(now))
+        metrics.record_completed(len(requests), latencies,
+                                 within_sla=within)
         with self._stats_lock:
             self._stats["completed"] += len(requests)

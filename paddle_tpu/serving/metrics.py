@@ -26,6 +26,18 @@ the serving pipeline shows up as:
   transient batch retries, batches re-run request-by-request after a
   terminal failure, and the requests that individually failed
 
+SLO rollups (published by the telemetry sampler via
+:func:`publish_rollups`, rolling :data:`SLO_WINDOW_S` window):
+
+* ``slo.goodput``  — completions within deadline ÷ submissions
+* ``slo.p50_ms`` / ``slo.p99_ms`` — service-latency percentiles
+* ``slo.window_submitted`` / ``slo.window_within_sla`` — the raw
+  window tallies behind the ratio
+
+``serving.qps`` decays to 0 when traffic stops: the sampler calls
+:func:`qps_now` each tick, which sweeps stale window entries instead
+of waiting for a next completion that never comes.
+
 Span sites (``monitor.trace``): ``serving.enqueue``,
 ``serving.batch_assemble``, ``serving.execute``, ``serving.scatter``,
 ``serving.warmup`` — the Perfetto view of queue→batch→MXU.
@@ -41,15 +53,25 @@ from ..io.bucketing import batch_mask
 
 #: rolling window for the serving.qps gauge
 QPS_WINDOW_S = 10.0
+#: rolling window for the slo.* goodput / latency-percentile gauges
+SLO_WINDOW_S = 60.0
 
 _qps_lock = threading.Lock()
 _qps_window = collections.deque()   # (t_monotonic, n_completed)
+
+_slo_lock = threading.Lock()
+_slo_submits = collections.deque()  # t_monotonic per submitted request
+_slo_done = collections.deque()     # (t, latency_ms|None, within_sla)
 
 
 def record_submit(n_rows):
     if _monitor.enabled():
         _monitor.counter("serving.requests").inc()
         _monitor.counter("serving.rows").inc(int(n_rows))
+        now = time.monotonic()
+        with _slo_lock:
+            _slo_submits.append(now)
+            _sweep(_slo_submits, now, SLO_WINDOW_S, key=lambda t: t)
 
 
 def record_queue_depth(depth):
@@ -67,6 +89,12 @@ def record_expired():
     if _monitor.enabled():
         _monitor.counter("serving.deadline_expired").inc()
         _monitor.emit(kind="serving", event="deadline_expired")
+        now = time.monotonic()
+        with _slo_lock:
+            # an expired request is a completed-OUTSIDE-SLA outcome for
+            # goodput; it has no service latency to histogram
+            _slo_done.append((now, None, False))
+            _sweep(_slo_done, now, SLO_WINDOW_S)
 
 
 def record_batch(real_rows, bucket_rows, n_requests):
@@ -80,9 +108,11 @@ def record_batch(real_rows, bucket_rows, n_requests):
         _monitor.counter("serving.pad_rows").inc(int(bucket_rows - real_rows))
 
 
-def record_completed(n_requests, latencies_ms):
-    """Per-batch completion: latency histogram per request + the rolling
-    QPS gauge."""
+def record_completed(n_requests, latencies_ms, within_sla=None):
+    """Per-batch completion: latency histogram per request, the rolling
+    QPS gauge, and the slo.* window. ``within_sla`` is a per-request
+    bool list (completed before its deadline; None = no deadlines in
+    play, every completion counts as within)."""
     if not _monitor.enabled():
         return
     h = _monitor.histogram("serving.latency_ms")
@@ -91,11 +121,97 @@ def record_completed(n_requests, latencies_ms):
     now = time.monotonic()
     with _qps_lock:
         _qps_window.append((now, int(n_requests)))
-        while _qps_window and now - _qps_window[0][0] > QPS_WINDOW_S:
-            _qps_window.popleft()
-        total = sum(k for _, k in _qps_window)
-        elapsed = max(now - _qps_window[0][0], 0.5)
-    _monitor.gauge("serving.qps").set(round(total / elapsed, 3))
+        _set_qps_locked(now)
+    with _slo_lock:
+        for i, ms in enumerate(latencies_ms):
+            ok = True if within_sla is None else bool(within_sla[i])
+            _slo_done.append((now, float(ms), ok))
+        _sweep(_slo_done, now, SLO_WINDOW_S)
+
+
+def _sweep(dq, now, horizon, key=lambda item: item[0]):
+    """Drop window entries older than ``horizon`` (callers hold the
+    window's lock)."""
+    while dq and now - key(dq[0]) > horizon:
+        dq.popleft()
+
+
+def _set_qps_locked(now):
+    _sweep(_qps_window, now, QPS_WINDOW_S)
+    if not _qps_window:
+        _monitor.gauge("serving.qps").set(0.0)
+        return 0.0
+    total = sum(k for _, k in _qps_window)
+    elapsed = max(now - _qps_window[0][0], 0.5)
+    val = round(total / elapsed, 3)
+    _monitor.gauge("serving.qps").set(val)
+    return val
+
+
+def qps_now(now=None):
+    """Sweep the rolling window and re-publish ``serving.qps`` from
+    what's left — when traffic stops, the stale entries age out HERE
+    instead of waiting for a next completion that never comes, so the
+    gauge decays to 0. Called by the telemetry sampler each tick; safe
+    to call from anywhere."""
+    if not _monitor.enabled():
+        return 0.0
+    now = time.monotonic() if now is None else now
+    with _qps_lock:
+        return _set_qps_locked(now)
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def slo_rollup(now=None):
+    """Rolling-window SLO accounting over the last :data:`SLO_WINDOW_S`
+    seconds: ``goodput`` = completions within deadline ÷ submissions
+    (expired requests count against it; an in-flight backlog does too,
+    which is the honest reading under overload), plus p50/p99 service
+    latency. Returns the dict and, when the monitor is enabled,
+    publishes it as ``slo.*`` gauges."""
+    now = time.monotonic() if now is None else now
+    with _slo_lock:
+        _sweep(_slo_submits, now, SLO_WINDOW_S, key=lambda t: t)
+        _sweep(_slo_done, now, SLO_WINDOW_S)
+        submitted = len(_slo_submits)
+        done = list(_slo_done)
+    ok = sum(1 for _, _, w in done if w)
+    lats = sorted(ms for _, ms, _ in done if ms is not None)
+    out = {"window_s": SLO_WINDOW_S, "submitted": submitted,
+           "completed": len(lats), "within_sla": ok,
+           "goodput": (ok / submitted) if submitted else None,
+           "p50_ms": _percentile(lats, 0.50),
+           "p99_ms": _percentile(lats, 0.99)}
+    if _monitor.enabled():
+        for key in ("goodput", "p50_ms", "p99_ms"):
+            if out[key] is not None:
+                _monitor.gauge(f"slo.{key}").set(out[key])
+        _monitor.gauge("slo.window_submitted").set(submitted)
+        _monitor.gauge("slo.window_within_sla").set(ok)
+    return out
+
+
+def publish_rollups(now=None):
+    """One sampler tick's worth of derived series: the decaying
+    ``serving.qps`` gauge plus the ``slo.*`` rollup."""
+    qps_now(now)
+    return slo_rollup(now)
+
+
+def reset_windows():
+    """Empty every rolling window (test isolation)."""
+    with _qps_lock:
+        _qps_window.clear()
+    with _slo_lock:
+        _slo_submits.clear()
+        _slo_done.clear()
 
 
 def record_compiles(n=1):

@@ -1,0 +1,307 @@
+"""Perf regression sentinel — the ledger's tripwire.
+
+The repo banks real on-chip measurements (``BENCH_r0*.json`` round
+artifacts, ``docs/bench_r04_measured.json`` /
+``docs/bench_latest_measured.json`` committed snapshots, and the
+``$PADDLE_TPU_BENCH_JSONL`` running artifact bench.py appends to). This
+script compares the NEWEST candidate measurement against the newest
+*committed* baseline, per metric, with per-metric tolerance bands — and
+exits nonzero iff something actually regressed.
+
+Three verdicts per metric, and the distinction is the whole point:
+
+* ``regression`` — a real number moved past its tolerance band in the
+  bad direction. Exit 1.
+* ``ok`` / ``improved`` — within band, or moved the good way. A better
+  candidate also prints a nudge to re-bank the baseline.
+* ``outage``  — the candidate is an error line (``value == 0`` with an
+  ``error`` field: the chip-tunnel wedge this environment documents in
+  ROADMAP.md). That is NOT a perf regression — the metric is SKIPPED,
+  loudly, and does not fail the gate. Zero-throughput-without-error
+  still trips: a silent zero is a regression, not an outage.
+
+Only the newest round is a candidate: older rounds are history (they
+were legitimately slower than today's baseline) and serve solely as
+baseline sources. A candidate older than the baseline it would be
+judged against is skipped for the same reason.
+
+Usage::
+
+    python scripts/perf_sentinel.py                  # audit the repo
+    python scripts/perf_sentinel.py --candidate f.json --baseline g.json
+    python scripts/perf_sentinel.py --jsonl /tmp/bench.jsonl
+    python scripts/perf_sentinel.py --tolerance 0.2  # widen every band
+
+Exit codes: 0 clean (incl. outage-skips and "no comparable data"),
+1 regression(s), 2 bad invocation/unreadable input.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+# (name, candidate keys tried in order, baseline keys tried in order,
+#  direction, default tolerance). Throughputs get the ISSUE's 10%;
+# serving latency/qps run wider — a shared CI box breathes harder than
+# an MXU.
+METRICS = [
+    ("bert_tokens_per_sec",
+     ("bert_base_seq128_tokens_per_sec", "value"),
+     ("bert_base_seq128_tokens_per_sec", "value"), "higher", 0.10),
+    ("resnet50_images_per_sec",
+     ("resnet50_images_per_sec",), ("resnet50_images_per_sec",),
+     "higher", 0.10),
+    ("loader_images_per_sec",
+     ("loader_images_per_sec", "loader_only_images_per_sec"),
+     ("loader_images_per_sec", "loader_only_images_per_sec"),
+     "higher", 0.15),
+    ("bert_seq512_tokens_per_sec",
+     ("bert_seq512_tokens_per_sec",), ("bert_seq512_tokens_per_sec",),
+     "higher", 0.10),
+    ("bert_seq2048_tokens_per_sec",
+     ("bert_seq2048_tokens_per_sec",), ("bert_seq2048_tokens_per_sec",),
+     "higher", 0.10),
+    ("serving_qps", ("serving_qps", "qps"), ("serving_qps", "qps"),
+     "higher", 0.25),
+    ("serving_p99_ms", ("serving_p99_ms", "p99_ms"),
+     ("serving_p99_ms", "p99_ms"), "lower", 0.50),
+]
+
+
+def _load_json(path):
+    with open(path) as fh:
+        return json.load(fh)
+
+
+def _first(blob, keys):
+    for k in keys:
+        v = blob.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return float(v)
+    return None
+
+
+def _is_outage(blob):
+    """An error line whose numbers are the zeros of a dead tunnel, not
+    of slow code: headline value 0/absent AND an explicit error."""
+    if not blob.get("error"):
+        return False
+    return not _first(blob, ("value",
+                             "bert_base_seq128_tokens_per_sec"))
+
+
+def _measurement_blob(raw):
+    """Normalize any supported artifact into one flat metric dict.
+
+    * driver round files ({n, cmd, rc, tail, parsed}) -> parsed (which
+      may be None: rc!=0 with no JSON line — treated as an outage line)
+    * bench stdout/JSONL lines and committed snapshots -> as-is
+    """
+    if not isinstance(raw, dict):
+        return None
+    if "parsed" in raw and "cmd" in raw:
+        parsed = raw.get("parsed")
+        if parsed is None:
+            # the round produced no JSON line at all (e.g. BENCH_r03's
+            # raw-traceback round): outage-shaped by construction
+            return {"value": 0.0,
+                    "error": f"round emitted no parseable result "
+                             f"(rc={raw.get('rc')})"}
+        return parsed
+    return raw
+
+
+def _last_jsonl_line(path):
+    last = None
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                last = json.loads(line)
+            except ValueError:
+                continue
+    return last
+
+
+def _round_files(root):
+    """BENCH_r*.json sorted oldest->newest by round number."""
+    def key(p):
+        import re
+        m = re.search(r"_r(\d+)", os.path.basename(p))
+        return int(m.group(1)) if m else -1
+    return sorted(glob.glob(os.path.join(root, "BENCH_r*.json")), key=key)
+
+
+def discover_baseline(root):
+    """The newest committed measurement, searched newest-first:
+    ``last_committed_measurement`` banked inside the newest round
+    files, then docs/bench_latest_measured.json, then the r4 snapshot.
+    Returns (blob, provenance-string) or (None, None)."""
+    for path in reversed(_round_files(root)):
+        try:
+            blob = _measurement_blob(_load_json(path))
+        except Exception:
+            continue
+        if not blob:
+            continue
+        lcm = blob.get("last_committed_measurement")
+        if isinstance(lcm, dict) and _first(
+                lcm, ("bert_base_seq128_tokens_per_sec", "value")):
+            src = blob.get("last_committed_measurement_file") or path
+            return lcm, f"{os.path.basename(path)} -> {src}"
+        # a round that itself measured real numbers IS the baseline
+        if not _is_outage(blob) and _first(
+                blob, ("value", "bert_base_seq128_tokens_per_sec")):
+            return blob, os.path.basename(path)
+    for rel in ("docs/bench_latest_measured.json",
+                "docs/bench_r04_measured.json"):
+        path = os.path.join(root, rel)
+        if os.path.exists(path):
+            try:
+                return _load_json(path), rel
+            except Exception:
+                continue
+    return None, None
+
+
+def discover_candidate(root, jsonl_paths=()):
+    """The newest measurement to judge: the last line of any given
+    JSONL artifact (newest file wins), else $PADDLE_TPU_BENCH_JSONL,
+    else the newest BENCH_r*.json round. Returns (blob, provenance)."""
+    paths = [p for p in jsonl_paths if p and os.path.exists(p)]
+    env = os.environ.get("PADDLE_TPU_BENCH_JSONL", "")
+    if not paths and env and os.path.exists(env):
+        paths = [env]
+    if paths:
+        newest = max(paths, key=os.path.getmtime)
+        blob = _last_jsonl_line(newest)
+        if blob is not None:
+            return _measurement_blob(blob), newest
+    rounds = _round_files(root)
+    if rounds:
+        path = rounds[-1]
+        try:
+            return _measurement_blob(_load_json(path)), \
+                os.path.basename(path)
+        except Exception as e:
+            raise SystemExit(f"perf_sentinel: unreadable {path}: {e}")
+    return None, None
+
+
+def compare(candidate, baseline, tolerance=None):
+    """Per-metric verdicts. Returns a list of dicts
+    {metric, verdict, candidate, baseline, band} where verdict is one
+    of regression/ok/improved/outage/no_data."""
+    out = []
+    outage = _is_outage(candidate)
+    for name, ckeys, bkeys, direction, tol in METRICS:
+        tol = tolerance if tolerance is not None else tol
+        base = _first(baseline, bkeys)
+        cand = _first(candidate, ckeys)
+        row = {"metric": name, "candidate": cand, "baseline": base,
+               "direction": direction, "tolerance": tol}
+        if base is None or cand is None:
+            row["verdict"] = "no_data"
+        elif outage and not cand:
+            # zero riding an error line: the tunnel died, the code
+            # didn't get slower — skip, don't fail
+            row["verdict"] = "outage"
+        elif direction == "higher":
+            floor = base * (1.0 - tol)
+            row["band"] = round(floor, 3)
+            row["verdict"] = ("regression" if cand < floor else
+                              "improved" if cand > base else "ok")
+        else:
+            ceil = base * (1.0 + tol)
+            row["band"] = round(ceil, 3)
+            row["verdict"] = ("regression" if cand > ceil else
+                              "improved" if cand < base else "ok")
+        out.append(row)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--repo-root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--candidate", default=None,
+                    help="explicit candidate measurement JSON file "
+                         "(default: newest JSONL artifact / round file)")
+    ap.add_argument("--baseline", default=None,
+                    help="explicit baseline JSON file (default: newest "
+                         "committed measurement)")
+    ap.add_argument("--jsonl", action="append", default=[],
+                    help="bench/smoke JSONL artifact; last parseable "
+                         "line is the candidate (repeatable)")
+    ap.add_argument("--tolerance", type=float, default=None,
+                    help="override every per-metric band (fraction, "
+                         "e.g. 0.1)")
+    args = ap.parse_args(argv)
+    root = args.repo_root
+
+    try:
+        if args.baseline:
+            baseline, base_src = _load_json(args.baseline), args.baseline
+        else:
+            baseline, base_src = discover_baseline(root)
+        if args.candidate:
+            candidate = _measurement_blob(_load_json(args.candidate))
+            cand_src = args.candidate
+        else:
+            candidate, cand_src = discover_candidate(root, args.jsonl)
+    except SystemExit:
+        raise
+    except Exception as e:
+        print(f"perf_sentinel: cannot read inputs: {e}", file=sys.stderr)
+        return 2
+
+    if baseline is None:
+        print(json.dumps({"sentinel": "perf", "ok": True,
+                          "note": "no committed baseline found; "
+                                  "nothing to compare"}))
+        return 0
+    if candidate is None:
+        print(json.dumps({"sentinel": "perf", "ok": True,
+                          "note": "no candidate measurement found; "
+                                  "nothing to compare"}))
+        return 0
+
+    rows = compare(candidate, baseline, tolerance=args.tolerance)
+    regressions = [r for r in rows if r["verdict"] == "regression"]
+    improved = [r for r in rows if r["verdict"] == "improved"]
+    outages = [r for r in rows if r["verdict"] == "outage"]
+
+    for r in rows:
+        if r["verdict"] == "no_data":
+            continue
+        mark = {"regression": "FAIL", "outage": "SKIP",
+                "improved": "  up", "ok": "  ok"}[r["verdict"]]
+        band = f" (band {r.get('band')})" if "band" in r else ""
+        print(f"[{mark}] {r['metric']}: {r['candidate']} vs baseline "
+              f"{r['baseline']}{band}", file=sys.stderr)
+    if outages:
+        err = str(candidate.get("error", ""))[:160]
+        print(f"[note] outage-shaped candidate (error: {err}) — "
+              f"{len(outages)} metric(s) skipped, not failed",
+              file=sys.stderr)
+    if improved and not regressions:
+        print("[note] candidate beats the baseline — consider re-banking "
+              "docs/bench_latest_measured.json", file=sys.stderr)
+
+    print(json.dumps({
+        "sentinel": "perf", "ok": not regressions,
+        "candidate": cand_src, "baseline": base_src,
+        "regressions": regressions,
+        "verdicts": {r["metric"]: r["verdict"] for r in rows
+                     if r["verdict"] != "no_data"},
+    }))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -40,6 +40,23 @@ bash scripts/serving_smoke.sh "$MONITOR_DIR/serving_smoke"
 srv=$?
 [ $srv -ne 0 ] && rc=$((rc == 0 ? srv : rc))
 
+# telemetry gate: scrape /metrics + /healthz mid-fit (OpenMetrics with
+# executor/prefetch/mem_* series, live watchdog state), clean teardown
+echo ""
+echo "-- export smoke gate --"
+bash scripts/export_smoke.sh "$MONITOR_DIR/export_smoke"
+exp=$?
+[ $exp -ne 0 ] && rc=$((rc == 0 ? exp : rc))
+
+# final gate: the perf regression sentinel over the repo's banked bench
+# artifacts — nonzero iff a real measurement fell out of its tolerance
+# band (outage-shaped zero/error lines are skipped, not failed)
+echo ""
+echo "-- perf sentinel gate --"
+python scripts/perf_sentinel.py
+sen=$?
+[ $sen -ne 0 ] && rc=$((rc == 0 ? sen : rc))
+
 latest=$(ls -t "$MONITOR_DIR"/events-*.jsonl 2>/dev/null | head -1)
 echo ""
 echo "monitor JSONL: ${latest:-<none written>} (dir: $MONITOR_DIR)"

@@ -45,7 +45,11 @@ def _run(cmd, log_name, timeout_s):
                              if proc.stderr else "")
         rc = proc.returncode
     except subprocess.TimeoutExpired as e:
-        out = (e.stdout or "") + f"\n--- TIMEOUT after {timeout_s}s ---\n"
+        # TimeoutExpired carries raw bytes even under text=True
+        partial = e.stdout or ""
+        if isinstance(partial, bytes):
+            partial = partial.decode("utf-8", "replace")
+        out = partial + f"\n--- TIMEOUT after {timeout_s}s ---\n"
         rc = -1
     header = (f"# cmd: {' '.join(cmd)}\n# rc: {rc}"
               f"  wall: {time.time() - t0:.0f}s"
