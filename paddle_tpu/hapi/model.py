@@ -271,6 +271,11 @@ class Model(Layer):
         if cm is not None:
             from ..resilience.preempt import PreemptionHandler
             handler = PreemptionHandler().install()
+            # arm the signal-path flush: a real SIGTERM saves the last
+            # completed step immediately, in case the grace window ends
+            # before this loop reaches its next boundary
+            handler.attach(cm, save_fn=lambda s: cm.save(
+                s, model=self, optimizer=self._optimizer))
         if nan_guard is not None:
             nan_guard.install()
         if wd is not None:
@@ -292,9 +297,10 @@ class Model(Layer):
                         continue
                     cblist.call("on_train_batch_begin", step)
                     ins, labs = self._split_batch(batch)
-                    if _faults.enabled() and _faults.fire("nan_grad",
-                                                          global_step):
-                        ins = [self._poison(ins[0])] + list(ins[1:])
+                    if _faults.enabled():
+                        _faults.maybe_raise("host_loss", global_step)
+                        if _faults.fire("nan_grad", global_step):
+                            ins = [self._poison(ins[0])] + list(ins[1:])
                     wd_ctx = wd.step(global_step) if wd is not None else None
                     try:
                         if wd_ctx is not None:
@@ -321,6 +327,8 @@ class Model(Layer):
                             self._train_step = None
                     if ok:
                         losses.append(loss)
+                    if handler is not None:
+                        handler.notify_step(global_step)
                     cblist.call("on_train_batch_end", step, {
                         "loss": loss,
                         "batch_size": ins[0].shape[0] if hasattr(
@@ -330,7 +338,11 @@ class Model(Layer):
                             _faults.fire("preempt", global_step))
                     if cm is not None and (preempted or (
                             save_steps and
-                            (global_step + 1) % save_steps == 0)):
+                            (global_step + 1) % save_steps == 0)) and (
+                            handler is None or
+                            handler.flushed_step != global_step):
+                        # (a signal-path flush may already have saved
+                        # exactly this step — don't save it twice)
                         cm.save(global_step, model=self,
                                 optimizer=self._optimizer)
                         if preempted:

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import shutil
 import threading
 import time
 import queue as _queue
@@ -183,18 +184,41 @@ class CheckpointManager:
     — unreadable or checksum-mismatched files are warned about, skipped
     and (on restore) quarantined to ``*.corrupt`` with a
     ``resilience.ckpt_quarantine`` event, falling back to the newest
-    checkpoint that does load. Checkpoint I/O retries transient OS
-    errors under resilience.retry.
+    checkpoint that does load. A checkpoint whose fresh ``.tmp`` staging
+    file/dir is still warm (< ``in_progress_grace`` seconds old) is a
+    save in progress — skipped silently, not warned about. Checkpoint
+    I/O retries transient OS errors under resilience.retry.
+
+    ``sharded=True`` switches saves to the per-shard format of
+    :mod:`paddle_tpu.io.sharded`: every process writes only the pytree
+    leaves it owns (keyed by their live ``NamedSharding`` layout) into a
+    ``ckpt-{step}.sharded/`` directory with a checksummed manifest, and
+    ``restore()`` reassembles + reshards the state onto the *current*
+    mesh even when its dp×tp topology differs from the one that saved.
+    Validation is a quorum rule: one missing or corrupt shard fails the
+    whole checkpoint, which is then quarantined and the newest
+    *complete* one wins (``ckpt.quorum_fallback``) — never a partial
+    load. Both formats can coexist in one directory; ``restore()``
+    reads whichever a step has.
     """
 
-    def __init__(self, directory, max_to_keep=3):
+    def __init__(self, directory, max_to_keep=3, sharded=False,
+                 in_progress_grace=60.0):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
-        self._valid_cache = {}  # step -> (size, mtime, ok)
+        self.sharded = bool(sharded)
+        self.in_progress_grace = float(in_progress_grace)
+        self._valid_cache = {}  # step -> (fingerprint, ok)
 
     def _path(self, step):
         return os.path.join(self.directory, f"ckpt-{step}.pkl")
+
+    def _sharded_path(self, step):
+        return os.path.join(self.directory, f"ckpt-{step}.sharded")
+
+    def _has_sharded(self, step):
+        return os.path.isdir(self._sharded_path(step))
 
     def save(self, step, model=None, optimizer=None, extra=None,
              program=None):
@@ -202,6 +226,30 @@ class CheckpointManager:
         parameter values (plus its recorded optimizers' state) so
         Executor loops checkpoint through the same manager."""
         from ..resilience import retry as _retry
+        if self.sharded:
+            # keep LIVE leaves: the sharded writer reads each array's
+            # NamedSharding to decide which shards this process owns
+            state = {"step": step}
+            if model is not None:
+                state["model"] = dict(model.state_dict())
+            if optimizer is not None:
+                state["optimizer"] = optimizer.state_dict()
+            if program is not None:
+                state["program"] = dict(program.param_vars)
+                state["program_optimizers"] = [
+                    opt.state_dict()
+                    if opt._parameter_list is not None else {}
+                    for opt, _ in getattr(program, "optimizers", [])]
+            if extra:
+                state["extra"] = extra
+            from . import sharded as _sharded
+            with _monitor.trace.span("checkpoint.save", step=step,
+                                     sharded=True):
+                _sharded.save_state(self._sharded_path(step), state,
+                                    step=step)
+            self._valid_cache.pop(step, None)
+            self._gc()
+            return
         state = {"step": step}
         if model is not None:
             state["model"] = _to_numpy_tree(model.state_dict())
@@ -240,11 +288,18 @@ class CheckpointManager:
         self._gc()
 
     def _steps(self):
-        out = []
+        out = set()
         for fn in os.listdir(self.directory):
-            if fn.startswith("ckpt-") and fn.endswith(".pkl"):
+            if not fn.startswith("ckpt-"):
+                continue
+            if fn.endswith(".pkl"):
                 try:
-                    out.append(int(fn[5:-4]))
+                    out.add(int(fn[5:-4]))
+                except ValueError:
+                    pass
+            elif fn.endswith(".sharded"):
+                try:
+                    out.add(int(fn[5:-8]))
                 except ValueError:
                     pass
         return sorted(out)
@@ -257,68 +312,132 @@ class CheckpointManager:
                     os.remove(self._path(s) + suffix)
                 except FileNotFoundError:
                     pass
+            shutil.rmtree(self._sharded_path(s), ignore_errors=True)
             self._valid_cache.pop(s, None)
 
-    def _is_valid(self, step):
-        """Readable + checksum-clean (sidecar when present, else a full
-        unpickle probe). Cached per (size, mtime)."""
+    def _fingerprint(self, step):
+        """Change-detection key for the validity cache: (size, mtime) of
+        the pkl, or the sorted (name, size, mtime) listing of a sharded
+        dir — any rewrite or corruption-in-place changes it."""
         path = self._path(step)
         try:
             st = os.stat(path)
+            return ("pkl", st.st_size, st.st_mtime_ns)
         except OSError:
+            pass
+        sdir = self._sharded_path(step)
+        try:
+            entries = []
+            for fn in sorted(os.listdir(sdir)):
+                st = os.stat(os.path.join(sdir, fn))
+                entries.append((fn, st.st_size, st.st_mtime_ns))
+            return ("sharded", tuple(entries))
+        except OSError:
+            return None
+
+    def _is_valid(self, step):
+        """Readable + checksum-clean. Pickle checkpoints verify via the
+        sha256 sidecar (else a full unpickle probe); sharded ones apply
+        the quorum rule — manifest plus EVERY shard must check out.
+        Cached per content fingerprint."""
+        fp = self._fingerprint(step)
+        if fp is None:
             return False
         cached = self._valid_cache.get(step)
-        if cached is not None and cached[:2] == (st.st_size, st.st_mtime_ns):
-            return cached[2]
+        if cached is not None and cached[0] == fp:
+            return cached[1]
         ok = False
-        try:
-            sidecar = path + ".sha256"
-            if os.path.exists(sidecar):
-                with open(sidecar, encoding="utf-8") as f:
-                    want = f.read().strip()
-                ok = bool(want) and _sha256_file(path) == want
-            else:
-                with open(path, "rb") as f:
-                    pickle.load(f)
-                ok = True
-        except Exception:
-            ok = False
-        self._valid_cache[step] = (st.st_size, st.st_mtime_ns, ok)
+        if fp[0] == "sharded":
+            from . import sharded as _sharded
+            ok, _why = _sharded.validate(self._sharded_path(step))
+        else:
+            path = self._path(step)
+            try:
+                sidecar = path + ".sha256"
+                if os.path.exists(sidecar):
+                    with open(sidecar, encoding="utf-8") as f:
+                        want = f.read().strip()
+                    ok = bool(want) and _sha256_file(path) == want
+                else:
+                    with open(path, "rb") as f:
+                        pickle.load(f)
+                    ok = True
+            except Exception:
+                ok = False
+        self._valid_cache[step] = (fp, ok)
         return ok
 
     def valid_steps(self):
         return [s for s in self._steps() if self._is_valid(s)]
 
+    def _in_progress(self, step):
+        """True while a save of `step` looks live: a ``.tmp`` staging
+        file/dir younger than ``in_progress_grace`` seconds. Such steps
+        are skipped silently — an interrupted save older than the grace
+        window is treated as corrupt like any other invalid file."""
+        candidates = [self._path(step) + ".tmp"]
+        prefix = f"ckpt-{step}.sharded.tmp-"
+        try:
+            candidates += [os.path.join(self.directory, fn)
+                           for fn in os.listdir(self.directory)
+                           if fn.startswith(prefix)]
+        except OSError:
+            pass
+        now = time.time()
+        for c in candidates:
+            try:
+                if now - os.stat(c).st_mtime < self.in_progress_grace:
+                    return True
+            except OSError:
+                continue
+        return False
+
     def _quarantine(self, step, why):
         from ..resilience import record as _record
-        path = self._path(step)
+        sharded = self._has_sharded(step) and not os.path.exists(
+            self._path(step))
+        path = self._sharded_path(step) if sharded else self._path(step)
         warnings.warn(
             f"CheckpointManager: quarantining corrupt checkpoint "
             f"{path} ({why})")
-        for suffix in ("", ".sha256"):
+        if sharded:
             try:
-                os.replace(path + suffix, path + suffix + ".corrupt")
+                os.replace(path, path + ".corrupt")
             except OSError:
                 pass
+        else:
+            for suffix in ("", ".sha256"):
+                try:
+                    os.replace(path + suffix, path + suffix + ".corrupt")
+                except OSError:
+                    pass
         self._valid_cache.pop(step, None)
-        _record("ckpt_quarantine", step=step, path=path, why=why)
+        _record("ckpt_quarantine", step=step, path=path, why=why,
+                sharded=sharded)
 
     def latest_step(self):
-        """Newest *valid* checkpoint step (corrupt/truncated files are
-        skipped with a warning — they never win)."""
+        """Newest *valid* checkpoint step. Corrupt/truncated files are
+        skipped with a warning — they never win; a save still in
+        progress (warm ``.tmp``) is skipped silently."""
         for s in reversed(self._steps()):
             if self._is_valid(s):
                 return s
+            if self._in_progress(s):
+                continue
+            shown = self._sharded_path(s) if self._has_sharded(s) and \
+                not os.path.exists(self._path(s)) else self._path(s)
             warnings.warn(
                 f"CheckpointManager: skipping unreadable/corrupt "
-                f"checkpoint {self._path(s)}")
+                f"checkpoint {shown}")
         return None
 
     def restore(self, model=None, optimizer=None, step=None, program=None):
         """Restore the requested (default: newest valid) checkpoint.
         Corrupt candidates found on the way are quarantined and the
-        next-newest valid one is used; an explicitly requested corrupt
-        step raises."""
+        next-newest valid one is used (for sharded candidates that is the
+        quorum fallback: one bad shard disqualifies the whole step —
+        ``ckpt.quorum_fallback``); an explicitly requested corrupt step
+        raises. In-progress saves are skipped, not quarantined."""
         from ..resilience import retry as _retry
         if step is not None:
             if not self._is_valid(step):
@@ -332,12 +451,30 @@ class CheckpointManager:
                 if self._is_valid(s):
                     chosen = s
                     break
+                if self._in_progress(s):
+                    continue
+                if self._has_sharded(s) and not os.path.exists(
+                        self._path(s)):
+                    _monitor.counter("ckpt.quorum_fallback").inc()
+                    _monitor.emit(kind="ckpt", event="quorum_fallback",
+                                  step=s)
                 self._quarantine(s, "failed validation during restore")
             if chosen is None:
                 return None
-        with _monitor.trace.span("checkpoint.restore", step=chosen):
-            state = _retry.retry_call(
-                load, self._path(chosen), label="ckpt_load")
+        sharded = self._has_sharded(chosen) and not os.path.exists(
+            self._path(chosen))
+        if sharded:
+            from . import sharded as _sharded
+            from ..parallel import collective as _collective
+            with _monitor.trace.span("checkpoint.restore", step=chosen,
+                                     sharded=True):
+                state, _manifest = _retry.retry_call(
+                    _sharded.load_state, self._sharded_path(chosen),
+                    mesh=_collective.get_mesh(), label="ckpt_load")
+        else:
+            with _monitor.trace.span("checkpoint.restore", step=chosen):
+                state = _retry.retry_call(
+                    load, self._path(chosen), label="ckpt_load")
         if model is not None and "model" in state:
             model.set_state_dict(state["model"])
         if optimizer is not None and "optimizer" in state:
@@ -939,3 +1076,6 @@ def prepend_feed_ops(*a, **kw):
 
 def append_fetch_ops(*a, **kw):
     """reference io.py:append_fetch_ops — fetches are jit outputs here."""
+
+
+from . import sharded  # noqa: E402,F401  (per-shard checkpoint format)

@@ -1,0 +1,325 @@
+"""paddle_tpu.io.sharded — per-shard checkpoints with a checksummed
+manifest and topology-elastic restore.
+
+The monolithic :class:`~paddle_tpu.io.CheckpointManager` pickle path
+writes one blob from one process — a single lost host (or a pod resize)
+loses the run. This module is the sharded contract underneath
+``CheckpointManager(sharded=True)``:
+
+* **save**: each process writes only the unique data shards it owns —
+  one ``.npy`` per (pytree leaf, mesh shard), keyed by the leaf's live
+  ``NamedSharding``/``PartitionSpec`` (``parallel.layout``), plus a
+  ``manifest.json`` recording the global tree structure, per-shard
+  sha256 + byte counts, the saving mesh's signature, and the step. The
+  whole checkpoint is staged in a ``.tmp-<pid>`` directory and
+  committed with one ``os.replace`` — a SIGKILL mid-save leaves a stray
+  tmp dir, never a half-visible checkpoint.
+* **restore**: reads the manifest, verifies every shard's checksum,
+  reassembles the global arrays, and reshards them onto the *current*
+  mesh even when its topology differs from the one that saved (dp×tp
+  resize, replica-count change). A missing or corrupt shard fails
+  validation as a unit — the manager quarantines that checkpoint and
+  falls back to the newest *complete* one (``ckpt.quorum_fallback``);
+  there is no partial load.
+
+Monitor series: ``ckpt.shard_bytes`` (counter), ``ckpt.shard_seconds``
+(histogram, per-shard write time), ``ckpt.restore_resharded`` (restores
+that landed on a different topology), ``ckpt.quorum_fallback``. Fault
+kinds ``shard_corrupt`` / ``shard_slow_write``
+(:mod:`paddle_tpu.resilience.faults`) hit the write path so the failure
+handling is deterministically testable. Per-shard I/O retries transient
+OS errors under :mod:`paddle_tpu.resilience.retry`.
+
+Single-controller note: with one process (the CPU test topology and
+single-host TPU), that process owns every shard and the manifest; on a
+multi-process pod each process writes its ``replica_id == 0`` shards
+whose device is local, and process 0 writes the manifest.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+import jax
+
+from ..tensor import Tensor
+from .. import monitor as _monitor
+from ..parallel import layout as _layout
+
+MANIFEST = "manifest.json"
+FORMAT_VERSION = 1
+
+
+def _sha256_file(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _is_array_leaf(x):
+    return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
+
+def _encode_tree(node, leaves):
+    """Nested state → JSON structure; array leaves become ``{"leaf": id}``
+    references into the manifest's leaf table (the *global tree
+    structure* the restore side rebuilds)."""
+    if _is_array_leaf(node):
+        leaves.append(node)
+        return {"t": "leaf", "id": len(leaves) - 1}
+    if isinstance(node, dict):
+        return {"t": "dict",
+                "items": {str(k): _encode_tree(v, leaves)
+                          for k, v in node.items()}}
+    if isinstance(node, (list, tuple)):
+        return {"t": "list" if isinstance(node, list) else "tuple",
+                "items": [_encode_tree(v, leaves) for v in node]}
+    if isinstance(node, np.generic):
+        return {"t": "val", "v": node.item()}
+    if isinstance(node, (bool, int, float, str)) or node is None:
+        return {"t": "val", "v": node}
+    raise TypeError(
+        f"sharded checkpoint cannot serialize a {type(node).__name__} "
+        "leaf — state trees must hold arrays/Tensors and JSON scalars")
+
+
+def _decode_tree(node, leaf_values):
+    t = node["t"]
+    if t == "leaf":
+        return leaf_values[node["id"]]
+    if t == "dict":
+        return {k: _decode_tree(v, leaf_values)
+                for k, v in node["items"].items()}
+    if t in ("list", "tuple"):
+        seq = [_decode_tree(v, leaf_values) for v in node["items"]]
+        return seq if t == "list" else tuple(seq)
+    return node["v"]
+
+
+def _unique_shards(arr):
+    """[(bounds, host_array)] covering `arr` exactly once. A NamedSharded
+    jax.Array contributes its ``replica_id == 0`` shards (the unique
+    data, deduped across replicas); anything else is one full shard."""
+    if isinstance(arr, jax.Array) and _layout.spec_of(arr) is not None \
+            and arr.is_fully_addressable:
+        out = []
+        for s in arr.addressable_shards:
+            if s.replica_id != 0:
+                continue
+            out.append((_layout.shard_index_bounds(s.index, arr.shape),
+                        np.asarray(s.data)))
+        if out:
+            return out
+    host = np.asarray(jax.device_get(arr) if isinstance(arr, jax.Array)
+                      else arr)
+    return [(_layout.shard_index_bounds(
+        tuple(slice(None) for _ in host.shape), host.shape), host)]
+
+
+def _write_shard(path, data, step=None):
+    """One shard write: fault-injectable, retried, fsynced, metered."""
+    from ..resilience import faults as _faults
+    from ..resilience import retry as _retry
+
+    def _write():
+        if _faults.enabled():
+            _faults.maybe_sleep("shard_slow_write", step)
+        with open(path, "wb") as f:
+            np.save(f, data, allow_pickle=False)
+            f.flush()
+            os.fsync(f.fileno())
+
+    t0 = time.perf_counter()
+    _retry.retry_call(_write, label="ckpt_shard_write")
+    if _monitor.enabled():
+        _monitor.counter("ckpt.shard_bytes").inc(int(data.nbytes))
+        _monitor.histogram("ckpt.shard_seconds").observe(
+            time.perf_counter() - t0)
+
+
+def save_state(dirname, state, step=None, mesh=None):
+    """Write `state` (a nested dict/list tree of Tensors/arrays and JSON
+    scalars) as a sharded checkpoint directory at `dirname`. Atomic:
+    stages under ``<dirname>.tmp-<pid>`` and commits via ``os.replace``.
+    Returns the manifest dict."""
+    from ..parallel import collective as _collective
+    from ..resilience import faults as _faults
+    mesh = mesh if mesh is not None else _collective.get_mesh()
+    final = os.path.abspath(dirname)
+    tmp = f"{final}.tmp-{os.getpid()}"
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+
+    leaves = []
+    tree = _encode_tree(state, leaves)
+    leaf_table = []
+    fileno = 0
+    for i, leaf in enumerate(leaves):
+        arr = leaf.data if isinstance(leaf, Tensor) else leaf
+        spec = _layout.spec_of(arr)
+        shape = tuple(int(d) for d in np.shape(arr))
+        dtype = str(arr.dtype) if hasattr(arr, "dtype") \
+            else str(np.asarray(arr).dtype)
+        shard_recs = []
+        for bounds, data in _unique_shards(arr):
+            fn = f"s{fileno:05d}.npy"
+            fileno += 1
+            fpath = os.path.join(tmp, fn)
+            _write_shard(fpath, data, step=step)
+            shard_recs.append({
+                "file": fn, "index": bounds,
+                "bytes": int(os.path.getsize(fpath)),
+                "sha256": _sha256_file(fpath)})
+        leaf_table.append({
+            "id": i, "shape": list(shape), "dtype": dtype,
+            "spec": _layout.spec_to_lists(spec, len(shape))
+            if spec is not None else None,
+            "shards": shard_recs})
+
+    manifest = {
+        "format": FORMAT_VERSION,
+        "step": None if step is None else int(step),
+        "process_index": int(jax.process_index()),
+        "mesh": _layout.mesh_signature(mesh),
+        "tree": tree,
+        "leaves": leaf_table,
+    }
+    mpath = os.path.join(tmp, MANIFEST)
+    blob = json.dumps(manifest, sort_keys=True).encode()
+    with open(mpath, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(mpath + ".sha256", "w", encoding="utf-8") as f:
+        f.write(hashlib.sha256(blob).hexdigest() + "\n")
+
+    if os.path.isdir(final):
+        # re-save of the same step: swap the old dir out from under the
+        # name, then drop it — the name never points at a partial state
+        old = f"{final}.old-{os.getpid()}"
+        os.replace(final, old)
+        os.replace(tmp, final)
+        shutil.rmtree(old, ignore_errors=True)
+    else:
+        os.replace(tmp, final)
+
+    if _faults.enabled():
+        # bit-rot simulation: garble one committed shard so restore-side
+        # checksum verification (and quorum fallback) is exercised for
+        # real — fires AFTER the manifest recorded the clean hash
+        spec_fired = _faults.fire("shard_corrupt", step)
+        if spec_fired is not None and leaf_table and \
+                leaf_table[0]["shards"]:
+            _faults.garble_file(os.path.join(
+                final, leaf_table[0]["shards"][0]["file"]))
+    return manifest
+
+
+def read_manifest(dirname, verify=True):
+    """Parse (and by default checksum-verify) a checkpoint's manifest.
+    Raises ValueError when missing or corrupt."""
+    mpath = os.path.join(dirname, MANIFEST)
+    if not os.path.isfile(mpath):
+        raise ValueError(f"no {MANIFEST} in {dirname}")
+    with open(mpath, "rb") as f:
+        blob = f.read()
+    side = mpath + ".sha256"
+    if verify and os.path.exists(side):
+        with open(side, encoding="utf-8") as f:
+            want = f.read().strip()
+        if hashlib.sha256(blob).hexdigest() != want:
+            raise ValueError(f"manifest checksum mismatch in {dirname}")
+    try:
+        manifest = json.loads(blob.decode())
+    except Exception as e:
+        raise ValueError(f"unparseable manifest in {dirname}: {e}") from e
+    if manifest.get("format") != FORMAT_VERSION:
+        raise ValueError(
+            f"unsupported sharded-checkpoint format "
+            f"{manifest.get('format')!r} in {dirname}")
+    return manifest
+
+
+def validate(dirname):
+    """Full quorum check: manifest parses + checksums, and EVERY shard
+    file exists with matching size and sha256. Returns ``(ok, why)`` —
+    one missing/corrupt shard fails the whole checkpoint, which is what
+    keeps a partial load impossible."""
+    try:
+        manifest = read_manifest(dirname)
+    except ValueError as e:
+        return False, str(e)
+    for leaf in manifest["leaves"]:
+        for rec in leaf["shards"]:
+            path = os.path.join(dirname, rec["file"])
+            try:
+                if os.path.getsize(path) != rec["bytes"]:
+                    return False, f"shard {rec['file']} size mismatch"
+            except OSError:
+                return False, f"shard {rec['file']} missing"
+            if _sha256_file(path) != rec["sha256"]:
+                return False, f"shard {rec['file']} checksum mismatch"
+    return True, None
+
+
+def load_state(dirname, mesh=None, place=False, verify=True):
+    """Reassemble a sharded checkpoint into its global state tree.
+
+    Each leaf's shards are checksum-verified (unless ``verify=False``
+    when the caller just validated), loaded, and stitched into one host
+    array. With ``place=True`` every leaf that recorded a PartitionSpec
+    is ``device_put`` onto `mesh` (default: the current global mesh)
+    under :func:`paddle_tpu.parallel.layout.adapt_spec` — restoring onto
+    a resized mesh reshards rather than failing. Returns
+    ``(state, manifest)``.
+    """
+    from ..parallel import collective as _collective
+    from ..resilience import retry as _retry
+    manifest = read_manifest(dirname, verify=verify)
+    mesh = mesh if mesh is not None else _collective.get_mesh()
+
+    leaf_values = []
+    resharded = 0
+    for leaf in manifest["leaves"]:
+        shape = tuple(leaf["shape"])
+        dtype = np.dtype(leaf["dtype"])
+        out = np.empty(shape, dtype)
+        for rec in leaf["shards"]:
+            path = os.path.join(dirname, rec["file"])
+            if verify and _sha256_file(path) != rec["sha256"]:
+                raise ValueError(
+                    f"shard {rec['file']} checksum mismatch in {dirname}")
+            data = _retry.retry_call(np.load, path,
+                                     label="ckpt_shard_read")
+            sl = _layout.bounds_to_slices(rec["index"])
+            if shape == ():
+                out[()] = np.asarray(data)
+            else:
+                out[sl] = data
+        value = out
+        if place and leaf["spec"] is not None:
+            value, changed = _layout.reshard(out, leaf["spec"], mesh)
+            resharded += bool(changed)
+        leaf_values.append(value)
+
+    state = _decode_tree(manifest["tree"], leaf_values)
+    if _monitor.enabled():
+        cur_sig = _layout.mesh_signature(mesh)
+        if not _layout.same_signature(manifest.get("mesh"), cur_sig):
+            _monitor.counter("ckpt.restore_resharded").inc()
+            _monitor.emit(kind="ckpt", event="restore_resharded",
+                          step=manifest.get("step"),
+                          saved_mesh=manifest.get("mesh"),
+                          current_mesh=cur_sig,
+                          leaves_respecced=resharded)
+    return state, manifest

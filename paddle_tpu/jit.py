@@ -236,11 +236,27 @@ class StaticFunction:
             # anyway) so monitor.xla records its measured flops/bytes;
             # any failure keeps the original jitted callable
             with _monitor.trace.span("jit.aot_capture", fn=fn_label):
+                entry["uncompiled"] = entry["jitted"]
                 entry["jitted"] = _monitor.xla.aot_capture(
                     entry["jitted"], f"jit.{fn_label}",
                     (state_vals, arrays))
         with _monitor.trace.span(f"jit.{fn_label}"):
-            out_arrays, new_state = entry["jitted"](state_vals, arrays)
+            try:
+                out_arrays, new_state = entry["jitted"](state_vals, arrays)
+            except ValueError:
+                # an AOT Compiled is pinned to its capture-time input
+                # shardings; when GSPMD's output sharding for a state
+                # leaf drifts from its input one, the written-back state
+                # no longer matches. Plain jax.jit reshards/recompiles
+                # transparently — fall back to it so enabling the
+                # monitor never changes trainability.
+                fallback = entry.get("uncompiled")
+                if fallback is None or fallback is entry["jitted"]:
+                    raise
+                entry["jitted"] = fallback
+                if _monitor.enabled():
+                    _monitor.counter("jit.aot_sharding_fallback").inc()
+                out_arrays, new_state = entry["jitted"](state_vals, arrays)
 
         for name, new in zip(state_names, new_state):
             holders[name].data = new

@@ -42,6 +42,22 @@ Resilience series (docs/robustness.md; ``paddle_tpu.resilience``):
   injections and batches dropped after retry exhaustion
   (``prefetch.drops`` counts the same at the prefetch site)
 
+Sharded-checkpoint series (docs/robustness.md "Sharded & elastic
+checkpoints"; ``paddle_tpu.io.sharded``):
+
+* ``ckpt.shard_bytes`` (counter) / ``ckpt.shard_seconds`` (histogram)
+  — bytes written and per-shard write latency of sharded saves
+* ``ckpt.restore_resharded``    — restores that landed on a mesh with
+  a different topology than the one that saved (each also emits a
+  ``ckpt`` JSONL event with both mesh signatures)
+* ``ckpt.quorum_fallback``      — sharded checkpoints rejected by the
+  quorum rule (≥1 missing/corrupt shard) during restore's fallback
+  scan; the ``checkpoint.save``/``checkpoint.restore`` trace spans
+  carry a ``sharded`` attribute on the sharded path
+* ``resilience.elastic_attempt`` / ``elastic_restart`` /
+  ``elastic_resize`` / ``elastic_preempt_stop`` — the elastic
+  recovery loop's state transitions (``resilience.elastic``)
+
 Serving series (docs/serving.md; ``paddle_tpu.serving``):
 
 * ``serving.requests`` / ``serving.rows`` / ``serving.batches`` —

@@ -5,6 +5,8 @@ from . import collective
 from .collective import (make_mesh, get_mesh, set_mesh, shard, replicated,
                          all_reduce, all_gather, reduce_scatter, broadcast,
                          all_to_all, ppermute, barrier)
+from . import layout
+from .layout import mesh_signature, extract_layout, adapt_spec, reshard
 from .env import ParallelEnv, prepare_context
 from . import fleet as fleet_mod
 from .fleet import fleet, DistributedStrategy, PaddleCloudRoleMaker, init

@@ -1,0 +1,155 @@
+"""paddle_tpu.parallel.layout — mesh/PartitionSpec layout extraction and
+reshard-on-load.
+
+The sharded checkpoint contract (paddle_tpu.io.sharded) needs three
+things from the parallelism layer, all of which live here so the io
+layer never reaches into jax.sharding internals directly:
+
+* :func:`mesh_signature` — a JSON-able fingerprint of a mesh's topology
+  (axis names → sizes, device count, platform). Saved into every
+  sharded-checkpoint manifest; a restore onto a mesh with a different
+  signature is a *resharding* restore (``ckpt.restore_resharded``).
+* :func:`spec_of` / :func:`spec_to_lists` / :func:`spec_from_lists` —
+  extract a live array's ``PartitionSpec`` and round-trip it through a
+  JSON-able form (``[["dp"], None, ["tp","sp"]]``-style lists).
+* :func:`adapt_spec` / :func:`reshard` — map a saved spec onto the
+  *current* mesh, which may have different axis sizes (dp×tp resize),
+  missing axes, or fewer devices. Axes the new mesh doesn't have are
+  dropped; a dimension whose sharded axis product no longer divides the
+  dimension falls back to replication for that dimension — placement
+  degrades to *correct but less sharded*, never to an invalid layout.
+
+Everything here is topology math on host metadata; no collective is
+issued and nothing requires an SPMD region.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def mesh_signature(mesh):
+    """JSON-able topology fingerprint: ``{"axes": {name: size}, ...}``.
+    ``None`` (no mesh) signs as a single-device/no-mesh layout."""
+    if mesh is None:
+        return {"axes": {}, "n_devices": 1, "platform": None}
+    axes = {str(name): int(size) for name, size in mesh.shape.items()}
+    devs = mesh.devices.reshape(-1)
+    platform = getattr(devs[0], "platform", None) if len(devs) else None
+    return {"axes": axes, "n_devices": int(devs.size), "platform": platform}
+
+
+def same_signature(a, b):
+    """Topology equality: axis names+sizes and device count (platform is
+    informational — a CPU rehearsal of a TPU layout still reshards)."""
+    return (a or {}).get("axes") == (b or {}).get("axes") and \
+        (a or {}).get("n_devices") == (b or {}).get("n_devices")
+
+
+def spec_of(value):
+    """The PartitionSpec of a live array/Tensor, or None when it has no
+    NamedSharding (numpy, fully-committed single device, GSPMD opaque)."""
+    arr = getattr(value, "data", value)
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding):
+        return sh.spec
+    return None
+
+
+def spec_to_lists(spec, ndim):
+    """PartitionSpec → JSON form: one entry per dim, each ``None`` or a
+    list of axis names (a dim sharded over multiple axes keeps them in
+    order). Dims beyond the spec's length are unsharded."""
+    out = []
+    entries = tuple(spec) if spec is not None else ()
+    for d in range(ndim):
+        e = entries[d] if d < len(entries) else None
+        if e is None:
+            out.append(None)
+        elif isinstance(e, (tuple, list)):
+            out.append([str(a) for a in e])
+        else:
+            out.append([str(e)])
+    return out
+
+
+def spec_from_lists(lists):
+    """Inverse of :func:`spec_to_lists`."""
+    entries = []
+    for e in lists or ():
+        if not e:
+            entries.append(None)
+        elif len(e) == 1:
+            entries.append(e[0])
+        else:
+            entries.append(tuple(e))
+    return P(*entries)
+
+
+def extract_layout(named_values):
+    """{name: live array/Tensor} → {name: spec-lists} for every value
+    that carries a NamedSharding (the manifest's layout record)."""
+    out = {}
+    for name, v in named_values.items():
+        spec = spec_of(v)
+        if spec is not None:
+            arr = getattr(v, "data", v)
+            out[name] = spec_to_lists(spec, int(getattr(arr, "ndim", 0)))
+    return out
+
+
+def adapt_spec(lists, shape, mesh):
+    """Map a saved spec (lists form) onto `mesh` for an array of `shape`.
+
+    Returns ``(PartitionSpec, changed)``. Per dimension: axis names the
+    mesh doesn't have are dropped; if the surviving axes' size product
+    does not divide the dimension, the whole dimension falls back to
+    replicated. `changed` is True when any dim degraded — the signal
+    behind ``ckpt.restore_resharded`` accounting.
+    """
+    if mesh is None:
+        return P(), bool(lists and any(lists))
+    sizes = {str(n): int(s) for n, s in mesh.shape.items()}
+    entries, changed = [], False
+    for d, e in enumerate(lists or ()):
+        if not e:
+            entries.append(None)
+            continue
+        kept = [a for a in e if a in sizes]
+        if len(kept) != len(e):
+            changed = True
+        prod = int(np.prod([sizes[a] for a in kept])) if kept else 1
+        dim = int(shape[d]) if d < len(shape) else 1
+        if not kept or prod <= 0 or dim % prod != 0:
+            if kept:
+                changed = True
+            entries.append(None)
+            continue
+        entries.append(kept[0] if len(kept) == 1 else tuple(kept))
+    return P(*entries), changed
+
+
+def reshard(value, lists, mesh):
+    """Place a (host) array onto `mesh` under the saved spec, adapted to
+    the mesh actually present. Returns ``(jax.Array, changed)``; with no
+    mesh the value passes through as-is."""
+    if mesh is None:
+        return value, False
+    spec, changed = adapt_spec(lists, np.shape(value), mesh)
+    return jax.device_put(value, NamedSharding(mesh, spec)), changed
+
+
+def shard_index_bounds(index, shape):
+    """Normalize an ``addressable_shards[...].index`` slice tuple into
+    JSON-able ``[[start, stop], ...]`` bounds over `shape`."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def bounds_to_slices(bounds):
+    return tuple(slice(b[0], b[1]) for b in bounds)

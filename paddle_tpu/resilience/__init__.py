@@ -21,6 +21,10 @@ subsystem turns each into a bounded, observable recovery:
 * :mod:`~paddle_tpu.resilience.deadline` — monotonic wall-time budgets
   (:class:`Deadline`); the serving tier's admission controller drops
   expired requests at dequeue so they never occupy a batch slot
+* :mod:`~paddle_tpu.resilience.elastic`  — the elastic recovery loop:
+  restart after :class:`HostLossError` on a mesh shrunk to the
+  surviving devices, resuming from the last complete sharded
+  checkpoint at the exact next step
 
 Checkpoint hardening itself (tmp-file + ``os.replace``, sha256
 sidecars, corrupt-file quarantine) lives in
@@ -41,6 +45,7 @@ from . import guard  # noqa: F401
 from . import watchdog  # noqa: F401
 from . import preempt  # noqa: F401
 from . import deadline  # noqa: F401
+from . import elastic  # noqa: F401
 from ._common import record  # noqa: F401
 from .deadline import Deadline  # noqa: F401
 from .retry import (RetryPolicy, RetryExhausted, TransientError,  # noqa: F401
@@ -48,12 +53,15 @@ from .retry import (RetryPolicy, RetryExhausted, TransientError,  # noqa: F401
 from .guard import NaNGuard, NonFiniteError  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 from .preempt import PreemptionHandler  # noqa: F401
+from .faults import HostLossError  # noqa: F401
+from .elastic import ElasticSupervisor  # noqa: F401
 
 __all__ = [
     "faults", "retry", "guard", "watchdog", "preempt", "deadline",
-    "RetryPolicy", "RetryExhausted", "TransientError", "retry_call",
-    "retrying", "is_transient", "NaNGuard", "NonFiniteError",
-    "Watchdog", "PreemptionHandler", "Deadline", "record",
+    "elastic", "RetryPolicy", "RetryExhausted", "TransientError",
+    "retry_call", "retrying", "is_transient", "NaNGuard",
+    "NonFiniteError", "Watchdog", "PreemptionHandler", "HostLossError",
+    "ElasticSupervisor", "Deadline", "record",
 ]
 
 # PADDLE_TPU_FAULTS='[{"kind":"loader","step":3}]' registers faults at

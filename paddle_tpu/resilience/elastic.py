@@ -1,0 +1,179 @@
+"""paddle_tpu.resilience.elastic — the elastic recovery loop.
+
+A pod-scale run must survive two distinct ends-of-the-world:
+
+* **preemption** — the scheduler says *stop*: SIGTERM lands, the
+  :mod:`~paddle_tpu.resilience.preempt` handler flushes a final sharded
+  save, and the job should NOT restart (the scheduler owns the next
+  incarnation).
+* **host loss** — a worker (and its devices) silently drops out:
+  the run dies mid-step with :class:`~paddle_tpu.resilience.faults.
+  HostLossError` (or, on real hardware, a device error the caller maps
+  to one), and the job SHOULD restart — on a smaller mesh, from the
+  last complete checkpoint, at the exact next step.
+
+:class:`ElasticSupervisor` is the control loop gluing those together.
+Each *attempt* plans a mesh over the devices still available
+(:meth:`plan_mesh` shrinks the data axis first, so model-parallel
+groups stay intact), registers it as the global mesh, and calls the
+user's ``train_fn(attempt)`` — which runs ``hapi.Model.fit(...,
+auto_resume=True)`` or ``Executor.train_from_dataset`` against
+``attempt.mesh``. Sharded checkpoints written on the old topology
+restore onto the new one through
+:meth:`paddle_tpu.io.CheckpointManager.restore`'s reshard-on-load
+path, so resuming after a resize is the same code path as resuming
+after a clean stop. Worker liveness is observable through
+:meth:`liveness` (reusing :func:`paddle_tpu.resilience.watchdog.
+health` — the same feed the monitor's /healthz serves).
+
+Every transition is recorded: ``resilience.elastic_attempt``,
+``elastic_restart`` (a host died; restarting), ``elastic_resize``
+(the planned mesh differs from the previous attempt's),
+``elastic_preempt_stop`` and ``elastic_done``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ._common import record
+from .faults import HostLossError
+from . import watchdog as _watchdog
+
+
+class Attempt:
+    """One incarnation of the run: the mesh it trains on, which devices
+    back it, and whether it should auto-resume from the checkpoint."""
+
+    def __init__(self, number, mesh, axes, devices, checkpoint,
+                 auto_resume):
+        self.number = number
+        self.mesh = mesh
+        self.axes = axes
+        self.devices = devices
+        self.checkpoint = checkpoint
+        self.auto_resume = auto_resume
+
+    def __repr__(self):
+        return (f"Attempt(number={self.number}, axes={self.axes}, "
+                f"devices={len(self.devices)}, "
+                f"auto_resume={self.auto_resume})")
+
+
+class ElasticSupervisor:
+    """Restart-on-host-loss supervisor around a training function.
+
+    checkpoint   — the run's :class:`~paddle_tpu.io.CheckpointManager`
+                   (``sharded=True`` for topology-elastic restores).
+    mesh_axes    — the full-strength mesh, e.g. ``{"dp": 4, "tp": 2}``;
+                   None trains unmeshed (single device).
+    shrink_axis  — which axis absorbs lost devices (default: the first,
+                   conventionally the data axis).
+    max_restarts — restart budget; one more :class:`HostLossError`
+                   re-raises to the caller.
+    """
+
+    def __init__(self, checkpoint=None, mesh_axes=None, shrink_axis=None,
+                 max_restarts=3):
+        self.checkpoint = checkpoint
+        self.mesh_axes = dict(mesh_axes) if mesh_axes else None
+        if shrink_axis is None and self.mesh_axes:
+            shrink_axis = next(iter(self.mesh_axes))
+        self.shrink_axis = shrink_axis
+        self.max_restarts = int(max_restarts)
+        self.lost_devices = 0
+        self.attempts = []
+
+    # -- observability ----------------------------------------------------
+
+    def liveness(self):
+        """Watchdog health snapshots (the /healthz feed): stalled or
+        dead step-loops show up here before they show up as losses."""
+        return _watchdog.health()
+
+    def available_devices(self):
+        """Devices this incarnation may use: the visible set minus the
+        ones reported lost (simulated loss keeps the jax client's
+        device list intact, so the supervisor does the subtraction)."""
+        import jax
+        devs = jax.devices()
+        n = max(1, len(devs) - self.lost_devices)
+        return devs[:n]
+
+    # -- topology planning ------------------------------------------------
+
+    def plan_mesh(self, n_devices):
+        """Shrink ``mesh_axes`` to fit `n_devices`, data axis first.
+
+        The shrink axis takes ``n // prod(other axes)``; if even the
+        other axes alone no longer fit, the largest of them is halved
+        until they do. Axis names and order are preserved, so saved
+        PartitionSpecs stay meaningful across the resize."""
+        if not self.mesh_axes:
+            return None
+        axes = dict(self.mesh_axes)
+        shrink = self.shrink_axis
+        n = max(1, int(n_devices))
+
+        def _others():
+            return int(np.prod([s for k, s in axes.items()
+                                if k != shrink] or [1]))
+
+        while _others() > n:
+            candidates = [k for k in axes if k != shrink and axes[k] > 1]
+            if not candidates:
+                break
+            k = max(candidates, key=lambda k: axes[k])
+            axes[k] = max(1, axes[k] // 2)
+        axes[shrink] = max(1, n // _others())
+        return axes
+
+    # -- the loop ---------------------------------------------------------
+
+    def run(self, train_fn):
+        """Run ``train_fn(attempt)`` until it finishes, is preempted, or
+        the restart budget is spent. Returns the last attempt's result.
+        """
+        from ..parallel import collective as _collective
+        from .preempt import PreemptionHandler
+        result = None
+        attempt_no = 0
+        prev_axes = None
+        with PreemptionHandler() as handler:
+            while True:
+                devices = self.available_devices()
+                axes = self.plan_mesh(len(devices))
+                mesh = (_collective.make_mesh(axes, devices=devices)
+                        if axes else None)
+                if prev_axes is not None and axes != prev_axes:
+                    record("elastic_resize", previous=prev_axes,
+                           planned=axes, devices=len(devices))
+                prev_axes = axes
+                attempt = Attempt(attempt_no, mesh, axes, devices,
+                                  self.checkpoint,
+                                  auto_resume=attempt_no > 0 or (
+                                      self.checkpoint is not None and
+                                      self.checkpoint.latest_step()
+                                      is not None))
+                self.attempts.append(attempt)
+                record("elastic_attempt", attempt=attempt_no, axes=axes,
+                       devices=len(devices),
+                       auto_resume=attempt.auto_resume)
+                try:
+                    result = train_fn(attempt)
+                except HostLossError as e:
+                    self.lost_devices += max(1, int(
+                        getattr(e, "lost", 1)))
+                    if attempt_no >= self.max_restarts:
+                        record("elastic_exhausted", attempt=attempt_no,
+                               lost_devices=self.lost_devices)
+                        raise
+                    record("elastic_restart", attempt=attempt_no,
+                           lost=getattr(e, "lost", 1),
+                           lost_total=self.lost_devices, error=str(e))
+                    attempt_no += 1
+                    continue
+                if handler.triggered:
+                    record("elastic_preempt_stop", attempt=attempt_no)
+                else:
+                    record("elastic_done", attempt=attempt_no)
+                return result

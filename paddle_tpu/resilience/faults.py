@@ -10,11 +10,20 @@ exercised end-to-end, not unit-mocked.
 
 Well-known kinds (the registry itself is string-keyed and open):
 
-* ``loader``    — raise inside the batch producer (default: a
-                  :class:`~paddle_tpu.resilience.retry.TransientError`)
-* ``nan_grad``  — poison one training batch so loss/grads go NaN
-* ``slow_step`` — sleep ``delay`` seconds inside a step (watchdog food)
-* ``preempt``   — simulated SIGTERM: save-and-stop mid-run
+* ``loader``          — raise inside the batch producer (default: a
+                        :class:`~paddle_tpu.resilience.retry.TransientError`)
+* ``nan_grad``        — poison one training batch so loss/grads go NaN
+* ``slow_step``       — sleep ``delay`` seconds inside a step
+                        (watchdog food)
+* ``preempt``         — simulated SIGTERM: save-and-stop mid-run
+* ``shard_corrupt``   — garble bytes of one committed checkpoint shard
+                        (fires inside ``io.sharded.save_state``; the
+                        quorum rule must then reject that step)
+* ``shard_slow_write``— sleep ``delay`` inside a shard write (retry /
+                        ``ckpt.shard_seconds`` food)
+* ``host_loss``       — raise :class:`HostLossError` in the train loop:
+                        ``lost`` devices vanish and the elastic
+                        supervisor must resize the mesh and resume
 
 Every injection site is behind :func:`enabled` — an empty registry
 costs one truthiness check.
@@ -36,14 +45,26 @@ from ._common import record
 from .retry import TransientError
 
 
+class HostLossError(RuntimeError):
+    """A (simulated) host dropped out of the slice mid-run. ``lost`` is
+    how many devices went with it — the elastic supervisor shrinks the
+    mesh by that many and resumes from the last complete checkpoint."""
+
+    def __init__(self, msg="host lost", lost=1):
+        super().__init__(msg)
+        self.lost = int(lost)
+
+
 class FaultSpec:
     """One injected fault: where it fires (exact steps and/or seeded
     probability), how often (``times`` budget), and what it does
-    (raise ``exc``, or sleep ``delay`` for slow-step faults)."""
+    (raise ``exc``, sleep ``delay`` for slow faults, or drop ``lost``
+    devices for ``host_loss``)."""
 
     def __init__(self, kind, step=None, probability=1.0, times=1,
-                 exc=None, delay=0.0, seed=0):
+                 exc=None, delay=0.0, seed=0, lost=1):
         self.kind = kind
+        self.lost = int(lost)
         if step is None:
             self.steps = None
         elif isinstance(step, (list, tuple, set, frozenset)):
@@ -70,6 +91,10 @@ class FaultSpec:
     def make_exc(self):
         e = self.exc
         if e is None:
+            if self.kind == "host_loss":
+                return HostLossError(
+                    f"injected host_loss fault (fire #{self.fired}, "
+                    f"lost={self.lost})", lost=self.lost)
             return TransientError(
                 f"injected {self.kind} fault (fire #{self.fired})")
         if isinstance(e, type):
@@ -84,11 +109,11 @@ _specs = {}   # kind -> [FaultSpec]
 
 
 def inject(kind, step=None, probability=1.0, times=1, exc=None,
-           delay=0.0, seed=0):
+           delay=0.0, seed=0, lost=1):
     """Register a fault. Returns the spec (its ``.fired`` counter is the
     test-side evidence the injection actually happened)."""
     spec = FaultSpec(kind, step=step, probability=probability, times=times,
-                     exc=exc, delay=delay, seed=seed)
+                     exc=exc, delay=delay, seed=seed, lost=lost)
     with _lock:
         _specs.setdefault(kind, []).append(spec)
     return spec
@@ -140,6 +165,29 @@ def maybe_sleep(kind, step=None):
         time.sleep(spec.delay)
         return True
     return spec is not None
+
+
+def garble_file(path, nbytes=16, seed=0):
+    """Deterministically corrupt `nbytes` of `path` in place (XOR with a
+    seeded byte stream at a seeded offset) — the shard-corruption
+    primitive behind the ``shard_corrupt`` fault and the chaos gates.
+    The file's size never changes, so only checksums can catch it."""
+    size = os.path.getsize(path)
+    if size == 0:
+        with open(path, "wb") as f:
+            f.write(b"\xff")
+        return
+    rng = random.Random(seed)
+    n = min(int(nbytes), size)
+    off = rng.randrange(0, size - n + 1)
+    with open(path, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(n)
+        garbled = bytes(b ^ (rng.randrange(1, 256)) for b in chunk)
+        f.seek(off)
+        f.write(garbled)
+        f.flush()
+        os.fsync(f.fileno())
 
 
 def load_env(var="PADDLE_TPU_FAULTS"):

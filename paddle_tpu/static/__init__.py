@@ -681,6 +681,10 @@ class Executor:
         if cm is not None:
             from ..resilience.preempt import PreemptionHandler
             handler = PreemptionHandler().install()
+            # real SIGTERM → flush a final program save from the signal
+            # path (the loop's boundary save may never come)
+            handler.attach(cm, save_fn=lambda s: cm.save(
+                s, program=real_prog))
 
         batches = dataset._batches()
         if prefetch:
@@ -690,9 +694,13 @@ class Executor:
             for i, batch in enumerate(batches):
                 if i < start_step:
                     continue  # auto_resume fast-forward
+                if _faults.enabled():
+                    _faults.maybe_raise("host_loss", i)
                 outs = self.run(program, feed=batch, fetch_list=fetch_list,
                                 scope=scope, bucket=bucket, buckets=buckets,
                                 nan_guard=nan_guard)
+                if handler is not None:
+                    handler.notify_step(i)
                 if debug and fetch_list and i % max(print_period, 1) == 0:
                     msg = ", ".join(f"{n}={np.asarray(o).ravel()[:1]}"
                                     for n, o in zip(fetch_info, outs))
@@ -700,7 +708,9 @@ class Executor:
                 preempted = (handler is not None and handler.triggered) or \
                     (_faults.enabled() and _faults.fire("preempt", i))
                 if cm is not None and (
-                        preempted or (save_steps and (i + 1) % save_steps == 0)):
+                        preempted or
+                        (save_steps and (i + 1) % save_steps == 0)) and (
+                        handler is None or handler.flushed_step != i):
                     cm.save(i, program=real_prog)
                     if preempted:
                         _rrecord("preempt_save", step=i, where="executor")

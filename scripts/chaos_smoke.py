@@ -16,6 +16,18 @@ criteria from the monitor JSONL stream:
 * the resumed run continues at exactly the step after the preemption
   save (``resilience.auto_resume``) and finishes with finite loss
 
+Sharded / topology-elastic gates (ISSUE 7), on an 8-virtual-device mesh:
+
+* a preemption during a ``sharded=True`` run triggers a final per-shard
+  save whose manifest + every shard validate
+  (``preempt_triggered_sharded_save``)
+* a run on a RESIZED mesh (4×2 → 2×4) auto-resumes from that sharded
+  checkpoint at exactly the next step, recording
+  ``ckpt.restore_resharded`` (``mesh_resize_resumed_at_next_step``)
+* garbling one shard of the newest checkpoint disqualifies the whole
+  step — quorum rule — and restore falls back to the previous complete
+  one (``corrupt_one_shard_never_wins``)
+
 Writes the monitor JSONL to --out-dir as the CI artifact and prints one
 JSON result line. Exit code 0 iff every gate passes.
 """
@@ -23,8 +35,12 @@ import argparse
 import json
 import os
 import sys
+import warnings
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -91,16 +107,86 @@ def main():
     h2 = m2.fit(ds, batch_size=args.batch, epochs=args.epochs, verbose=0,
                 shuffle=False, checkpoint=cm, auto_resume=True,
                 nan_guard="skip")
+    monitor.emit(kind="chaos", event="marker", phase="sharded")
+
+    # -- run 3: SHARDED checkpoints on a 4×2 mesh, preempt mid-run ----------
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.io import sharded as shio
+    from paddle_tpu.parallel import collective
+
+    def smodel(mesh):
+        """The same MLP, tp-row-sharded so sharded saves write real
+        multi-file shards."""
+        m = model()
+        for p in m.parameters():
+            if p.data.ndim == 2 and \
+                    p.shape[0] % mesh.shape["tp"] == 0:
+                collective.shard(p, P("tp", None), mesh)
+            else:
+                collective.replicated(p, mesh)
+        return m
+
+    ckpt2_dir = os.path.join(args.out_dir, "ckpts_sharded")
+    cm2 = CheckpointManager(ckpt2_dir, sharded=True)
+    preempt2 = steps_per_epoch + 1  # epoch 1, batch 1
+    faults.inject("preempt", step=preempt2)
+    mesh_save = collective.make_mesh({"dp": 4, "tp": 2})
+    m3 = smodel(mesh_save)
+    h3 = m3.fit(ds, batch_size=args.batch, epochs=args.epochs, verbose=0,
+                shuffle=False, checkpoint=cm2, save_steps=2)
+    faults.clear()
+    sharded_dir = cm2._sharded_path(preempt2)
+    sharded_save_ok = os.path.isdir(sharded_dir) and \
+        shio.validate(sharded_dir)[0]
+    monitor.emit(kind="chaos", event="marker", phase="resize")
+
+    # -- run 4: resume the sharded checkpoint on a RESIZED 2×4 mesh ---------
+    mesh_resize = collective.make_mesh({"dp": 2, "tp": 4})
+    m4 = smodel(mesh_resize)
+    h4 = m4.fit(ds, batch_size=args.batch, epochs=args.epochs, verbose=0,
+                shuffle=False, checkpoint=cm2, auto_resume=True,
+                save_steps=2)
+    monitor.emit(kind="chaos", event="marker", phase="corrupt")
+
+    # -- run 5: garble ONE shard of the newest checkpoint -------------------
+    valid_before = cm2.valid_steps()
+    newest = cm2._sharded_path(valid_before[-1])
+    shard0 = sorted(f for f in os.listdir(newest)
+                    if f.endswith(".npy"))[0]
+    faults.garble_file(os.path.join(newest, shard0))
+    m5 = smodel(mesh_resize)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        latest_after_shard_corrupt = cm2.latest_step()
+        state5 = cm2.restore(model=m5)
     monitor.disable()
 
-    records = [r for r in monitor.read_jsonl(jsonl)
-               if r.get("kind") == "resilience"]
-    events = {}
-    for r in records:
-        events.setdefault(r["event"], []).append(r)
-    resume_steps = [r.get("step") for r in events.get("auto_resume", [])]
+    all_records = monitor.read_jsonl(jsonl)
+    phases, cur = {"base": []}, "base"
+    for r in all_records:
+        if r.get("kind") == "chaos" and r.get("event") == "marker":
+            cur = r["phase"]
+            phases[cur] = []
+        else:
+            phases.setdefault(cur, []).append(r)
 
-    finite_losses = [float(v) for v in h1["loss"] + h2["loss"]]
+    def by_event(phase, kind="resilience"):
+        out = {}
+        for r in phases.get(phase, []):
+            if r.get("kind") == kind:
+                out.setdefault(r["event"], []).append(r)
+        return out
+
+    events = by_event("base")
+    resume_steps = [r.get("step") for r in events.get("auto_resume", [])]
+    sharded_ev = by_event("sharded")
+    resize_ev = by_event("resize")
+    resize_ckpt_ev = by_event("resize", kind="ckpt")
+
+    finite_losses = [float(v)
+                     for v in h1["loss"] + h2["loss"] + h3["loss"] +
+                     h4["loss"]]
     gates = {
         "loader_fault_fired_twice": loader_spec.fired == 2,
         "nan_fault_fired": nan_spec.fired == 1,
@@ -115,12 +201,27 @@ def main():
         "corrupt_ckpt_quarantined": os.path.exists(bogus + ".corrupt")
         and not os.path.exists(bogus),
         "resumed_at_next_step": resume_steps == [preempt_step + 1],
+        # ISSUE 7 gates ----------------------------------------------------
+        "preempt_triggered_sharded_save": sharded_save_ok and [
+            r.get("step") for r in sharded_ev.get("preempt_save", [])
+        ] == [preempt2],
+        "mesh_resize_resumed_at_next_step": [
+            r.get("step") for r in resize_ev.get("auto_resume", [])
+        ] == [preempt2 + 1] and
+        len(resize_ckpt_ev.get("restore_resharded", [])) >= 1,
+        "corrupt_one_shard_never_wins":
+            latest_after_shard_corrupt == valid_before[-2] and
+            state5 is not None and
+            state5.get("step") == valid_before[-2] and
+            os.path.isdir(newest + ".corrupt"),
     }
     result = {
         "gates": gates,
         "ok": all(gates.values()),
         "run1_loss": h1["loss"],
         "run2_loss": h2["loss"],
+        "run3_loss": h3["loss"],
+        "run4_loss": h4["loss"],
         "jsonl": jsonl,
     }
     print(json.dumps(result))

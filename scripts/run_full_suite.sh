@@ -48,6 +48,16 @@ bash scripts/export_smoke.sh "$MONITOR_DIR/export_smoke"
 exp=$?
 [ $exp -ne 0 ] && rc=$((rc == 0 ? exp : rc))
 
+# chaos gate: every injected fault class absorbed end to end — loader
+# retry, NaN skip, preempt save/resume, quarantine, plus the sharded
+# trio (preempt-triggered sharded save, mesh-resize resume at the exact
+# next step, corrupt-one-shard-never-wins quorum fallback)
+echo ""
+echo "-- chaos smoke gate --"
+bash scripts/chaos_smoke.sh "$MONITOR_DIR/chaos_smoke"
+chs=$?
+[ $chs -ne 0 ] && rc=$((rc == 0 ? chs : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

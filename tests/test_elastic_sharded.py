@@ -1,0 +1,352 @@
+"""Sharded, topology-elastic checkpoints + the elastic recovery loop
+(paddle_tpu.io.sharded / resilience.elastic): per-shard save with a
+checksummed manifest, quorum fallback on missing/corrupt shards,
+restore onto a different dp×tp factorization bit-identically, the
+SIGTERM signal-path flush, and host-loss → mesh-shrink → resume."""
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import hapi, io, monitor, nn, optimizer as popt
+from paddle_tpu.io import CheckpointManager, TensorDataset
+from paddle_tpu.io import sharded as shio
+from paddle_tpu.parallel import collective, layout
+from paddle_tpu.resilience import (ElasticSupervisor, HostLossError,
+                                   PreemptionHandler, faults)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faults.clear()
+    yield
+    faults.clear()
+    collective.set_mesh(None)
+
+
+@pytest.fixture
+def jsonl(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    monitor.enable(path)
+    yield path
+    monitor.disable()
+
+
+def _toy():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 3)
+    x = rng.randn(64, 8).astype("f4")
+    y = (x @ w).argmax(-1).astype("i4")
+    return TensorDataset(x, y)
+
+
+def _model(mesh=None, tp="tp"):
+    """The resilience-test toy model; with a mesh, weights go tp-column
+    sharded so sharded saves produce real multi-file shards."""
+    pt.seed(7)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+    m = hapi.Model(net)
+    if mesh is not None:
+        for p in m.parameters():
+            if p.data.ndim == 2 and all(
+                    d % mesh.shape[tp] == 0 for d in (p.shape[0],)):
+                collective.shard(p, P(tp, None), mesh)
+            else:
+                collective.replicated(p, mesh)
+    m.prepare(optimizer=popt.SGD(learning_rate=0.05,
+                                 parameters=m.parameters()),
+              loss_function=hapi.CrossEntropy())
+    return m
+
+
+def _params(m):
+    return {n: np.asarray(p.numpy()) for n, p in m.network.named_parameters()
+            } if hasattr(m, "network") else {
+        n: np.asarray(p.numpy()) for n, p in m.named_parameters()}
+
+
+# -- layout math ------------------------------------------------------------
+
+def test_mesh_signature_and_equality():
+    mesh = collective.make_mesh({"dp": 4, "tp": 2})
+    sig = layout.mesh_signature(mesh)
+    assert sig["axes"] == {"dp": 4, "tp": 2} and sig["n_devices"] == 8
+    mesh2 = collective.make_mesh({"dp": 2, "tp": 4})
+    assert not layout.same_signature(sig, layout.mesh_signature(mesh2))
+    assert layout.same_signature(sig, dict(sig, platform="tpu"))
+
+
+def test_spec_lists_roundtrip():
+    lists = layout.spec_to_lists(P("dp", None, ("tp", "dp")), 4)
+    assert lists == [["dp"], None, ["tp", "dp"], None]
+    assert tuple(layout.spec_from_lists(lists))[:3] == \
+        tuple(P("dp", None, ("tp", "dp")))[:3]
+
+
+def test_adapt_spec_degrades_never_fails():
+    mesh = collective.make_mesh({"dp": 2, "tp": 2},
+                                devices=jax.devices()[:4])
+    # unknown axis dropped
+    spec, changed = layout.adapt_spec([["sp"], ["tp"]], (8, 8), mesh)
+    assert tuple(spec) == (None, "tp") and changed
+    # non-divisible dim falls back to replication
+    spec, changed = layout.adapt_spec([["dp"], None], (7, 8), mesh)
+    assert tuple(spec) == (None, None) and changed
+    # clean fit passes through
+    spec, changed = layout.adapt_spec([["dp"], ["tp"]], (8, 8), mesh)
+    assert tuple(spec) == ("dp", "tp") and not changed
+
+
+# -- sharded format ---------------------------------------------------------
+
+def test_sharded_save_layout_and_manifest(tmp_path):
+    mesh = collective.make_mesh({"dp": 4, "tp": 2})
+    x = jax.device_put(np.arange(64, dtype="f4").reshape(8, 8),
+                       NamedSharding(mesh, P("dp", "tp")))
+    man = shio.save_state(str(tmp_path / "ck"), {"w": x, "step": 3},
+                          step=3)
+    d = tmp_path / "ck"
+    assert (d / "manifest.json").is_file()
+    npys = sorted(f for f in os.listdir(d) if f.endswith(".npy"))
+    assert len(npys) == 8  # one unique shard per device position
+    assert man["mesh"]["axes"] == {"dp": 4, "tp": 2}
+    ok, why = shio.validate(str(d))
+    assert ok, why
+    state, man2 = shio.load_state(str(d))
+    assert np.array_equal(state["w"], np.asarray(x))
+    assert state["step"] == 3 and man2["step"] == 3
+
+
+def test_sharded_vs_unsharded_bit_identical(tmp_path):
+    mesh = collective.make_mesh({"dp": 4, "tp": 2})
+    m = _model(mesh)
+    m.fit(_toy(), batch_size=16, epochs=1, verbose=0, shuffle=False)
+    want = _params(m)
+
+    cm_s = CheckpointManager(str(tmp_path / "s"), sharded=True)
+    cm_p = CheckpointManager(str(tmp_path / "p"))
+    cm_s.save(0, model=m, optimizer=m._optimizer)
+    cm_p.save(0, model=m, optimizer=m._optimizer)
+
+    r_s, r_p = _model(mesh), _model(mesh)
+    cm_s.restore(model=r_s, optimizer=r_s._optimizer)
+    cm_p.restore(model=r_p, optimizer=r_p._optimizer)
+    for n in want:
+        got_s, got_p = _params(r_s)[n], _params(r_p)[n]
+        assert np.array_equal(got_s, want[n]), n
+        assert np.array_equal(got_s, got_p), n
+
+
+def test_restore_onto_resized_meshes_bit_identical(tmp_path, jsonl):
+    mesh = collective.make_mesh({"dp": 4, "tp": 2})
+    m = _model(mesh)
+    m.fit(_toy(), batch_size=16, epochs=1, verbose=0, shuffle=False)
+    want = _params(m)
+    cm = CheckpointManager(str(tmp_path), sharded=True)
+    cm.save(4, model=m, optimizer=m._optimizer)
+
+    for axes, ndev in (({"dp": 2, "tp": 4}, 8), ({"dp": 2, "tp": 2}, 4)):
+        mesh2 = collective.make_mesh(axes, devices=jax.devices()[:ndev])
+        m2 = _model(mesh2)
+        state = cm.restore(model=m2, optimizer=m2._optimizer)
+        assert state["step"] == 4
+        for n, v in _params(m2).items():
+            assert np.array_equal(v, want[n]), (axes, n)
+        # restored params live on the NEW mesh
+        p0 = next(iter(m2.parameters()))
+        assert p0.data.sharding.mesh.shape == mesh2.shape
+    events = [r for r in monitor.read_jsonl(jsonl)
+              if r.get("kind") == "ckpt"
+              and r.get("event") == "restore_resharded"]
+    assert events, "resized restores must emit ckpt.restore_resharded"
+
+
+def test_place_true_reshards_standalone(tmp_path):
+    mesh = collective.make_mesh({"dp": 4, "tp": 2})
+    x = jax.device_put(np.arange(64, dtype="f4").reshape(8, 8),
+                       NamedSharding(mesh, P("dp", "tp")))
+    shio.save_state(str(tmp_path / "ck"), {"w": x}, step=0)
+    mesh2 = collective.make_mesh({"dp": 2, "tp": 4})
+    state, _ = shio.load_state(str(tmp_path / "ck"), mesh=mesh2,
+                               place=True)
+    assert np.array_equal(np.asarray(state["w"]), np.asarray(x))
+    assert state["w"].sharding.mesh.shape == mesh2.shape
+
+
+# -- quorum rule ------------------------------------------------------------
+
+def _two_sharded_saves(tmp_path):
+    mesh = collective.make_mesh({"dp": 4, "tp": 2})
+    m = _model(mesh)
+    cm = CheckpointManager(str(tmp_path), sharded=True)
+    cm.save(1, model=m, optimizer=m._optimizer)
+    m.fit(_toy(), batch_size=16, epochs=1, verbose=0, shuffle=False)
+    cm.save(2, model=m, optimizer=m._optimizer)
+    return cm, m, mesh
+
+
+def test_missing_shard_falls_back_to_complete(tmp_path, jsonl):
+    cm, m, mesh = _two_sharded_saves(tmp_path)
+    d2 = cm._sharded_path(2)
+    os.remove(os.path.join(d2, sorted(
+        f for f in os.listdir(d2) if f.endswith(".npy"))[0]))
+    assert cm.latest_step() == 1
+    m2 = _model(mesh)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state = cm.restore(model=m2, optimizer=m2._optimizer)
+    assert state["step"] == 1
+    assert os.path.isdir(d2 + ".corrupt")  # quarantined, never wins
+    events = [r for r in monitor.read_jsonl(jsonl)]
+    assert any(r.get("event") == "quorum_fallback" for r in events)
+    assert any(r.get("event") == "ckpt_quarantine" for r in events)
+
+
+def test_bad_checksum_falls_back_to_complete(tmp_path):
+    cm, m, mesh = _two_sharded_saves(tmp_path)
+    d2 = cm._sharded_path(2)
+    shard = sorted(f for f in os.listdir(d2) if f.endswith(".npy"))[0]
+    faults.garble_file(os.path.join(d2, shard))
+    ok, why = shio.validate(d2)
+    assert not ok and "checksum" in why
+    m2 = _model(mesh)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        state = cm.restore(model=m2, optimizer=m2._optimizer)
+    assert state["step"] == 1
+
+
+def test_explicit_corrupt_step_raises(tmp_path):
+    cm, m, _mesh = _two_sharded_saves(tmp_path)
+    d2 = cm._sharded_path(2)
+    os.remove(os.path.join(d2, "manifest.json"))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with pytest.raises(ValueError):
+            cm.restore(model=m, step=2)
+
+
+def test_in_progress_tmp_skipped_silently(tmp_path):
+    cm = CheckpointManager(str(tmp_path))
+    m = _model()
+    cm.save(3, model=m)
+    # step 5: a truncated final + a warm .tmp == save in progress
+    bad = cm._path(5)
+    with open(bad, "wb") as f:
+        f.write(b"partial")
+    with open(bad + ".tmp", "wb") as f:
+        f.write(b"still writing")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any warning fails the test
+        assert cm.latest_step() == 3
+    # the same state 2 minutes later is a crashed save: warn as corrupt
+    old = time.time() - 120
+    os.utime(bad + ".tmp", (old, old))
+    with pytest.warns(UserWarning, match="corrupt"):
+        assert cm.latest_step() == 3
+
+
+# -- faults + metrics -------------------------------------------------------
+
+def test_shard_corrupt_fault_breaks_quorum(tmp_path):
+    mesh = collective.make_mesh({"dp": 4, "tp": 2})
+    m = _model(mesh)
+    cm = CheckpointManager(str(tmp_path), sharded=True)
+    spec = faults.inject("shard_corrupt", step=7)
+    cm.save(7, model=m)
+    assert spec.fired == 1
+    ok, why = shio.validate(cm._sharded_path(7))
+    assert not ok and "checksum" in why
+
+
+def test_shard_slow_write_and_metrics(tmp_path, jsonl):
+    spec = faults.inject("shard_slow_write", times=None, delay=0.01)
+    m = _model()
+    cm = CheckpointManager(str(tmp_path), sharded=True)
+    t0 = time.perf_counter()
+    cm.save(0, model=m)
+    assert time.perf_counter() - t0 >= 0.01
+    assert spec.fired >= 1
+    snap = monitor.snapshot("ckpt.")
+    assert snap["ckpt.shard_bytes"] > 0
+    assert snap["ckpt.shard_seconds"]["count"] >= 1
+
+
+def test_host_loss_fault_raises_typed_error():
+    faults.inject("host_loss", step=2, lost=4)
+    with pytest.raises(HostLossError) as ei:
+        _model().fit(_toy(), batch_size=16, epochs=1, verbose=0,
+                     shuffle=False)
+    assert ei.value.lost == 4
+
+
+# -- preempt flush ----------------------------------------------------------
+
+def test_signal_flush_saves_last_completed_step(jsonl):
+    saved = []
+    h = PreemptionHandler().attach(save_fn=saved.append)
+    h.notify_step(4)
+    h.request(signum=15)
+    assert saved == [4] and h.flushed_step == 4
+    events = [r for r in monitor.read_jsonl(jsonl)
+              if r.get("event") == "preempt_save"]
+    assert events and events[0]["step"] == 4
+    assert events[0]["where"] == "signal_flush"
+
+
+def test_signal_flush_failure_never_raises():
+    def boom(step):
+        raise OSError("disk gone")
+    h = PreemptionHandler().attach(save_fn=boom)
+    h.notify_step(1)
+    with pytest.warns(UserWarning, match="final save"):
+        h.request(signum=15)
+    assert h.triggered and h.flushed_step is None
+
+
+# -- elastic recovery loop --------------------------------------------------
+
+def test_elastic_resize_resumes_exact_next_step(tmp_path, jsonl):
+    cm = CheckpointManager(str(tmp_path), sharded=True)
+    sup = ElasticSupervisor(checkpoint=cm, mesh_axes={"dp": 4, "tp": 2},
+                            max_restarts=2)
+    faults.inject("host_loss", step=5, lost=4)
+
+    def train(attempt):
+        m = _model(attempt.mesh)
+        return m.fit(_toy(), batch_size=16, epochs=3, verbose=0,
+                     shuffle=False, checkpoint=cm, save_steps=2,
+                     auto_resume=attempt.auto_resume)
+
+    sup.run(train)
+    assert [a.axes for a in sup.attempts] == \
+        [{"dp": 4, "tp": 2}, {"dp": 2, "tp": 2}]
+    events = monitor.read_jsonl(jsonl)
+    kinds = [r.get("event") for r in events]
+    assert "elastic_restart" in kinds and "elastic_resize" in kinds
+    # host died at step 5; last periodic save was step 3 → resume at 4
+    resumes = [r for r in events if r.get("event") == "auto_resume"]
+    assert resumes and resumes[-1]["step"] == 4
+    resized = [r for r in events if r.get("event") == "elastic_resize"]
+    assert resized[0]["planned"] == {"dp": 2, "tp": 2}
+
+
+def test_elastic_budget_exhaustion_reraises(tmp_path):
+    cm = CheckpointManager(str(tmp_path), sharded=True)
+    sup = ElasticSupervisor(checkpoint=cm, mesh_axes={"dp": 4, "tp": 2},
+                            max_restarts=0)
+    faults.inject("host_loss", step=1)
+
+    def train(attempt):
+        m = _model(attempt.mesh)
+        return m.fit(_toy(), batch_size=16, epochs=1, verbose=0,
+                     shuffle=False, checkpoint=cm,
+                     auto_resume=attempt.auto_resume)
+
+    with pytest.raises(HostLossError):
+        sup.run(train)
