@@ -373,6 +373,47 @@ def bench_serving(requests=400, clients=8, max_batch=32,
     return pct(0.50), pct(0.99), len(lat) / wall, mean_fill
 
 
+def bench_collective_overlap(timeout_s=600):
+    """Gradient-communication stage: runs scripts/comm_smoke.py in a
+    subprocess pinned to 8 virtual CPU devices (the collective ring
+    needs a multi-device mesh regardless of what backend the rest of
+    the bench runs on) and banks its measurements — exposed wire
+    seconds exact vs overlap, bucket count, wire/logical comm bytes,
+    quantized loss parity. The sentinel bands these via
+    collective_overlap_* keys."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "comm_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir", "/tmp/paddle_tpu_bench_comm"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"comm_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    return {
+        "collective_overlap_exposed_wire_s":
+            r["exposed_wire_overlap_s"],
+        "collective_overlap_exact_wire_s": r["exposed_wire_exact_s"],
+        "collective_overlap_ratio": r["overlap_ratio"],
+        "collective_overlap_bucket_count": r["bucket_count"],
+        "comm_bytes_logical": r["comm_bytes_logical"],
+        "comm_bytes_wire_int8": r["comm_bytes_wire_int8"],
+        "comm_wire_reduction_int8_x": r["wire_reduction_int8_x"],
+        "comm_wire_reduction_int4_x": r["wire_reduction_int4_x"],
+        "comm_quantized_loss_rel_err": r["quantized_loss_rel_err"],
+    }
+
+
 _RESULTS = {}  # metrics banked as each stage finishes (partial-credit)
 
 
@@ -713,6 +754,15 @@ def main():
             _RESULTS[key.replace("_tokens_per_sec", "_mfu")] = \
                 _mfu(tps, _bert_flops_per_token())
             _note_mfu_divergence(key.replace("_tokens_per_sec", ""))
+        try:
+            comm = bench_collective_overlap()
+        except Exception as e:
+            print(f"collective_overlap bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial collective_overlap_ratio="
+                  f"{comm['collective_overlap_ratio']}", flush=True)
+            _RESULTS.update(comm)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

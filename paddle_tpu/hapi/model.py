@@ -186,7 +186,7 @@ class Model(Layer):
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, prefetch=0, bucket=False, checkpoint=None,
             save_steps=None, auto_resume=False, nan_guard=None,
-            watchdog=None, metrics_port=None):
+            watchdog=None, metrics_port=None, grad_sync=None):
         """reference hapi/model.py:1128 fit.
 
         TPU pipelining extensions: ``prefetch=N`` stages the next N
@@ -215,8 +215,17 @@ class Model(Layer):
         ``/metrics`` (OpenMetrics), ``/healthz`` (watchdog/NaN-guard
         state), ``/snapshot``; use 0 for an ephemeral port
         (``monitor.export.port()`` reports it). The server outlives
-        fit() — ``monitor.disable()`` tears it down."""
+        fit() — ``monitor.disable()`` tears it down.
+
+        Communication extension: ``grad_sync``
+        ("exact"|"quantized"|"overlap", or a
+        parallel.overlap.GradSyncScheduler) attaches a gradient-sync
+        scheduler to the optimizer — see docs/performance.md
+        "Communication overlap & quantized sync" for what each mode
+        means at this (GSPMD-synced) level vs explicit-DDP loops."""
         assert self._optimizer is not None, "call prepare() first"
+        if grad_sync is not None:
+            self._optimizer.set_grad_sync(grad_sync)
         from ..resilience import faults as _faults
         from ..resilience._common import record as _rrecord
 

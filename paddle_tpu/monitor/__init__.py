@@ -76,6 +76,28 @@ Serving series (docs/serving.md; ``paddle_tpu.serving``):
 * ``inference.{compile,cache_hit,aot_warmup,bucket_pad}`` — the
   underlying Predictor's executable-cache accounting
 
+Gradient-communication series (docs/performance.md "Communication
+overlap & quantized sync"; ``paddle_tpu.parallel.overlap``):
+
+* ``comm.bytes_logical`` / ``comm.bytes_wire`` — f32 payload bytes of
+  each gradient sync vs the bytes of its wire representation (f32 for
+  exact/overlap, int8/packed-int4 + per-hop scales for quantized) —
+  the quantization saving is their ratio
+* ``comm.buckets`` / ``comm.bucket_compile`` / ``comm.reduce_launch``
+  — bucket plan size, distinct bucket-reduce executables minted (must
+  stop growing after the first step of each mode), and launched bucket
+  reduces
+* ``comm.exposed_wait_s`` (histogram) / ``comm.exposed_wait_s_total``
+  — seconds the step loop spent *blocked* on unfinished reduces: the
+  exposed wire time overlap mode is built to remove (bench.py's
+  ``collective_overlap`` stage gates on it)
+* ``comm.sync.<mode>`` / ``comm.lag_warmup`` — sync calls per mode and
+  lag-1 warm-up steps that had no previous grads to apply
+* ``comm.bucket_reduce`` / ``comm.wait`` trace spans — bucket reduces
+  (on the ``comm-worker`` thread track in overlap mode, where their
+  overlap with backward compute is *visible* in the Chrome export) and
+  the blocking collect
+
 Span tracing & XLA-measured cost (PR 4's additions):
 
 * ``monitor.trace``  — thread-aware span tracer (``span()`` context

@@ -37,11 +37,20 @@ class Optimizer:
 
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
-                 regularization=None):
+                 regularization=None, grad_sync=None):
         if parameters is not None and not isinstance(parameters,
                                                      (list, tuple)):
             parameters = list(parameters)
         self._parameter_list = list(parameters) if parameters else None
+        # gradient-sync scheduler (parallel.overlap): a mode string
+        # ("exact"|"quantized"|"overlap") or a GradSyncScheduler. Under
+        # GSPMD the grads reaching step() are already reduced, so at
+        # this level the scheduler contributes lag-1 apply pipelining +
+        # comm.* accounting; wire-level bucketed/quantized reduces live
+        # in explicit-DDP loops (scheduler.reduce) and megatron.
+        self._grad_sync = None
+        if grad_sync is not None:
+            self.set_grad_sync(grad_sync)
         self._grad_clip = grad_clip
         # weight_decay may be a float (L2) or a regularizer object
         wd = weight_decay if weight_decay is not None else regularization
@@ -123,6 +132,18 @@ class Optimizer:
                                  cls=type(self).__name__):
             self._step_body()
 
+    def set_grad_sync(self, grad_sync):
+        """Attach a gradient-sync scheduler (a mode string builds one
+        over the registered mesh). See parallel.overlap."""
+        from ..parallel.overlap import GradSyncScheduler
+        if isinstance(grad_sync, str):
+            if grad_sync == "exact":
+                self._grad_sync = None
+                return self
+            grad_sync = GradSyncScheduler(mode=grad_sync)
+        self._grad_sync = grad_sync
+        return self
+
     def _step_body(self):
         if self._lr_decay is not None:
             # host-side schedule: advance + refresh the device lr tensor
@@ -130,6 +151,10 @@ class Optimizer:
             self._set_lr_value(self._lr_decay())
         params_grads = [(p, p._grad) for p in self._params()
                         if not (p.stop_gradient or p._grad is None)]
+        if self._grad_sync is not None:
+            params_grads = self._grad_sync.process(params_grads)
+            if params_grads is None:
+                return  # lag-1 warm-up: this step's grads are in flight
         # reference order (optimizer.py:apply_gradients): clip raw grads
         # first, then append the regularization term. Per-param clips
         # (set_gradient_clip param_list) go first, then the optimizer's

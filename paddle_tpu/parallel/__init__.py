@@ -4,7 +4,11 @@ parallel; redesigned over jax.sharding / shard_map / ICI collectives)."""
 from . import collective
 from .collective import (make_mesh, get_mesh, set_mesh, shard, replicated,
                          all_reduce, all_gather, reduce_scatter, broadcast,
-                         all_to_all, ppermute, barrier)
+                         all_to_all, ppermute, barrier,
+                         all_reduce_quantized, matmul_reduce_scatter)
+from . import overlap
+from .overlap import (GradSyncScheduler, local_value_and_grad, sync_tree,
+                      plan_buckets)
 from . import layout
 from .layout import mesh_signature, extract_layout, adapt_spec, reshard
 from .env import ParallelEnv, prepare_context

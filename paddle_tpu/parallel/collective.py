@@ -149,12 +149,18 @@ def _account(op, x, axis_name):
 
 
 def all_reduce(x, op="sum", axis_name="dp", group=None):
-    """c_allreduce_* → lax.psum/pmax/pmin on the ICI mesh axis."""
+    """c_allreduce_* → lax.psum/pmean/pmax/pmin on the ICI mesh axis.
+    ``op="mean"`` is first-class (lax.pmean) — callers must not
+    hand-divide a psum by the axis size."""
     if not _maybe(axis_name):
         return as_tensor(x)
     _account(f"c_allreduce_{op}", x, axis_name)
-    fns = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
+    fns = {"sum": lax.psum, "mean": lax.pmean, "max": lax.pmax,
+           "min": lax.pmin,
            "prod": lambda v, n: jnp.exp(lax.psum(jnp.log(v), n))}
+    if op not in fns:
+        raise ValueError(
+            f"all_reduce op {op!r} unknown; supported: {sorted(fns)}")
     fn = fns[op]
     return apply(lambda x: fn(x, axis_name), (x,), name=f"c_allreduce_{op}")
 
@@ -241,38 +247,124 @@ _c_reducescatter = reduce_scatter
 _c_broadcast = broadcast
 
 
-def all_reduce_quantized(x, axis_name="dp", bits=8):
-    """Quantized ring all-reduce: int8 chunks + one f32 scale per hop
-    on the wire instead of f32 tensors (the EQuARX direction,
-    arxiv 2506.17615; the reference's analogous bandwidth lever is DGC
-    sparsification over NCCL). Ring reduce-scatter then ring
-    all-gather, n-1 ppermute hops each, with per-hop symmetric
-    requantization — wire bytes drop ~4x for bf16/f32 grads at a
-    bounded quantization error that grows with ring length (callers
-    should reserve it for bandwidth-bound DCN/large-dp regimes; exact
-    psum stays the default everywhere).
+def matmul_reduce_scatter(x, w, axis_name="tp", fused=True):
+    """Fused matmul-then-reduce-scatter for the tensor-parallel exit of
+    a row-split layer (fused computation-collectives, arxiv 2305.06942;
+    reference analogue: the c_reducescatter op a Megatron row layer
+    would issue after its partial matmul).
 
-    Only meaningful inside shard_map with `axis_name`; returns the
-    SUM over the axis (like lax.psum). bits=8 only (int8 wire)."""
-    if bits != 8:
-        raise ValueError("int8 wire only (bits=8)")
+    ``x @ w`` where x is [m, k_local] and w is [k_local, N] with N
+    divisible by the axis size; every rank holds a partial [m, N]
+    product that must be reduce-scattered over the last dim. The
+    unfused form is ``lax.psum_scatter(x @ w, ...)`` — the full partial
+    product materialises, then the wire moves it. The fused schedule
+    interleaves per-block matmuls with ring ppermute hops of the
+    accumulator (start at column block (r-1)%n, permute forward, add
+    block (r-t-2)%n each hop), so the collective for block t rides
+    under the matmul for block t+1 and rank r ends holding fully
+    reduced block r — bit-compatible layout with
+    ``lax.psum_scatter(..., tiled=True)``. Outside an SPMD region it
+    degrades to the plain local matmul (reduce_scatter's identity
+    semantics)."""
+    if not _maybe(axis_name):
+        a = x.data if isinstance(x, Tensor) else x
+        b = w.data if isinstance(w, Tensor) else w
+        return as_tensor(jnp.asarray(a) @ jnp.asarray(b))
+    _account("matmul_reduce_scatter", w, axis_name)
+
+    def impl(x, w):
+        n = axis_size(axis_name)
+        m, N = x.shape[0], w.shape[1]
+        if N % n:
+            raise ValueError(
+                f"matmul_reduce_scatter: output dim {N} not divisible "
+                f"by axis {axis_name!r} size {n}")
+        bs = N // n
+        if not fused:
+            return lax.psum_scatter(x @ w, axis_name,
+                                    scatter_dimension=1, tiled=True)
+        r = lax.axis_index(axis_name)
+        fwd = [(i, (i + 1) % n) for i in range(n)]
+
+        def block(j):
+            return x @ lax.dynamic_slice(w, (0, j * bs),
+                                         (w.shape[0], bs))
+
+        acc = block((r - 1) % n)
+        for t in range(n - 1):
+            acc = lax.ppermute(acc, axis_name, fwd)
+            acc = acc + block((r - t - 2) % n)
+        return acc
+
+    return apply(impl, (x, w), name="matmul_reduce_scatter")
+
+
+QUANTIZED_WIRE_BITS = (4, 8)
+
+
+def all_reduce_quantized(x, axis_name="dp", bits=8, op="sum"):
+    """Quantized ring all-reduce: int8 (or packed-int4) chunks + one
+    f32 scale per hop on the wire instead of f32 tensors (the EQuARX
+    direction, arxiv 2506.17615; the reference's analogous bandwidth
+    lever is DGC sparsification over NCCL). Ring reduce-scatter then
+    ring all-gather, n-1 ppermute hops each, with per-hop symmetric
+    requantization — wire bytes drop ~4x (int8) / ~8x (int4, two
+    values packed per byte) for bf16/f32 grads at a bounded
+    quantization error that grows with ring length (callers should
+    reserve it for bandwidth-bound DCN/large-dp regimes; exact psum
+    stays the default everywhere).
+
+    Only meaningful inside shard_map with `axis_name`; returns the SUM
+    over the axis (like lax.psum), or the mean with ``op="mean"`` —
+    the division happens once, after the ring, so both ops share one
+    wire schedule."""
+    if bits not in QUANTIZED_WIRE_BITS:
+        raise ValueError(
+            f"quantized wire width bits={bits} unsupported; supported "
+            f"widths: {QUANTIZED_WIRE_BITS} (int8, packed int4)")
+    if op not in ("sum", "mean"):
+        raise ValueError(
+            f"all_reduce_quantized op {op!r} unknown; supported: "
+            f"['mean', 'sum']")
     n = axis_size(axis_name)
     if n == 1:
         return x
-    qmax = 127.0
+    qmax = 127.0 if bits == 8 else 7.0
 
     shape = x.shape
     flat = x.reshape(-1).astype(jnp.float32)
     c = -(-flat.shape[0] // n)
+    if bits == 4:
+        c += c % 2  # packed pairs: chunk length must be even
     flat = jnp.pad(flat, (0, n * c - flat.shape[0]))
     chunks = flat.reshape(n, c)
     r = lax.axis_index(axis_name)
     fwd = [(i, (i + 1) % n) for i in range(n)]
 
-    def quant(v):
-        s = jnp.max(jnp.abs(v)) / qmax + 1e-30
-        q = jnp.clip(jnp.round(v / s), -127, 127).astype(jnp.int8)
-        return q, s
+    if bits == 8:
+        def quant(v):
+            s = jnp.max(jnp.abs(v)) / qmax + 1e-30
+            q = jnp.clip(jnp.round(v / s), -127, 127).astype(jnp.int8)
+            return q, s
+
+        def dequant(q, s):
+            return q.astype(jnp.float32) * s
+    else:
+        # packed int4: q ∈ [-7, 7] biased to [1, 15], two nibbles per
+        # uint8 byte — ~8x less wire than f32 plus one scale per hop
+        def quant(v):
+            s = jnp.max(jnp.abs(v)) / qmax + 1e-30
+            q = jnp.clip(jnp.round(v / s), -7, 7)
+            b = (q + 8.0).astype(jnp.uint8)
+            packed = b[..., 0::2] | (b[..., 1::2] << 4)
+            return packed, s
+
+        def dequant(packed, s):
+            lo = (packed & 0xF).astype(jnp.float32) - 8.0
+            hi = (packed >> 4).astype(jnp.float32) - 8.0
+            q = jnp.stack([lo, hi], axis=-1).reshape(
+                packed.shape[:-1] + (2 * packed.shape[-1],))
+            return q * s
 
     # ring reduce-scatter: after n-1 hops rank r owns the fully
     # reduced chunk (r + 1) % n
@@ -283,7 +375,7 @@ def all_reduce_quantized(x, axis_name="dp", bits=8):
         q, s = quant(piece)
         q = lax.ppermute(q, axis_name, fwd)
         s = lax.ppermute(s, axis_name, fwd)
-        got = q.astype(jnp.float32) * s
+        got = dequant(q, s)
         cur = lax.dynamic_slice(chunks, (recv_idx, 0), (1, c))
         chunks = lax.dynamic_update_slice(chunks, cur + got,
                                           (recv_idx, 0))
@@ -297,15 +389,20 @@ def all_reduce_quantized(x, axis_name="dp", bits=8):
     own = lax.dynamic_slice(chunks, (own_idx, 0), (1, c))
     q, s = quant(own)
     # store the dequantized form locally too — identical on all ranks
-    chunks = lax.dynamic_update_slice(
-        chunks, q.astype(jnp.float32) * s, (own_idx, 0))
+    chunks = lax.dynamic_update_slice(chunks, dequant(q, s),
+                                      (own_idx, 0))
     for t in range(n - 1):
         q = lax.ppermute(q, axis_name, fwd)
         s = lax.ppermute(s, axis_name, fwd)
         idx = (r - t) % n  # arriving chunk originated at rank
         # (r - t - 1), which owns chunk (r - t) % n
-        chunks = lax.dynamic_update_slice(
-            chunks, q.astype(jnp.float32) * s, (idx, 0))
+        chunks = lax.dynamic_update_slice(chunks, dequant(q, s),
+                                          (idx, 0))
 
-    return chunks.reshape(-1)[:int(np.prod(shape))].reshape(shape) \
-        .astype(x.dtype)
+    out = chunks.reshape(-1)[:int(np.prod(shape))].reshape(shape)
+    if op == "mean":
+        # one division AFTER the ring: every rank scales the identical
+        # dequantized sum, so the cross-rank bit-equality invariant of
+        # the all-gather phase survives
+        out = out / n
+    return out.astype(x.dtype)

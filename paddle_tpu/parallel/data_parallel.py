@@ -10,6 +10,14 @@ the dp axis makes XLA's GSPMD partitioner emit the gradient all-reduce on
 ICI automatically inside the compiled train step. scale_loss /
 apply_collective_grads are therefore identity shims kept for API parity —
 the math they performed (grad-sum ÷ nranks) is what GSPMD produces.
+
+``grad_sync="overlap"|"quantized"|"exact"`` attaches a
+:class:`~paddle_tpu.parallel.overlap.GradSyncScheduler` (exposed as
+``.grad_scheduler``): explicit-DDP loops feed it stacked per-rank grads
+(``overlap.local_value_and_grad``) for bucketed / overlapped /
+quantized-ring sync, and ``apply_collective_grads`` drains any
+in-flight bucket reduces — see docs/performance.md "Communication
+overlap & quantized sync".
 """
 from __future__ import annotations
 
@@ -23,7 +31,9 @@ from . import collective
 class DataParallel(Layer):
     """reference: dygraph/parallel.py:DataParallel."""
 
-    def __init__(self, layers, strategy=None, mesh=None):
+    def __init__(self, layers, strategy=None, mesh=None,
+                 grad_sync=None, grad_bits=8, grad_bucket_bytes=None,
+                 async_apply=None):
         super().__init__()
         self._layers = layers
         mesh = mesh or collective.get_mesh()
@@ -33,6 +43,14 @@ class DataParallel(Layer):
         if mesh is not None:
             fleet._mesh = fleet._mesh or mesh
             fleet.shard_model(layers)
+        self.grad_scheduler = None
+        if grad_sync is not None and grad_sync != "exact":
+            from .overlap import (DEFAULT_BUCKET_BYTES,
+                                  GradSyncScheduler)
+            self.grad_scheduler = GradSyncScheduler(
+                mode=grad_sync, mesh=mesh, bits=grad_bits,
+                bucket_bytes=grad_bucket_bytes or DEFAULT_BUCKET_BYTES,
+                async_apply=async_apply)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
@@ -43,8 +61,12 @@ class DataParallel(Layer):
         return loss
 
     def apply_collective_grads(self):
-        """Parity shim: GSPMD emits the grad allreduce inside the compiled
-        step; nothing to do here."""
+        """GSPMD emits the grad allreduce inside the compiled step, so
+        without a grad scheduler this stays a parity no-op; with one it
+        drains the in-flight bucket reduces (the lag-1 tail) so every
+        launched gradient lands before the caller reads params."""
+        if self.grad_scheduler is not None:
+            return self.grad_scheduler.flush()
         return
 
     def state_dict(self, *args, **kwargs):

@@ -51,8 +51,19 @@ class MegatronConfig(NamedTuple):
     # int8-wire ring all-reduce for the dp gradient sync
     # (collective.all_reduce_quantized, EQuARX direction / the
     # reference's DGC bandwidth lever) — opt-in: ~4x less gradient
-    # traffic at a bounded quantization error; exact psum by default
+    # traffic at a bounded quantization error; exact psum by default.
+    # Kept for back-compat: equivalent to grad_sync="quantized".
     quantized_grad_allreduce: bool = False
+    # dp gradient sync plan (parallel.overlap.sync_tree):
+    #   "exact"     — per-leaf lax.pmean (the default, no bucketing)
+    #   "quantized" — bucketed int8/int4 ring (grad_bits wire width)
+    #   "overlap"   — bucketed exact reduce; the per-bucket collectives
+    #                 are independent so XLA interleaves them with the
+    #                 remaining backward compute inside the one
+    #                 shard_map program
+    grad_sync: str = "exact"
+    grad_bits: int = 8
+    grad_bucket_bytes: int = 4 << 20
 
 
 def factorize_mesh(n_devices):
@@ -464,15 +475,21 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
         # reference's c_allreduce on NCCL — here psum over dp and sp (tp/pp/
         # ep-sharded params already got their grads via their own psums in
         # the forward transpose).
-        if cfg.quantized_grad_allreduce:
-            from .collective import all_reduce_quantized
-            n_dp = _axis_size("dp")
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.pmean(
-                    all_reduce_quantized(g, "dp") / n_dp, "sp"), grads)
-        else:
+        mode = cfg.grad_sync
+        if cfg.quantized_grad_allreduce and mode == "exact":
+            mode = "quantized"  # legacy knob
+        if mode == "exact":
             grads = jax.tree_util.tree_map(
                 lambda g: lax.pmean(lax.pmean(g, "dp"), "sp"), grads)
+        else:
+            # bucketed (optionally quantized-ring) dp sync with
+            # op="mean" — the mean happens inside the collective, no
+            # hand-division by the axis size here
+            from .overlap import sync_tree
+            grads = sync_tree(
+                grads, axis_name="dp", mode=mode, bits=cfg.grad_bits,
+                bucket_bytes=cfg.grad_bucket_bytes, op="mean",
+                extra_mean_axes=("sp",))
         t = state["t"] + 1
         if cfg.optimizer == "adam":
             tf = t.astype(jnp.float32)

@@ -628,7 +628,8 @@ class Executor:
                            fetch_info=None, print_period=100,
                            prefetch=0, bucket=False, buckets=None,
                            checkpoint=None, save_steps=None,
-                           auto_resume=False, nan_guard=None):
+                           auto_resume=False, nan_guard=None,
+                           grad_sync=None):
         """reference executor.py:train_from_dataset — run the program
         over every batch a fluid.dataset yields. The reference spawns
         C++ DataFeed threads; here each host-assembled MultiSlot batch
@@ -645,7 +646,13 @@ class Executor:
         ``save_steps`` batches and on SIGTERM/SIGINT; ``auto_resume=True``
         restores the newest valid checkpoint and skips already-trained
         batches; ``nan_guard`` (a resilience.NaNGuard or policy string)
-        guards every step."""
+        guards every step.
+
+        ``grad_sync`` ("exact"|"quantized"|"overlap" or a
+        parallel.overlap.GradSyncScheduler) attaches a gradient-sync
+        scheduler to every optimizer the program recorded (see
+        docs/performance.md "Communication overlap & quantized
+        sync")."""
         if dataset is None:
             raise RuntimeError("dataset is required for train_from_dataset")
         fetch_list = fetch_list or []
@@ -654,6 +661,9 @@ class Executor:
 
         prog = program or default_main_program()
         real_prog = prog.program if isinstance(prog, CompiledProgram) else prog
+        if grad_sync is not None:
+            for _opt, _ in getattr(real_prog, "optimizers", []):
+                _opt.set_grad_sync(grad_sync)
         cm = None
         if checkpoint is not None:
             from ..io import CheckpointManager

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Gradient-communication smoke gate: bucketed/overlapped/quantized
+# collectives on 8 virtual CPU devices. See scripts/comm_smoke.py for
+# the gates. Usage: comm_smoke.sh [out_dir]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_comm_smoke}"
+JAX_PLATFORMS=cpu python scripts/comm_smoke.py --out-dir "$OUT_DIR"

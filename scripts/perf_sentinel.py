@@ -68,6 +68,25 @@ METRICS = [
      "higher", 0.25),
     ("serving_p99_ms", ("serving_p99_ms", "p99_ms"),
      ("serving_p99_ms", "p99_ms"), "lower", 0.50),
+    # gradient-communication stage (bench_collective_overlap): exposed
+    # wire seconds breathe with CI load (wide bands); bucket count and
+    # wire bytes are deterministic functions of the model + bucket size
+    # (tight bands — drift means the bucketing or wire format changed)
+    ("collective_overlap_exposed_wire_s",
+     ("collective_overlap_exposed_wire_s",),
+     ("collective_overlap_exposed_wire_s",), "lower", 1.00),
+    ("collective_overlap_ratio",
+     ("collective_overlap_ratio",), ("collective_overlap_ratio",),
+     "lower", 0.75),
+    ("collective_overlap_bucket_count",
+     ("collective_overlap_bucket_count",),
+     ("collective_overlap_bucket_count",), "lower", 0.10),
+    ("comm_bytes_wire_int8",
+     ("comm_bytes_wire_int8",), ("comm_bytes_wire_int8",),
+     "lower", 0.10),
+    ("comm_wire_reduction_int4_x",
+     ("comm_wire_reduction_int4_x",), ("comm_wire_reduction_int4_x",),
+     "higher", 0.10),
 ]
 
 

@@ -58,6 +58,16 @@ bash scripts/chaos_smoke.sh "$MONITOR_DIR/chaos_smoke"
 chs=$?
 [ $chs -ne 0 ] && rc=$((rc == 0 ? chs : rc))
 
+# comm gate: bucketed/overlapped/quantized grad collectives on 8
+# virtual CPU devices — overlap hides wire time (<=60% of exact,
+# reduce spans overlap backward in the Chrome trace), no compile tax,
+# int8/int4 wire-byte honesty, lag-1 resumes bit-identical
+echo ""
+echo "-- comm smoke gate --"
+bash scripts/comm_smoke.sh "$MONITOR_DIR/comm_smoke"
+cms=$?
+[ $cms -ne 0 ] && rc=$((rc == 0 ? cms : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

@@ -1,0 +1,320 @@
+"""Gradient-communication plane (ISSUE 8): bucketed/overlapped/
+quantized collectives — ring properties over lengths {2,4,8}, the
+fused matmul-reduce-scatter, sync_tree, and the GradSyncScheduler's
+lag-1 + checkpoint discipline."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu.parallel import collective, overlap
+
+
+def _ring(n):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("dp",))
+
+
+# -- satellite: quantized ring widths over ring lengths {2,4,8} -----------
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("bits,rel_bound", [(8, 0.05), (4, 0.35)])
+def test_quantized_ring_bounded_error_and_bit_equality(n, bits,
+                                                       rel_bound):
+    """Property pair the wire format must satisfy at every ring length:
+    max-abs error bounded relative to the exact sum's scale (per-hop
+    requant compounds, so int4 gets the looser bound), and the
+    dequantized result BIT-IDENTICAL on every rank (the all-gather hop
+    distributes one owner-quantized chunk; ranks never dequantize
+    independently)."""
+    rng = np.random.RandomState(n * 10 + bits)
+    per_dev = rng.randn(n, 501).astype("f4")  # odd len: int4 pad path
+    exact = per_dev.sum(0)
+    out = np.asarray(jax.jit(collective.shard_map_compat(
+        lambda x: collective.all_reduce_quantized(
+            x, axis_name="dp", bits=bits),
+        _ring(n), in_specs=P("dp", None),
+        out_specs=P("dp", None), check_vma=False))(per_dev))
+    scale = np.abs(exact).max()
+    assert np.abs(out[0] - exact).max() / scale < rel_bound
+    for rk in range(1, n):
+        np.testing.assert_array_equal(out[rk], out[0])
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_quantized_ring_mean_op(n):
+    """op="mean" divides ONCE after the ring — same bit-equality as
+    sum, value == sum/n exactly."""
+    rng = np.random.RandomState(n)
+    per_dev = rng.randn(n, 64).astype("f4")
+
+    def body(x):
+        s = collective.all_reduce_quantized(x, axis_name="dp", op="sum")
+        m = collective.all_reduce_quantized(x, axis_name="dp",
+                                            op="mean")
+        return s, m
+
+    s, m = jax.jit(collective.shard_map_compat(
+        body, _ring(n), in_specs=P("dp", None),
+        out_specs=(P("dp", None), P("dp", None)),
+        check_vma=False))(per_dev)
+    np.testing.assert_array_equal(np.asarray(m),
+                                  np.asarray(s) / np.float32(n))
+    for rk in range(1, n):
+        np.testing.assert_array_equal(np.asarray(m)[rk],
+                                      np.asarray(m)[0])
+
+
+def test_quantized_width_and_op_validation():
+    """Unsupported widths fail loudly, naming the supported set."""
+    with pytest.raises(ValueError, match=r"4, 8"):
+        collective.all_reduce_quantized(np.ones(4), bits=2)
+    with pytest.raises(ValueError, match=r"16"):
+        collective.all_reduce_quantized(np.ones(4), bits=16)
+    with pytest.raises(ValueError):
+        collective.all_reduce_quantized(np.ones(4), op="max")
+
+
+# -- satellite: first-class mean reduce -----------------------------------
+
+def test_all_reduce_mean_first_class():
+    """op="mean" routes through lax.pmean directly (no hand-divide),
+    and an unknown op names the supported set."""
+    per_dev = np.arange(8.0, dtype="f4").reshape(8, 1)
+    out = collective.shard_map_compat(
+        lambda x: collective.all_reduce(pt.Tensor(x), op="mean",
+                                        axis_name="dp").data,
+        _ring(8), in_specs=P("dp"), out_specs=P("dp"))(per_dev)
+    np.testing.assert_allclose(np.asarray(out).ravel(), [3.5] * 8)
+    with pytest.raises(ValueError, match="supported"):
+        collective.shard_map_compat(
+            lambda x: collective.all_reduce(pt.Tensor(x), op="median",
+                                            axis_name="dp").data,
+            _ring(8), in_specs=P("dp"), out_specs=P("dp"))(per_dev)
+
+
+# -- tentpole: fused matmul-then-reduce-scatter (tp path) -----------------
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_matmul_reduce_scatter_matches_unfused(n):
+    """The fused ring schedule (per-block matmul interleaved with
+    ppermute hops of the accumulator) must equal the unfused
+    psum_scatter(x @ w) reference at every ring length."""
+    rng = np.random.RandomState(n)
+    m, k, N = 8, 4 * n, 16
+    xs = rng.randn(n, m, k // n).astype("f4")
+    w = rng.randn(k // n, N).astype("f4")
+
+    def run(fused):
+        return np.asarray(jax.jit(collective.shard_map_compat(
+            lambda x: collective.matmul_reduce_scatter(
+                x[0], w, axis_name="dp", fused=fused).data[None],
+            _ring(n), in_specs=P("dp"),
+            out_specs=P("dp"), check_vma=False))(xs))
+
+    np.testing.assert_allclose(run(True), run(False), atol=1e-4)
+    # eager fallback (no axis context) is a plain matmul
+    eager = collective.matmul_reduce_scatter(xs[0], w)
+    np.testing.assert_allclose(np.asarray(eager.data), xs[0] @ w,
+                               rtol=1e-6)
+
+
+# -- tentpole: bucket planning + in-SPMD bucketed sync --------------------
+
+def test_plan_buckets_properties():
+    sizes = [10, 20, 1000, 5, 5, 2000, 1]
+    plan = overlap.plan_buckets(sizes, bucket_bytes=400, itemsize=4)
+    # partition: every index exactly once, order preserved
+    flat = [i for b in plan for i in b]
+    assert flat == list(range(len(sizes)))
+    cap = 400 // 4
+    for b in plan:
+        total = sum(sizes[i] for i in b)
+        assert total <= cap or len(b) == 1  # oversized leaf rides alone
+    assert [1000] == [sizes[i] for b in plan for i in b if len(b) == 1
+                      and sizes[b[0]] > cap][:1]
+    assert overlap.plan_buckets([], 400) == []
+
+
+@pytest.mark.parametrize("mode", ["exact", "quantized", "overlap"])
+def test_sync_tree_inside_shard_map(mode):
+    """sync_tree reduces every leaf over the axis (mean), restoring
+    shapes/dtypes, for all three modes; quantized within wire error."""
+    rng = np.random.RandomState(0)
+    tree = {"w": rng.randn(8, 6, 5).astype("f4"),
+            "b": rng.randn(8, 5).astype("f4")}
+    want = {k: v.mean(0) for k, v in tree.items()}
+    out = jax.jit(collective.shard_map_compat(
+        lambda t: jax.tree_util.tree_map(
+            lambda x: x[None],
+            overlap.sync_tree(
+                jax.tree_util.tree_map(lambda x: x[0], t),
+                axis_name="dp", mode=mode, bucket_bytes=64)),
+        _ring(8), in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False))(tree)
+    tol = 0.2 if mode == "quantized" else 1e-6
+    for k in want:
+        got = np.asarray(out[k])[0]
+        assert got.shape == want[k].shape
+        np.testing.assert_allclose(got, want[k], atol=tol)
+    with pytest.raises(ValueError, match="mode"):
+        overlap.sync_tree(tree, mode="bogus")
+
+
+# -- tentpole: explicit-DDP scheduler -------------------------------------
+
+def _stacked_grads(rng, n=8):
+    return {"w": rng.randn(n, 7, 3).astype("f4"),
+            "b": rng.randn(n, 3).astype("f4")}
+
+
+def test_local_value_and_grad_stacked():
+    """Per-rank grads stack [n, *shape]; their mean equals the
+    full-batch gradient."""
+    rng = np.random.RandomState(0)
+    params = {"w": rng.randn(4, 1).astype("f4")}
+    x = rng.randn(16, 4).astype("f4")
+    y = rng.randn(16, 1).astype("f4")
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    lvg = overlap.local_value_and_grad(loss_fn, _ring(8))
+    loss, grads = lvg(params, (jnp.asarray(x), jnp.asarray(y)))
+    assert loss.shape == (8,)
+    assert grads["w"].shape == (8, 4, 1)
+    full = jax.grad(loss_fn)(params, (jnp.asarray(x), jnp.asarray(y)))
+    np.testing.assert_allclose(np.asarray(grads["w"]).mean(0),
+                               np.asarray(full["w"]), atol=1e-5)
+
+
+@pytest.mark.parametrize("mode", ["exact", "quantized", "overlap"])
+def test_scheduler_reduces_to_rank_mean(mode):
+    rng = np.random.RandomState(1)
+    grads = _stacked_grads(rng)
+    want = {k: v.mean(0) for k, v in grads.items()}
+    s = overlap.GradSyncScheduler(mode=mode, mesh=_ring(8),
+                                  bucket_bytes=64, async_apply=False)
+    try:
+        out = s.reduce(grads)
+        assert s.compiled_buckets >= 2  # bucket_bytes forces a split
+        tol = 0.2 if mode == "quantized" else 1e-6
+        for k in want:
+            np.testing.assert_allclose(np.asarray(out[k]), want[k],
+                                       atol=tol)
+        # second reduce with same signature mints no new executables
+        minted = s.compiled_buckets
+        s.reduce(grads)
+        assert s.compiled_buckets == minted
+    finally:
+        s.shutdown()
+
+
+def test_scheduler_lag1_semantics():
+    """async_apply: warm-up returns None, then each reduce returns the
+    PREVIOUS step's synced tree; flush drains the tail exactly once."""
+    rng = np.random.RandomState(2)
+    g0, g1 = _stacked_grads(rng), _stacked_grads(rng)
+    s = overlap.GradSyncScheduler(mode="overlap", mesh=_ring(8),
+                                  bucket_bytes=64)
+    try:
+        assert s.reduce(g0) is None
+        out1 = s.reduce(g1)
+        np.testing.assert_allclose(np.asarray(out1["w"]),
+                                   g0["w"].mean(0), atol=1e-6)
+        tail = s.flush()
+        np.testing.assert_allclose(np.asarray(tail["w"]),
+                                   g1["w"].mean(0), atol=1e-6)
+        assert s.flush() is None
+    finally:
+        s.shutdown()
+
+
+def test_scheduler_state_dict_bit_identity():
+    """Checkpoint mid-pipeline: state_dict MATERIALISES the pending
+    synced grads (never flushes them into an early apply); both the
+    continuing scheduler and a restored one serve the identical
+    numpy-round-tripped tree on their next reduce."""
+    rng = np.random.RandomState(3)
+    g0, g1, g2 = (_stacked_grads(rng) for _ in range(3))
+    mesh = _ring(8)
+    a = overlap.GradSyncScheduler(mode="overlap", mesh=mesh,
+                                  bucket_bytes=64)
+    b = overlap.GradSyncScheduler(mode="overlap", mesh=mesh,
+                                  bucket_bytes=64)
+    try:
+        a.reduce(g0)
+        a.reduce(g1)          # pending = synced(g1)
+        sd = a.state_dict()
+        assert "pending" in sd and all(
+            isinstance(x, np.ndarray) for x in sd["pending"])
+        b.set_state_dict(sd)
+        out_a = a.reduce(g2)  # continuing run serves restored g1-sync
+        out_b = b.reduce(g2)
+        for k in out_a:
+            np.testing.assert_array_equal(np.asarray(out_a[k]),
+                                          np.asarray(out_b[k]))
+        # and the value really is g1's synced mean
+        np.testing.assert_allclose(np.asarray(out_a["w"]),
+                                   g1["w"].mean(0), atol=1e-6)
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+def test_scheduler_eager_fallback_and_validation():
+    """No mesh: the stacking axis is the reduce axis (host mean); bad
+    mode/width rejected at construction."""
+    rng = np.random.RandomState(4)
+    grads = _stacked_grads(rng, n=4)
+    s = overlap.GradSyncScheduler(mode="exact", mesh=None,
+                                  async_apply=False)
+    out = s.reduce(grads)
+    np.testing.assert_allclose(np.asarray(out["w"]),
+                               grads["w"].mean(0), atol=1e-6)
+    s.shutdown()
+    with pytest.raises(ValueError, match="mode"):
+        overlap.GradSyncScheduler(mode="sorta")
+    with pytest.raises(ValueError, match=r"4, 8"):
+        overlap.GradSyncScheduler(bits=3)
+
+
+# -- wiring: Optimizer.step hook ------------------------------------------
+
+def test_optimizer_set_grad_sync_lag1():
+    """Optimizer.set_grad_sync threads the scheduler into _step_body:
+    the warm-up step applies nothing (lag-1), the next applies the
+    previous grads; "exact" detaches the hook."""
+    from paddle_tpu import nn, optimizer as opt
+
+    pt.seed(0)
+    lin = nn.Linear(3, 1)
+    sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+    sgd.set_grad_sync("overlap")
+    assert isinstance(sgd._grad_sync, overlap.GradSyncScheduler)
+
+    x = pt.Tensor(np.ones((2, 3), "f4"))
+    w0 = np.asarray(lin.weight.data).copy()
+    loss = lin(x).mean()
+    loss.backward()
+    sgd.step()          # warm-up: grads staged, params untouched
+    np.testing.assert_array_equal(np.asarray(lin.weight.data), w0)
+    sgd.clear_grad()
+    loss = lin(x).mean()
+    loss.backward()
+    sgd.step()          # applies the staged step-0 grads
+    assert not np.array_equal(np.asarray(lin.weight.data), w0)
+    sgd._grad_sync.shutdown()
+    sgd.set_grad_sync("exact")
+    assert sgd._grad_sync is None
+
+
+def test_scheduler_process_passthrough_sync():
+    """Non-async scheduler: process() is the identity on eager pairs
+    (GSPMD grads arrive already reduced — accounting only)."""
+    s = overlap.GradSyncScheduler(mode="exact", async_apply=False)
+    pairs = [(np.zeros(3), np.ones(3, "f4"))]
+    assert s.process(pairs) is pairs
+    s.shutdown()
