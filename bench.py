@@ -414,6 +414,37 @@ def bench_collective_overlap(timeout_s=600):
     }
 
 
+def bench_hotspot(label=None, top_k=5):
+    """Hotspot stage: parse the newest captured step executable's HLO
+    into the per-op cost ledger (monitor.profile) and bank the ranked
+    fusion menu next to the throughput it explains — which region, at
+    what attributed fraction, with how much memory-bound headroom. The
+    sentinel bands hotspot_count tight (the menu must not silently go
+    empty) and the fractions wide."""
+    from paddle_tpu import monitor
+    rep = monitor.profile.report(label=label, top_k=top_k,
+                                 emit_records=False)
+    if rep is None:
+        return None
+    recon = rep.get("flops_reconciliation")
+    top = rep["hotspots"][0] if rep["hotspots"] else None
+    return {
+        "hotspot_count": len(rep["hotspots"]),
+        "hotspot_attributed_frac": round(rep["attributed_frac"], 4),
+        "hotspot_top_headroom_s":
+            round(top["headroom_s"], 9) if top else None,
+        "hotspot_flops_reconciliation":
+            round(recon, 4) if recon else None,
+        "hotspot_top_regions": [
+            {"region": h["region"], "bound": h["bound"],
+             "flops": h["flops"],
+             "headroom_s": round(h["headroom_s"], 9)}
+            for h in rep["hotspots"][:3]],
+        "hotspot_device_kind": rep["ceilings"]["device_kind"],
+        "hotspot_assumed_roofline": rep["ceilings"]["assumed"],
+    }
+
+
 _RESULTS = {}  # metrics banked as each stage finishes (partial-credit)
 
 
@@ -656,6 +687,9 @@ def _enable_monitoring_and_cache():
     if enable_compilation_cache("/tmp/paddle_tpu_xla_cache") is None:
         print("compile cache unavailable", flush=True)
     monitor.enable()  # no sink path: in-memory counters only
+    # label every layer/optimizer scope in the step HLO so the hotspot
+    # stage can attribute the cost ledger to real model parts
+    monitor.profile.enable()
 
 
 _COMPILES_SEEN = {"n": 0}
@@ -704,6 +738,17 @@ def main():
                     bert_loss=round(bert_loss, 4),
                     bert_mfu=_mfu(bert_tps, _bert_flops_per_token()))
     _note_mfu_divergence("bert")
+    try:
+        hs = bench_hotspot()  # newest capture: the BERT train step
+    except Exception as e:
+        print(f"hotspot stage failed: {type(e).__name__}: {e}",
+              flush=True)
+    else:
+        if hs:
+            print(f"partial hotspot_count={hs['hotspot_count']} "
+                  f"attributed={hs['hotspot_attributed_frac']}",
+                  flush=True)
+            _RESULTS.update(hs)
     rn_ips, rn_loss = bench_resnet(measured_key="resnet50_mfu_measured")
     _record_stage_compiles("resnet50")
     print(f"partial resnet_images_per_sec={rn_ips:.1f}", flush=True)

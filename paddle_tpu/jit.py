@@ -284,6 +284,10 @@ class StaticFunction:
     def _make_entry(self, treedef, arr_idx, statics, state_names):
         fn = self._fn
         fn_scope = getattr(self, "__name__", None) or "to_static"
+        # a "root" scope is recognized by monitor.profile but never
+        # counts as attribution — everything lives under it (cold path:
+        # one dict write per compiled entry)
+        _monitor.profile.register_scope(fn_scope, "root")
         models, optimizers = self._models, self._optimizers
         scalers = self._scalers or []
         meta = {}

@@ -146,6 +146,7 @@ __all__ = [
     "record_collective", "StepMonitor", "mfu", "peak_flops_for_device",
     "transformer_train_flops_per_token", "device_memory_stats",
     "read_jsonl", "trace", "xla", "serve", "export", "sampler",
+    "profile",
 ]
 
 _registry = Registry()
@@ -207,6 +208,8 @@ def enable(path=None, time_dispatch=None):
 
     if os.environ.get("PADDLE_TPU_TRACE", "") not in ("", "0"):
         trace.enable()
+    if os.environ.get("PADDLE_TPU_PROFILE", "") not in ("", "0"):
+        profile.enable()
 
     from .. import dispatch
     dispatch.install_monitor_hook(_dispatch_hook, time_ops=_time_dispatch)
@@ -316,4 +319,4 @@ def record_collective(op, axis_name, nbytes):
 
 # imported last: the submodules reach back into this namespace
 # (gauge/emit/snapshot), which is fully populated by this point
-from . import trace, xla, export, sampler  # noqa: E402,F401
+from . import trace, xla, export, sampler, profile  # noqa: E402,F401

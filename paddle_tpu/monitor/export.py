@@ -140,15 +140,27 @@ def health_payload():
 
 
 def snapshot_payload():
-    """The /snapshot body: full registry snapshot + evidence pointers."""
+    """The /snapshot body: full registry snapshot + evidence pointers —
+    including the newest xla_cost capture and the last profiled hotspot
+    summary, so one scrape is enough to triage a slow step."""
     from .. import monitor as _mon
+    from . import profile as _profile
     from . import trace as _trace
+    from . import xla as _xla
+    newest = _xla.last()
+    xla_cost = None
+    if newest is not None:
+        label, info = newest
+        xla_cost = {"labels": _xla.labels(), "last_label": label,
+                    "last": dict(info or {})}
     return {
         "ts": time.time(),
         "pid": os.getpid(),
         "monitor_enabled": _mon.enabled(),
         "jsonl": _mon.jsonl_path(),
         "flight_dir": _trace.last_flight(),
+        "xla_cost": xla_cost,
+        "hotspots": _profile.last_summary(),
         "counters": _mon.snapshot(),
     }
 

@@ -31,6 +31,36 @@ _PEAK_FLOPS_BF16 = (
     ("TPU v2", 46e12),
 )
 
+# per-chip HBM bandwidth (GB/s) by the same substrings — the other half
+# of the roofline monitor.profile classifies against
+_PEAK_HBM_GBPS = (
+    ("TPU v6", 1640.0),
+    ("TPU v5p", 2765.0),
+    ("TPU v5 lite", 819.0),
+    ("TPU v5e", 819.0),
+    ("TPU v4", 1228.0),
+    ("TPU v3", 900.0),
+    ("TPU v2", 700.0),
+)
+
+_ceilings_cache = {}
+
+
+def ceilings_for_kind(kind):
+    """The single cached (peak_flops, hbm_bytes_per_sec) table lookup
+    for a device_kind string; either half is None when the kind is
+    unknown. Env overrides live in the callers (peak_flops_for_device,
+    profile.roofline_ceilings) so the cache never captures them."""
+    kind = str(kind)
+    hit = _ceilings_cache.get(kind)
+    if hit is None:
+        flops = next((p for tag, p in _PEAK_FLOPS_BF16 if tag in kind),
+                     None)
+        bw = next((b * 1e9 for tag, b in _PEAK_HBM_GBPS if tag in kind),
+                  None)
+        hit = _ceilings_cache[kind] = (flops, bw)
+    return hit
+
 # BERT-base has ~110M params; training flops/token ~= 6N (fwd 2N + bwd 4N)
 BERT_BASE_PARAMS = 110e6
 # ResNet-50 fwd @224 is ~4.1 GMACs = 8.2 GFLOPs; training ~= 3x fwd
@@ -55,10 +85,23 @@ def peak_flops_for_device(device=None):
         except Exception:
             return None
     kind = str(getattr(device, "device_kind", ""))
-    for tag, peak in _PEAK_FLOPS_BF16:
-        if tag in kind:
-            return peak
-    return None
+    return ceilings_for_kind(kind)[0]
+
+
+def peak_hbm_bandwidth_for_device(device=None):
+    """Per-chip HBM bandwidth ceiling in bytes/s, or None when unknown.
+    PADDLE_TPU_HBM_GBPS (GB/s) overrides the table."""
+    env = os.environ.get("PADDLE_TPU_HBM_GBPS")
+    if env:
+        return float(env) * 1e9
+    if device is None:
+        import jax
+        try:
+            device = jax.local_devices()[0]
+        except Exception:
+            return None
+    kind = str(getattr(device, "device_kind", ""))
+    return ceilings_for_kind(kind)[1]
 
 
 def mfu(flops_per_step, step_time_s, peak_flops=None):

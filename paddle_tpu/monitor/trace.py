@@ -380,6 +380,21 @@ def flight_record(reason, step=None, directory=None, extra=None):
         except Exception:
             pass
 
+        # the per-op cost ledger next to its HLO: the cached report if
+        # one exists, else a fresh parse of the captured executable —
+        # still inside the outer try, never a second crash
+        try:
+            from . import profile as _profile
+            ledger = _profile.last_report()
+            if ledger is None:
+                ledger = _profile.report(emit_records=False)
+            if ledger:
+                with open(os.path.join(d, "op_ledger.json"), "w",
+                          encoding="utf-8") as fh:
+                    json.dump(ledger, fh, default=str, indent=1)
+        except Exception:
+            pass
+
         _memit(kind="flight_record", reason=str(reason), step=step,
                path=d)
         global _last_flight

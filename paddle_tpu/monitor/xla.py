@@ -30,7 +30,7 @@ import threading
 __all__ = [
     "analyze", "capture", "aot_capture", "get", "flops",
     "bytes_accessed", "peak_memory", "labels", "last", "hlo_text",
-    "measured_mfu", "reset",
+    "executable", "measured_mfu", "reset",
 ]
 
 MAX_ENTRIES = 64
@@ -172,15 +172,23 @@ def last():
         return label, _entries.get(label)
 
 
-def hlo_text(label=None, max_bytes=2_000_000):
-    """HLO of a captured executable (default: newest), truncated to
-    ``max_bytes``; None when unavailable."""
+def executable(label=None):
+    """The captured Compiled object for ``label`` (default: newest), or
+    None — monitor.profile pulls untruncated HLO through this."""
     with _lock:
         if label is None:
             if not _order:
                 return None
             label = _order[-1]
-        exe = _execs.get(str(label))
+        return _execs.get(str(label))
+
+
+def hlo_text(label=None, max_bytes=2_000_000):
+    """HLO of a captured executable (default: newest), truncated to
+    ``max_bytes``; None when unavailable. Truncation lands on a line
+    boundary with an explicit ``... [truncated N bytes]`` tail so a
+    flight-recorder dump stays parseable."""
+    exe = executable(label)
     if exe is None:
         return None
     try:
@@ -188,7 +196,11 @@ def hlo_text(label=None, max_bytes=2_000_000):
     except Exception:
         return None
     if txt and max_bytes and len(txt) > max_bytes:
-        txt = txt[:max_bytes] + "\n... [truncated]\n"
+        cut = txt.rfind("\n", 0, max_bytes)
+        if cut <= 0:
+            cut = max_bytes
+        dropped = len(txt) - cut
+        txt = txt[:cut] + f"\n... [truncated {dropped} bytes]\n"
     return txt or None
 
 

@@ -21,6 +21,7 @@ import jax
 
 from ..tensor import Tensor, Parameter, convert_dtype, get_default_dtype
 from .. import initializer as I
+from ..monitor import profile as _profile
 
 
 # Global structure version: bumped whenever any Layer's parameter /
@@ -279,6 +280,14 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *args, **kwargs):
+        # cost discipline: profiling off (the default) costs exactly one
+        # module-flag check here — no scope name, no context manager
+        if _profile.scopes_on:
+            with jax.named_scope(_profile.layer_scope(self)):
+                return self._run_forward(args, kwargs)
+        return self._run_forward(args, kwargs)
+
+    def _run_forward(self, args, kwargs):
         for hook in self._forward_pre_hooks.values():
             res = hook(self, args)
             if res is not None:

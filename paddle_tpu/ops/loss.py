@@ -17,10 +17,21 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from contextlib import nullcontext
+
 from ..tensor import as_tensor
 from ..dispatch import apply
+from ..monitor import profile as _profile
 from . import math as _math
 from . import nn_ops as _nn
+
+
+def _pscope(name):
+    """named_scope(F.<name>) when profiling is armed, else a no-op —
+    one flag check, so the disabled path stays free."""
+    if _profile.scopes_on:
+        return jax.named_scope(_profile.fscope(name))
+    return nullcontext()
 
 
 def _reduce(out, reduction):
@@ -88,11 +99,12 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
         if return_softmax:
             return loss, jnp.exp(logp)
         return loss
-    out = apply(impl, (logits, label),
-                dict(soft_label=soft_label, ignore_index=ignore_index,
-                     axis=axis, return_softmax=return_softmax),
-                n_out=2 if return_softmax else 1,
-                name="softmax_with_cross_entropy")
+    with _pscope("F.softmax_with_cross_entropy"):
+        out = apply(impl, (logits, label),
+                    dict(soft_label=soft_label, ignore_index=ignore_index,
+                         axis=axis, return_softmax=return_softmax),
+                    n_out=2 if return_softmax else 1,
+                    name="softmax_with_cross_entropy")
     return out
 
 
@@ -141,10 +153,11 @@ def cross_entropy(input, label, soft_label=False, ignore_index=-100,
         return jnp.sum(loss) / jnp.maximum(jnp.sum(denom_w), 1e-12)
 
     args = (input, label) if weight is None else (input, label, weight)
-    return apply(impl, args,
-                 dict(soft_label=soft_label, ignore_index=ignore_index,
-                      axis=axis, use_softmax=use_softmax,
-                      reduction=reduction), name="cross_entropy")
+    with _pscope("F.cross_entropy"):
+        return apply(impl, args,
+                     dict(soft_label=soft_label, ignore_index=ignore_index,
+                          axis=axis, use_softmax=use_softmax,
+                          reduction=reduction), name="cross_entropy")
 
 
 def sigmoid_cross_entropy_with_logits(x, label, ignore_index=-100,

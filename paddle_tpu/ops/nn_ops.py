@@ -21,9 +21,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from contextlib import nullcontext
+
 from ..tensor import Tensor, as_tensor, convert_dtype
 from ..dispatch import apply
+from ..monitor import profile as _profile
 from .. import random as prandom
+
+
+def _pscope(name):
+    """named_scope(F.<name>) when profiling is armed, else a no-op —
+    one flag check, so the disabled path stays free."""
+    if _profile.scopes_on:
+        return jax.named_scope(_profile.fscope(name))
+    return nullcontext()
 
 
 # ---------------------------------------------------------------------------
@@ -146,13 +157,15 @@ def maxout(x, groups, axis=1, name=None):
 
 def softmax(x, axis=-1, name=None):
     """reference: softmax_op.cc — one fused XLA softmax."""
-    return apply(lambda x, axis: jax.nn.softmax(x, axis=axis), (x,),
-                 dict(axis=axis), name="softmax")
+    with _pscope("F.softmax"):
+        return apply(lambda x, axis: jax.nn.softmax(x, axis=axis), (x,),
+                     dict(axis=axis), name="softmax")
 
 
 def log_softmax(x, axis=-1, name=None):
-    return apply(lambda x, axis: jax.nn.log_softmax(x, axis=axis), (x,),
-                 dict(axis=axis), name="log_softmax")
+    with _pscope("F.log_softmax"):
+        return apply(lambda x, axis: jax.nn.log_softmax(x, axis=axis), (x,),
+                     dict(axis=axis), name="log_softmax")
 
 
 # ---------------------------------------------------------------------------
@@ -531,9 +544,11 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     args = (x, running_mean, running_var)
     if weight is not None:
         args = args + (weight, bias)
-    out = apply(impl, args, dict(training=training, momentum=momentum,
-                                 epsilon=epsilon, data_format=data_format),
-                n_out=3, name="batch_norm")
+    with _pscope("F.batch_norm"):
+        out = apply(impl, args,
+                    dict(training=training, momentum=momentum,
+                         epsilon=epsilon, data_format=data_format),
+                    n_out=3, name="batch_norm")
     return out
 
 
@@ -557,8 +572,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         return out
 
     args = (x,) if weight is None else (x, weight, bias)
-    return apply(impl, args, dict(naxes=naxes, epsilon=epsilon),
-                 name="layer_norm")
+    with _pscope("F.layer_norm"):
+        return apply(impl, args, dict(naxes=naxes, epsilon=epsilon),
+                     name="layer_norm")
 
 
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,

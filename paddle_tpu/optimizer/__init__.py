@@ -198,7 +198,16 @@ class Optimizer:
     def _apply_update(self, params_grads, lr):
         """The raw update: batched multi-tensor path or the per-param
         _rule loop (split from step() so the resilience guard can
-        bracket it with its snapshot/select machinery)."""
+        bracket it with its snapshot/select machinery). Under an armed
+        profiler the whole body runs inside a stable ``opt.<Cls>``
+        named_scope, so monitor.profile can attribute the update math —
+        one flag check when profiling is off."""
+        if _monitor.profile.scopes_on:
+            with jax.named_scope(_monitor.profile.optimizer_scope(self)):
+                return self._apply_update_body(params_grads, lr)
+        return self._apply_update_body(params_grads, lr)
+
+    def _apply_update_body(self, params_grads, lr):
         if self._batched_update(params_grads, lr):
             self._post_step()
             return

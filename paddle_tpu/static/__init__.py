@@ -847,6 +847,11 @@ class Executor:
         const_vals = {n: t.data for n, t in program.const_vars.items()}
         opt_entries = program.optimizers
         rng_names = list(program.rng_vars)
+        # register the graph-op types as attributable "op" scopes so a
+        # profile.report() over a static Program's executable credits
+        # flops to op types (compile-time cost, one dict write per type)
+        for op in ops:
+            _monitor.profile.register_scope(op.type or "op", "op")
 
         def interpret(env):
             for op in ops:

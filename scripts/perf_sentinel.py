@@ -87,6 +87,18 @@ METRICS = [
     ("comm_wire_reduction_int4_x",
      ("comm_wire_reduction_int4_x",), ("comm_wire_reduction_int4_x",),
      "higher", 0.10),
+    # hotspot stage (bench_hotspot): the ranked fusion menu and the
+    # attributed fraction are deterministic functions of the step HLO
+    # (tight bands — shrinkage means scope labels or the parser broke);
+    # the top region's headroom is a modeled time (very wide band)
+    ("hotspot_count", ("hotspot_count",), ("hotspot_count",),
+     "higher", 0.10),
+    ("hotspot_attributed_frac",
+     ("hotspot_attributed_frac",), ("hotspot_attributed_frac",),
+     "higher", 0.10),
+    ("hotspot_top_headroom_s",
+     ("hotspot_top_headroom_s",), ("hotspot_top_headroom_s",),
+     "lower", 1.00),
 ]
 
 

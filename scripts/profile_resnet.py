@@ -114,6 +114,9 @@ def main():
     jax.config.update("jax_compilation_cache_dir",
                       "/tmp/paddle_tpu_xla_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from paddle_tpu import monitor
+    monitor.enable()          # in-memory counters + xla capture
+    monitor.profile.enable()  # named scopes -> attributable step HLO
     for layout in ("NHWC", "NCHW"):
         ms, tf, fixed = conv_ceiling(128, layout)
         print(f"conv3x3 b128 {layout}: marginal {ms:.3f} ms "
@@ -127,6 +130,13 @@ def main():
               flush=True)
     from paddle_tpu.utils.profiler import summarize_trace
     summarize_trace(trace_dir, steps=8)  # the traced call runs inner=8
+    # the attributed cost ledger of the newest captured train step:
+    # which region tops the fusion menu, at what attributed fraction —
+    # the trace view above says WHAT is slow, this says WHOSE it is
+    rep = monitor.profile.report(top_k=12, emit_records=False)
+    if rep is not None:
+        print(flush=True)
+        print(monitor.profile.format_table(rep, top_k=12), flush=True)
 
 
 if __name__ == "__main__":

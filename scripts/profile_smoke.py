@@ -1,0 +1,123 @@
+"""Per-op cost-attribution smoke gate (tier-1-safe: tiny MLP, CPU,
+seconds).
+
+Runs a 2-layer MLP + Adam train step under ``jit.to_static`` with
+profiling scopes armed, builds the per-op cost ledger from the captured
+step executable, and asserts the acceptance criteria directly:
+
+* >= 90% of the step's flops attribute to named framework scopes
+  (layers / functional ops / the optimizer update — never the root)
+* the parser's flop total reconciles with XLA's own ``cost_analysis()``
+  within 1%
+* the ranked hotspot list is non-empty, rank-ordered 1..k, and sorted
+  by fusion headroom (descending)
+* one ``hotspot`` JSONL record per ranked region landed in the sink
+* disabled mode stays free: with scopes off, a layer call must not
+  touch the scope registry
+
+Writes the monitor JSONL to --out-dir and prints one JSON result line.
+Exit code 0 iff every gate passes.
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="/tmp/paddle_tpu_profile_smoke")
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--batch", type=int, default=16)
+    args = ap.parse_args()
+
+    import paddle_tpu as pt
+    from paddle_tpu import jit, monitor, nn, optimizer as opt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.monitor.registry import read_jsonl
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    jsonl = monitor.enable(os.path.join(args.out_dir,
+                                        "profile_smoke.jsonl"))
+    monitor.profile.enable()
+
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(16, args.hidden), nn.ReLU(),
+                          nn.Linear(args.hidden, 10))
+    adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    @jit.to_static(models=[model], optimizers=[adam])
+    def step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        adam.step()
+        return loss
+
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(args.batch, 16).astype("f4"))
+    y = pt.to_tensor(rng.randint(0, 10, (args.batch,)).astype("i8"))
+    for _ in range(2):
+        loss = step(x, y)
+    loss.numpy()
+
+    rep = monitor.profile.report(top_k=8)
+    if rep is None:
+        print(json.dumps({"metric": "profile_smoke", "pass": False,
+                          "error": "no captured executable"}))
+        return 1
+
+    heads = [h["headroom_s"] for h in rep["hotspots"]]
+    ranks = [h["rank"] for h in rep["hotspots"]]
+    recs = [r for r in read_jsonl(jsonl) if r.get("kind") == "hotspot"]
+    recon = rep["flops_reconciliation"]
+
+    # disabled mode: one flag check, no registry traffic
+    monitor.profile.disable()
+    scopes_before = len(monitor.profile.scopes())
+    nn.Linear(4, 4)(pt.to_tensor(np.zeros((2, 4), dtype="f4")))
+    scopes_added = len(monitor.profile.scopes()) - scopes_before
+
+    result = {
+        "metric": "profile_smoke",
+        "label": rep["label"],
+        "total_flops": rep["total_flops"],
+        "attributed_frac": round(rep["attributed_frac"], 4),
+        "flops_reconciliation": (round(recon, 4)
+                                 if recon is not None else None),
+        "hotspot_count": len(rep["hotspots"]),
+        "top_region": (rep["hotspots"][0]["region"]
+                       if rep["hotspots"] else None),
+        "device_kind": rep["ceilings"]["device_kind"],
+        "assumed_roofline": rep["ceilings"]["assumed"],
+        "hotspot_jsonl_records": len(recs),
+        "disabled_scopes_added": scopes_added,
+        "jsonl": jsonl,
+    }
+    gates = {
+        "attributed_frac>=0.9": rep["attributed_frac"] >= 0.9,
+        "flops_reconcile_1pct": (recon is not None
+                                 and abs(recon - 1.0) <= 0.01),
+        "hotspots_nonempty": len(rep["hotspots"]) >= 1,
+        "hotspots_rank_ordered": (
+            ranks == list(range(1, len(ranks) + 1))
+            and heads == sorted(heads, reverse=True)),
+        "hotspot_jsonl_records==count":
+            len(recs) == len(rep["hotspots"]),
+        "disabled_adds_no_scopes": scopes_added == 0,
+    }
+    result["gates"] = gates
+    result["pass"] = all(gates.values())
+    print(monitor.profile.format_table(rep), file=sys.stderr)
+    monitor.disable()
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

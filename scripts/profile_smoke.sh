@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# CI gate for per-op cost attribution: a 2-layer MLP + Adam to_static
+# step must attribute >= 90% of its XLA-counted flops to named
+# framework scopes, reconcile the parsed flop total with
+# cost_analysis() within 1%, rank a non-empty hotspot menu by fusion
+# headroom, and land one `hotspot` JSONL record per ranked region.
+# Tier-1-safe: tiny MLP, CPU, seconds.
+#
+# Usage: scripts/profile_smoke.sh [out_dir]
+# The monitor JSONL lands in out_dir (default
+# /tmp/paddle_tpu_profile_smoke); the last stdout line is one JSON
+# result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_profile_smoke}"
+JAX_PLATFORMS=cpu python scripts/profile_smoke.py --out-dir "$OUT_DIR"

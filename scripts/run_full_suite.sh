@@ -68,6 +68,15 @@ bash scripts/comm_smoke.sh "$MONITOR_DIR/comm_smoke"
 cms=$?
 [ $cms -ne 0 ] && rc=$((rc == 0 ? cms : rc))
 
+# profile gate: the 2-layer to_static step must attribute >=90% of its
+# flops to named scopes, reconcile with cost_analysis() within 1%, and
+# rank a non-empty hotspot menu with one JSONL record per region
+echo ""
+echo "-- profile smoke gate --"
+bash scripts/profile_smoke.sh "$MONITOR_DIR/profile_smoke"
+prf=$?
+[ $prf -ne 0 ] && rc=$((rc == 0 ? prf : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

@@ -1,0 +1,345 @@
+"""paddle_tpu.monitor.profile — HLO parse → per-op attribution, roofline
+classification, fusion-menu ranking, ceilings, and the disabled-mode
+zero-cost contract."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit, monitor, nn, optimizer as opt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.monitor import profile
+from paddle_tpu.monitor.registry import read_jsonl
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """profile + monitor are process-global; every test starts dark."""
+    for var in ("PADDLE_TPU_FLOPS_CEILING", "PADDLE_TPU_HBM_GBPS",
+                "PADDLE_TPU_ROOFLINE_DEVICE", "PADDLE_TPU_PROFILE"):
+        monkeypatch.delenv(var, raising=False)
+    monitor.disable(flush_counters=False)
+    monitor.reset()
+    profile.disable()
+    profile.reset()
+    yield
+    monitor.disable(flush_counters=False)
+    monitor.reset()
+    profile.disable()
+    profile.reset()
+
+
+# -- synthetic HLO for the parser units --------------------------------------
+
+DOT_HLO = """\
+HloModule test, is_scheduled=true
+
+ENTRY %main.1 (a: f32[4,8], b: f32[8,16]) -> f32[4,16] {
+  %a = f32[4,8]{1,0} parameter(0)
+  %b = f32[8,16]{1,0} parameter(1)
+  ROOT %dot.1 = f32[4,16]{1,0} dot(f32[4,8]{1,0} %a, f32[8,16]{1,0} %b), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(f)/jit(main)/root/L0/dot_general"}
+}
+"""
+
+FUSED_HLO = """\
+HloModule test2, is_scheduled=true
+
+%fused_computation (p0: f32[4,8]) -> f32[4,8] {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %exp.1 = f32[4,8]{1,0} exponential(f32[4,8]{1,0} %p0), metadata={op_name="jit(f)/jit(main)/root/F.softmax/exp"}
+  ROOT %add.1 = f32[4,8]{1,0} add(f32[4,8]{1,0} %exp.1, f32[4,8]{1,0} %p0), metadata={op_name="jit(f)/jit(main)/root/transpose(jvp(F.softmax))/add"}
+}
+
+%region.1 (x: f32[], y: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  %y = f32[] parameter(1)
+  ROOT %add.2 = f32[] add(f32[] %x, f32[] %y)
+}
+
+ENTRY %main.2 (a: f32[4,8]) -> f32[4] {
+  %a = f32[4,8]{1,0} parameter(0)
+  %fus = f32[4,8]{1,0} fusion(f32[4,8]{1,0} %a), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(f)/jit(main)/root/F.softmax/add"}
+  %c0 = f32[] constant(0)
+  ROOT %reduce.1 = f32[4]{0} reduce(f32[4,8]{1,0} %fus, f32[] %c0), dimensions={1}, to_apply=%region.1, metadata={op_name="jit(f)/jit(main)/root/F.softmax/reduce_sum"}
+}
+"""
+
+
+def test_parse_dot_flops_and_bytes():
+    profile.register_scope("root", "root")
+    profile.register_scope("L0", "layer")
+    a = profile.attribute(DOT_HLO)
+    assert a["total_flops"] == 2 * (4 * 16) * 8       # 2·out·K
+    assert a["attributed_frac"] == 1.0
+    (row,) = a["ops"]
+    assert row["opcode"] == "dot"
+    assert row["region"] == "L0"
+    # operands (128 + 512) + output 256 bytes, f32
+    assert row["bytes"] == 4 * (4 * 8 + 8 * 16 + 4 * 16)
+
+
+def test_parse_fusion_reduce_transcendentals():
+    profile.register_scope("root", "root")
+    profile.register_scope("F.softmax", "functional")
+    a = profile.attribute(FUSED_HLO)
+    rows = {r["name"]: r for r in a["ops"]}
+    # fusion = inner add (32 flops) + inner exp (32 transcendentals);
+    # the transpose(jvp(...)) wrapper still resolves to F.softmax
+    assert rows["fus"]["flops"] == 32
+    assert rows["fus"]["transcendentals"] == 32
+    assert rows["fus"]["region"] == "F.softmax"
+    # reduce = in − out, its to_apply region body is folded, not counted
+    assert rows["reduce.1"]["flops"] == 32 - 4
+    assert a["total_flops"] == 32 + 28
+    assert a["transcendentals"] == 32
+    assert a["attributed_frac"] == 1.0
+
+
+def test_unregistered_scopes_bucket_as_unattributed():
+    # nothing registered: the root/L0 tokens mean nothing -> 0% attributed
+    a = profile.attribute(DOT_HLO)
+    assert a["attributed_frac"] == 0.0
+    assert a["ops"][0]["region"] == profile.UNATTRIBUTED
+
+
+def test_root_scope_never_attributes():
+    # only the root is registered — everything under it must still
+    # bucket as unattributed (the ≥90% bar must not be trivially true)
+    profile.register_scope("root", "root")
+    a = profile.attribute(DOT_HLO)
+    assert a["attributed_frac"] == 0.0
+
+
+# -- roofline ceilings --------------------------------------------------------
+
+def test_roofline_ceilings_known_kind():
+    c = profile.roofline_ceilings("TPU v5p")
+    assert c["peak_flops"] == 459e12
+    assert c["hbm_bytes_per_sec"] == 2765e9
+    assert not c["assumed"]
+    assert c["ridge_flops_per_byte"] == pytest.approx(459e12 / 2765e9)
+
+
+def test_roofline_ceilings_unknown_kind_assumes_v5e():
+    c = profile.roofline_ceilings("M2 Ultra")
+    assert c["assumed"]
+    assert "assumed" in c["device_kind"]
+    assert c["peak_flops"] == 197e12
+    assert c["hbm_bytes_per_sec"] == 819e9
+
+
+def test_roofline_ceilings_env_overrides(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLOPS_CEILING", "2e12")
+    monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", "100")
+    c = profile.roofline_ceilings("whatever")
+    assert c["peak_flops"] == 2e12
+    assert c["hbm_bytes_per_sec"] == 100e9
+    assert not c["assumed"]          # both ceilings pinned by the user
+
+
+def test_roofline_device_env(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_ROOFLINE_DEVICE", "TPU v4")
+    c = profile.roofline_ceilings()
+    assert c["peak_flops"] == 275e12
+    assert c["hbm_bytes_per_sec"] == 1228e9
+    assert not c["assumed"]
+
+
+def test_step_bandwidth_lookup_and_env(monkeypatch):
+    from paddle_tpu.monitor import step as mstep
+    assert mstep.ceilings_for_kind("TPU v5 lite")[1] == 819e9
+    assert mstep.ceilings_for_kind("TPU v6e")[0] == 918e12
+    assert mstep.ceilings_for_kind("cpu") == (None, None)
+    monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", "123")
+    assert mstep.peak_hbm_bandwidth_for_device() == 123e9
+
+
+# -- roofline classification boundaries ---------------------------------------
+
+def test_classification_boundaries():
+    ceil = {"peak_flops": 1.0, "hbm_bytes_per_sec": 1.0,
+            "ridge_flops_per_byte": 1.0, "device_kind": "unit",
+            "assumed": False}
+    mk = lambda f, b: {"flops": float(f), "bytes": float(b),
+                       "transcendentals": 0.0}
+    above, below, at = profile._rooflined(
+        [mk(100, 10), mk(10, 100), mk(50, 50)], ceil)
+    assert above["bound"] == "compute" and above["headroom_s"] == 0.0
+    assert below["bound"] == "memory"
+    assert below["headroom_s"] == pytest.approx(100.0 - 10.0)
+    assert below["mfu"] == pytest.approx(0.1)
+    assert at["bound"] == "compute"   # exactly on the ridge: compute
+
+
+def test_report_classifies_with_env_roofline(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLOPS_CEILING", "1e9")
+    monkeypatch.setenv("PADDLE_TPU_HBM_GBPS", "1")     # ridge = 1 F/B
+    profile.register_scope("root", "root")
+    profile.register_scope("L0", "layer")
+    rep = profile.report(hlo=DOT_HLO)
+    (row,) = rep["ops"]
+    # dot: 1024 flops / 896 bytes -> AI > ridge -> compute-bound
+    assert row["bound"] == "compute"
+    assert row["arith_intensity"] == pytest.approx(1024 / 896)
+    assert rep["hotspots"][0]["region"] == "L0"
+
+
+# -- hlo_text truncation (satellite fix) --------------------------------------
+
+def test_hlo_text_truncates_at_line_boundary(tmp_path):
+    import jax
+    import jax.numpy as jnp
+    monitor.enable(str(tmp_path))
+    fn = jax.jit(lambda x: jnp.tanh(x) @ x)
+    monitor.xla.aot_capture(fn, "trunc", (np.eye(8, dtype="float32"),))
+    full = monitor.xla.hlo_text("trunc", max_bytes=0) or \
+        monitor.xla.executable("trunc").as_text()
+    # big enough that whole lines fit under the limit (the first
+    # HloModule header line alone is a few hundred bytes)
+    cut = monitor.xla.hlo_text("trunc", max_bytes=len(full) // 2)
+    assert cut is not None and cut != full
+    body, tail = cut.rstrip("\n").rsplit("\n", 1)
+    assert tail.startswith("... [truncated ") and tail.endswith(" bytes]")
+    # every byte up to the marker is a prefix of whole lines
+    assert full.startswith(body)
+    assert full[len(body)] == "\n"
+    dropped = int(tail.split("[truncated ")[1].split(" ")[0])
+    assert dropped == len(full) - len(body)
+
+
+# -- end-to-end: jitted MLP + Adam on CPU -------------------------------------
+
+def _mlp_step(tmp_path, hidden=32):
+    monitor.enable(str(tmp_path))
+    profile.enable()
+    model = nn.Sequential(nn.Linear(16, hidden), nn.ReLU(),
+                          nn.Linear(hidden, 10))
+    adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    @jit.to_static(models=[model], optimizers=[adam])
+    def step(x, y):
+        logits = model(x)
+        loss = F.cross_entropy(logits, y)
+        loss.backward()
+        adam.step()
+        return loss
+
+    x = pt.to_tensor(np.random.RandomState(0).randn(8, 16)
+                     .astype("float32"))
+    y = pt.to_tensor(np.arange(8).astype("int64") % 10)
+    step(x, y)
+    return step
+
+
+def test_mlp_adam_attribution_and_reconciliation(tmp_path):
+    _mlp_step(tmp_path)
+    rep = profile.report(top_k=8)
+    assert rep is not None and rep["label"] == "jit.step"
+    assert rep["label"] in monitor.xla.labels()
+    # every flop lands in a named scope or the <unattributed> bucket,
+    # and the parser's total agrees with XLA's own count within 1%
+    assert rep["attributed_frac"] >= 0.90
+    assert rep["flops_reconciliation"] == pytest.approx(1.0, abs=0.01)
+    total = sum(o["flops"] for o in rep["ops"])
+    assert total == pytest.approx(rep["total_flops"])
+    regions = {r["region"] for r in rep["regions"]}
+    # the SURVEY §2 fusion candidates surface from measurement
+    assert "opt.Adam" in regions
+    assert "F.cross_entropy" in regions
+    assert any("Linear_0" in r for r in regions)
+    for o in rep["ops"]:
+        assert o["bound"] in ("compute", "memory")
+        assert o["est_time_s"] >= 0
+    # hotspot JSONL records landed in the sink
+    recs = [r for r in read_jsonl(monitor.jsonl_path())
+            if r.get("kind") == "hotspot"]
+    assert recs and recs[0]["rank"] == 1
+    assert {r["region"] for r in recs} <= regions
+    # /snapshot surfaces the evidence pointers
+    snap = monitor.export.snapshot_payload()
+    assert snap["xla_cost"]["last_label"] == "jit.step"
+    assert "jit.step" in snap["xla_cost"]["labels"]
+    assert snap["hotspots"]["attributed_frac"] >= 0.90
+    assert snap["hotspots"]["hotspots"][0]["rank"] == 1
+
+
+def test_ranking_stable_across_reports(tmp_path):
+    _mlp_step(tmp_path)
+    r1 = profile.report(top_k=10)
+    r2 = profile.report(top_k=10)
+    order1 = [(h["rank"], h["region"]) for h in r1["hotspots"]]
+    order2 = [(h["rank"], h["region"]) for h in r2["hotspots"]]
+    assert order1 == order2
+    assert [h["rank"] for h in r1["hotspots"]] == \
+        list(range(1, len(order1) + 1))
+    # headroom is monotonically non-increasing down the menu
+    heads = [h["headroom_s"] for h in r1["hotspots"]]
+    assert heads == sorted(heads, reverse=True)
+
+
+def test_layer_scope_names_stable_per_instance(tmp_path):
+    profile.enable()
+    l0, l1 = nn.Linear(4, 4), nn.Linear(4, 4)
+    x = pt.to_tensor(np.zeros((2, 4), dtype="float32"))
+    l0(x), l1(x), l0(x)
+    assert l0._profile_scope == "Linear_0"
+    assert l1._profile_scope == "Linear_1"
+    scopes = profile.scopes()
+    assert scopes["Linear_0"] == "layer" and scopes["Linear_1"] == "layer"
+    # a reset keeps instance names on re-entry instead of renumbering
+    profile.reset()
+    l0(x)
+    assert l0._profile_scope == "Linear_0"
+    assert profile.scopes()["Linear_0"] == "layer"
+
+
+def test_format_table_renders(tmp_path):
+    _mlp_step(tmp_path)
+    rep = profile.report()
+    table = profile.format_table(rep)
+    assert "opt.Adam" in table and "region" in table
+    assert "attributed" in table
+    assert profile.format_table(None).startswith("profile: no captured")
+
+
+def test_flight_record_bundles_op_ledger(tmp_path):
+    _mlp_step(tmp_path)
+    profile.report()
+    d = monitor.trace.flight_record("test", directory=str(tmp_path / "fl"))
+    assert d is not None
+    ledger = json.load(open(f"{d}/op_ledger.json"))
+    assert ledger["label"] == "jit.step"
+    assert float(ledger["attributed_frac"]) >= 0.90
+
+
+# -- disabled mode: one flag check, nothing else ------------------------------
+
+def test_disabled_mode_no_scope_no_parse(monkeypatch):
+    assert profile.scopes_on is False
+    bomb = lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("profiling touched while disabled"))
+    monkeypatch.setattr(profile, "layer_scope", bomb)
+    monkeypatch.setattr(profile, "fscope", bomb)
+    monkeypatch.setattr(profile, "optimizer_scope", bomb)
+    monkeypatch.setattr(profile, "parse_hlo", bomb)
+    model = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+    adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    @jit.to_static(models=[model], optimizers=[adam])
+    def step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        adam.step()
+        return loss
+
+    x = pt.to_tensor(np.ones((2, 4), dtype="float32"))
+    y = pt.to_tensor(np.zeros((2,), dtype="int64"))
+    step(x, y)       # labels, forward, backward, update: no bomb trips
+    assert profile.last_report() is None
+
+
+def test_enable_env_var(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PROFILE", "1")
+    monitor.enable(str(tmp_path))
+    assert profile.scopes_on is True
