@@ -414,6 +414,42 @@ def bench_collective_overlap(timeout_s=600):
     }
 
 
+def bench_fused_optimizer(timeout_s=600):
+    """Fused-optimizer stage: runs scripts/arena_smoke.py in a
+    subprocess (CPU-pinned — the arena layout and the opt.* byte ledger
+    are backend-independent) and banks its measurements: optimizer-scope
+    bytes_accessed per 5-step run under the multi-tensor per-leaf
+    baseline vs the flat arena, the reduction fraction, the surviving
+    concat/gather/scatter count, and the post-compile step wall time.
+    The sentinel bands the byte metrics tight (deterministic functions
+    of the model layout + packing) and the wall time very wide."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "arena_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_arena"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"arena_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    return {
+        "fused_optimizer_opt_bytes_base": r["opt_bytes_base"],
+        "fused_optimizer_opt_bytes_flat": r["opt_bytes_flat"],
+        "fused_optimizer_bytes_reduction": r["opt_bytes_reduction"],
+        "fused_optimizer_banned_ops_flat":
+            r["opt_concat_gather_scatter_flat"],
+        "fused_optimizer_step_time_s": r["step_time_flat_s"],
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -808,6 +844,15 @@ def main():
             print(f"partial collective_overlap_ratio="
                   f"{comm['collective_overlap_ratio']}", flush=True)
             _RESULTS.update(comm)
+        try:
+            fo = bench_fused_optimizer()
+        except Exception as e:
+            print(f"fused_optimizer bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial fused_optimizer_bytes_reduction="
+                  f"{fo['fused_optimizer_bytes_reduction']}", flush=True)
+            _RESULTS.update(fo)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

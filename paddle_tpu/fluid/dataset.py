@@ -213,6 +213,7 @@ class InMemoryDataset(DatasetBase):
         self._memory = None
         self._columnar = None  # {'counts','offsets','vals','ivals'}
         self._perm = None
+        self._preload = None   # (pool, futures, native_ok) in flight
         self.queue_num = None
         self.fleet_send_batch_size = None
 
@@ -222,19 +223,9 @@ class InMemoryDataset(DatasetBase):
     def set_fleet_send_batch_size(self, n=1024):
         self.fleet_send_batch_size = int(n)
 
-    def load_into_memory(self):
-        """Native path keeps the parse COLUMNAR (counts/offsets/value
-        lanes straight from csrc ptc_multislot_parse) so batches
-        assemble by vectorized fancy-indexing and shuffling permutes an
-        index array — the reference's resident-Record vector, minus the
-        per-record python objects. Falls back to the python record list
-        when the native library is unavailable; each file's pipe
-        command runs exactly once either way."""
-        n_slots = len(self.use_var_names)
-        # ONE library probe decides the path (availability is global,
-        # not per-file); afterwards each file's text is read (pipe runs
-        # once), parsed, and dropped — peak memory is one file's bytes
-        # plus the accumulated parse, never all raw bytes at once.
+    def _probe_native(self):
+        """ONE library probe decides the parse path for a whole load
+        (availability is global, not per-file)."""
         native_ok = getattr(self, "use_native_parse", True)
         if native_ok:
             try:
@@ -242,15 +233,30 @@ class InMemoryDataset(DatasetBase):
                 native.get_lib()
             except Exception:
                 native_ok = False
+        return native_ok
+
+    def _load_one_file(self, path, native_ok):
+        """Read (pipe runs once) + parse ONE file — the unit of work
+        both the serial load and the preload thread pool schedule.
+        Returns (counts, vals) on the native path, a record list on the
+        python path. The pipe subprocess wait and the ctypes parse call
+        both release the GIL, so these units overlap on threads."""
+        text = self._read_file_text(path)
         if native_ok:
             from ..io import native
-            parsed = []  # per-file (counts, vals)
-            for path in self.filelist:
-                text = self._read_file_text(path)
-                # library is proven live: real errors (malformed data,
-                # MemoryError) must raise loudly, not degrade silently
-                parsed.append(native.multislot_parse(
-                    text, n_slots, self._slot_is_int()))
+            # library is proven live: real errors (malformed data,
+            # MemoryError) must raise loudly, not degrade silently
+            return native.multislot_parse(
+                text, len(self.use_var_names), self._slot_is_int())
+        return [self._parse_line(line)
+                for line in text.decode().splitlines() if line.strip()]
+
+    def _merge_loaded(self, parsed, native_ok):
+        """Merge per-file parse results (filelist order) into the
+        resident store: columnar lanes on the native path, the record
+        list otherwise."""
+        n_slots = len(self.use_var_names)
+        if native_ok:
             counts = (np.concatenate([c for c, _ in parsed])
                       if parsed else np.zeros((0, n_slots), np.int64))
             vals = (np.concatenate([v for _, v in parsed])
@@ -269,18 +275,55 @@ class InMemoryDataset(DatasetBase):
             self._columnar = None
             self._perm = None
             recs = []
-            for path in self.filelist:
-                text = self._read_file_text(path)
-                recs.extend(self._parse_line(line)
-                            for line in text.decode().splitlines()
-                            if line.strip())
+            for file_recs in parsed:
+                recs.extend(file_recs)
             self._memory = recs
 
+    def load_into_memory(self):
+        """Native path keeps the parse COLUMNAR (counts/offsets/value
+        lanes straight from csrc ptc_multislot_parse) so batches
+        assemble by vectorized fancy-indexing and shuffling permutes an
+        index array — the reference's resident-Record vector, minus the
+        per-record python objects. Falls back to the python record list
+        when the native library is unavailable; each file's pipe
+        command runs exactly once either way. Each file's text is read,
+        parsed, and dropped — peak memory is one file's bytes plus the
+        accumulated parse, never all raw bytes at once."""
+        native_ok = self._probe_native()
+        self._merge_loaded(
+            [self._load_one_file(p, native_ok) for p in self.filelist],
+            native_ok)
+
     def preload_into_memory(self, thread_num=None):
-        self.load_into_memory()
+        """Kick off load_into_memory on a thread pool (reference: the
+        preload_threads of data_feed): each file's read+pipe+parse is
+        one pool task, results merge in filelist order at
+        wait_preload_done so record order matches the serial load
+        exactly. thread_num defaults to set_thread()."""
+        import concurrent.futures as cf
+        nt = max(1, int(thread_num if thread_num is not None
+                        else self.thread_num or 1))
+        native_ok = self._probe_native()
+        pool = cf.ThreadPoolExecutor(max_workers=nt)
+        self._preload = (
+            pool,
+            [pool.submit(self._load_one_file, p, native_ok)
+             for p in self.filelist],
+            native_ok)
 
     def wait_preload_done(self):
-        pass
+        """Join the preload pool and publish the merged store. No-op
+        when no preload is in flight (reference behaviour)."""
+        preload = getattr(self, "_preload", None)
+        if preload is None:
+            return
+        pool, futs, native_ok = preload
+        self._preload = None
+        try:
+            results = [f.result() for f in futs]
+        finally:
+            pool.shutdown(wait=True)
+        self._merge_loaded(results, native_ok)
 
     def local_shuffle(self):
         if self._memory is None and self._columnar is None:

@@ -186,7 +186,8 @@ class Model(Layer):
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, prefetch=0, bucket=False, checkpoint=None,
             save_steps=None, auto_resume=False, nan_guard=None,
-            watchdog=None, metrics_port=None, grad_sync=None):
+            watchdog=None, metrics_port=None, grad_sync=None,
+            flat_arena=None):
         """reference hapi/model.py:1128 fit.
 
         TPU pipelining extensions: ``prefetch=N`` stages the next N
@@ -222,10 +223,15 @@ class Model(Layer):
         parallel.overlap.GradSyncScheduler) attaches a gradient-sync
         scheduler to the optimizer — see docs/performance.md
         "Communication overlap & quantized sync" for what each mode
-        means at this (GSPMD-synced) level vs explicit-DDP loops."""
+        means at this (GSPMD-synced) level vs explicit-DDP loops.
+        ``flat_arena=True`` turns on the zero-copy flat parameter arena
+        for the prepared Adam/AdamW (docs/performance.md "Flat
+        parameter arena")."""
         assert self._optimizer is not None, "call prepare() first"
         if grad_sync is not None:
             self._optimizer.set_grad_sync(grad_sync)
+        if flat_arena is not None:
+            self._optimizer.set_flat_arena(flat_arena)
         from ..resilience import faults as _faults
         from ..resilience._common import record as _rrecord
 

@@ -76,18 +76,26 @@ def _discover_state_objects(fn, models, optimizers, scalers=None):
 def _collect_state(models, optimizers, scalers=()):
     """Name → Tensor holder map for everything the step may read/mutate."""
     holders = {}
-    for mi, m in enumerate(models):
-        for name, p in m.named_parameters():
-            holders[f"m{mi}.{name}"] = p
-        for name, b in m.named_buffers():
-            if isinstance(b, Tensor):
-                holders[f"m{mi}.buf.{name}"] = b
+    # optimizers first: a flat-arena optimizer carries its trainables in
+    # one flat buffer per dtype — those params are traced THROUGH the
+    # arena (views sliced from the flat tracer), not as separate holders
+    covered = set()
     for oi, o in enumerate(optimizers):
         o._ensure_all_slots()
         holders[f"o{oi}.lr"] = o._lr_tensor
         for pid, slots in o._accumulators.items():
             for sname, t in slots.items():
                 holders[f"o{oi}.{pid}.{sname}"] = t
+        arena = getattr(o, "_arena", None)
+        if arena is not None:
+            covered |= arena.param_ids
+    for mi, m in enumerate(models):
+        for name, p in m.named_parameters():
+            if id(p) not in covered:
+                holders[f"m{mi}.{name}"] = p
+        for name, b in m.named_buffers():
+            if isinstance(b, Tensor):
+                holders[f"m{mi}.buf.{name}"] = b
     for si, s in enumerate(scalers):
         holders[f"s{si}.scale"] = s._scale
         holders[f"s{si}.good"] = s._good
@@ -180,6 +188,17 @@ class StaticFunction:
         else:
             self._fn = self._orig_fn
         models, optimizers, scalers = self._resolve_objects()
+        from . import tensor as _ptensor
+        own_arenas = []
+        if _ptensor._arena_hook is not None:
+            from .optimizer import arena as _arena_mod
+            own_arenas = [a for a in (getattr(o, "_arena", None)
+                                      for o in optimizers) if a is not None]
+            # external writes to arena leaves (set_value/checkpoint
+            # restore) must land in the flat buffers before we trace
+            # from them; foreign arenas also sync so the step reads
+            # fresh leaf data
+            _arena_mod.flush(exclude=own_arenas)
         holders, state_names, all_params = self._cached_state(
             models, optimizers, scalers)
 
@@ -260,6 +279,10 @@ class StaticFunction:
 
         for name, new in zip(state_names, new_state):
             holders[name].data = new
+        # the flat buffers just advanced; per-leaf views now lag until a
+        # read syncs them (lazily — zero per-step scatter)
+        for a in own_arenas:
+            a.mark_stale()
         for p in all_params:
             p._grad = None
 
@@ -301,11 +324,18 @@ class StaticFunction:
             args, kwargs = jax.tree_util.tree_unflatten(treedef, flat)
 
             hs = _collect_state(models, optimizers, scalers)
+            arenas = [a for a in (getattr(o, "_arena", None)
+                                  for o in optimizers) if a is not None]
             saved = {}
+            saved_views = []
             try:
                 for name, v in zip(state_names, state_vals):
                     saved[name] = hs[name].data
                     hs[name].data = v
+                # arena-covered params: forward reads zero-copy views
+                # sliced from the (now traced) flat buffers
+                for a in arenas:
+                    saved_views.append(a.bind_views())
                 # tag the whole step's HLO with the function name (shows
                 # up in XLA profiles / the flight recorder's HLO dump)
                 with jax.named_scope(fn_scope):
@@ -332,6 +362,8 @@ class StaticFunction:
                         p._grad = None
                 return out_arrays, new_state
             finally:
+                for a, sv in zip(arenas, saved_views):
+                    a.unbind_views(sv)
                 for name, v in saved.items():
                     hs[name].data = v
 

@@ -219,6 +219,12 @@ class Layer:
     # -- state dict ---------------------------------------------------------
     def state_dict(self, include_sublayers=True, keep_vars=True):
         """reference: Layer.state_dict — params + persistable buffers."""
+        from .. import tensor as _ptensor
+        if _ptensor._arena_hook is not None:
+            # flat-arena training leaves param views stale between
+            # steps; a state_dict read is a sync boundary
+            from ..optimizer.arena import sync_all
+            sync_all()
         out = OrderedDict()
         for name, p in self.named_parameters(
                 include_sublayers=include_sublayers):
@@ -288,6 +294,16 @@ class Layer:
         return self._run_forward(args, kwargs)
 
     def _run_forward(self, args, kwargs):
+        from .. import tensor as _ptensor
+        if _ptensor._arena_hook is not None and \
+                jax.core.trace_state_clean():
+            # an EAGER forward is a read boundary for flat-arena params:
+            # compiled steps leave leaf views stale on purpose (the flat
+            # buffer is the carried state), so settle them before eager
+            # math reads the payloads. Inside a trace the views are
+            # bound by jit.py and must not be touched.
+            from ..optimizer.arena import flush
+            flush()
         for hook in self._forward_pre_hooks.values():
             res = hook(self, args)
             if res is not None:

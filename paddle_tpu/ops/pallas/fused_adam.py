@@ -200,6 +200,82 @@ def adam_step(p, g, m, v, lr, beta1_pow, beta2_pow, *, beta1=0.9,
     new_v = beta2 * v + (1 - beta2) * g * g
     mhat = new_m / (1 - beta1_pow)
     vhat = new_v / (1 - beta2_pow)
-    new_p = p - lr * mhat / (jnp.sqrt(vhat) + eps)
+    # cast back to the param dtype: the f32 strong-typed lr would
+    # otherwise silently promote a bf16 param to f32 after one step
+    # (dtype drift = a state-shape recompile); the fused kernel above
+    # already preserves it via unflat
+    new_p = (p - lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+    return new_p, new_m, new_v
+
+
+def fused_adam_update_flat(p, g, m, v, lr, beta1_pow, beta2_pow,
+                           beta1=0.9, beta2=0.999, eps=1e-8,
+                           weight_decay=0.0):
+    """Fused kernel over an arena-flat 1-D buffer. The arena pads every
+    group to an (8, 128)-tile multiple, so the (rows, 128) kernel view
+    is a FREE reshape — no pad, no concat, unlike the multi-tensor path
+    that rebuilds its concatenated layout every call."""
+    from . import interpret_mode
+    n = p.shape[0]
+    cols = 128
+    assert n % cols == 0, "arena buffers are 128-lane aligned"
+    rows = n // cols
+
+    def tile(x):
+        return x.astype(jnp.float32).reshape(rows, cols)
+
+    scal = jnp.stack([jnp.asarray(lr, jnp.float32),
+                      jnp.asarray(beta1_pow, jnp.float32),
+                      jnp.asarray(beta2_pow, jnp.float32),
+                      jnp.asarray(weight_decay, jnp.float32)])
+    br = min(rows, 1024)  # same scoped-VMEM budget as the multi path
+    new_p, new_m, new_v = pl.pallas_call(
+        functools.partial(_adam_multi_kernel, beta1=beta1, beta2=beta2,
+                          eps=eps),
+        grid=(pl.cdiv(rows, br),),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] + [
+            pl.BlockSpec((br, cols), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)] * 4,
+        out_specs=[pl.BlockSpec((br, cols), lambda i: (i, 0),
+                                memory_space=pltpu.VMEM)] * 3,
+        out_shape=[jax.ShapeDtypeStruct((rows, cols), jnp.float32)] * 3,
+        input_output_aliases={1: 0, 3: 1, 4: 2},
+        interpret=interpret_mode(),
+    )(scal, tile(p), tile(g), tile(m), tile(v))
+    return (new_p.reshape(-1).astype(p.dtype),
+            new_m.reshape(-1).astype(m.dtype),
+            new_v.reshape(-1).astype(v.dtype))
+
+
+def adam_step_flat(p, g, m, v, lr, beta1_pow, beta2_pow, *, beta1=0.9,
+                   beta2=0.999, eps=1e-8, weight_decay=0.0, mask=None,
+                   use_fused=None):
+    """The Adam/AdamW update over arena-flat 1-D buffers — the same
+    dispatch discipline as :func:`adam_step` (Pallas kernel when
+    'fused_adam_multi' is enabled or ``use_fused`` forces it, identical
+    plain-XLA math otherwise). The pure path's cast sequencing matches
+    the per-leaf rule exactly — ``astype(p.dtype)`` after the adam term
+    and again after the decoupled decay — so arena mode is bit-identical
+    per element to the per-leaf update it replaces. ``mask`` (bool [n])
+    freezes elements of members that produced no grad this step."""
+    if use_fused is None:
+        from . import enabled
+        use_fused = enabled("fused_adam_multi")
+    if use_fused and mask is None and p.dtype == jnp.float32:
+        new_p, new_m, new_v = fused_adam_update_flat(
+            p, g, m, v, lr, beta1_pow, beta2_pow, beta1=beta1,
+            beta2=beta2, eps=eps, weight_decay=weight_decay)
+        return new_p, new_m, new_v
+    new_m = beta1 * m + (1 - beta1) * g
+    new_v = beta2 * v + (1 - beta2) * g * g
+    mhat = new_m / (1 - beta1_pow)
+    vhat = new_v / (1 - beta2_pow)
+    new_p = (p - lr * mhat / (jnp.sqrt(vhat) + eps)).astype(p.dtype)
+    if weight_decay:
+        new_p = (new_p - lr * weight_decay * p).astype(p.dtype)
+    if mask is not None:
+        new_p = jnp.where(mask, new_p, p)
+        new_m = jnp.where(mask, new_m, m)
+        new_v = jnp.where(mask, new_v, v)
     return new_p, new_m, new_v
 

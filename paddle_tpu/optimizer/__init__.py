@@ -35,13 +35,23 @@ from .lr import LRScheduler
 class Optimizer:
     """Base optimizer (reference: optimizer.py:Optimizer)."""
 
+    # flat-arena capability: subclasses that support the zero-copy flat
+    # parameter arena (optimizer.arena) name their per-element slot
+    # buffers here; None = unsupported (flat_arena=True raises)
+    _arena_slots = None
+    _arena_pows = ()
+
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None,
-                 regularization=None, grad_sync=None):
+                 regularization=None, grad_sync=None, flat_arena=False):
         if parameters is not None and not isinstance(parameters,
                                                      (list, tuple)):
             parameters = list(parameters)
         self._parameter_list = list(parameters) if parameters else None
+        self._arena = None
+        self._flat_arena = False
+        if flat_arena:
+            self.set_flat_arena(True)
         # gradient-sync scheduler (parallel.overlap): a mode string
         # ("exact"|"quantized"|"overlap") or a GradSyncScheduler. Under
         # GSPMD the grads reaching step() are already reduced, so at
@@ -144,6 +154,66 @@ class Optimizer:
         self._grad_sync = grad_sync
         return self
 
+    # -- flat parameter arena ------------------------------------------------
+    def set_flat_arena(self, enable=True):
+        """Toggle the zero-copy flat parameter arena (optimizer.arena):
+        one contiguous buffer per dtype holds every trainable param and
+        its mirrored slot state, so the per-step path has no
+        concat/split HBM traffic. Adam/AdamW only. Disabling dissolves
+        the arena back into ordinary per-leaf slots (values preserved),
+        so the knob can flip mid-training."""
+        enable = bool(enable)
+        if enable:
+            if self._arena_slots is None:
+                raise ValueError(
+                    f"flat_arena is not supported by "
+                    f"{type(self).__name__}; use Adam or AdamW")
+            self._flat_arena = True
+            # the arena itself builds lazily (_ensure_arena) once every
+            # parameter has concrete data
+        else:
+            if self._arena is not None:
+                a = self._arena
+                a.sync_leaves()
+                self._accumulators.pop(id(a), None)
+                for p in self._params():
+                    if id(p) in a.param_ids:
+                        self._accumulators[id(p)] = a.leaf_slot_tensors(p)
+                a.dissolve()
+                self._arena = None
+            self._flat_arena = False
+        return self
+
+    def _ensure_arena(self):
+        """Build (or rebuild after a structure change) the flat arena
+        over the current trainables, adopting any existing per-leaf slot
+        values; registers the flat buffers as ONE accumulators entry so
+        jit/Executor carry them as donated state."""
+        from .arena import ParamArena
+        trainables = [p for p in self._params() if not p.stop_gradient]
+        if self._arena is not None:
+            if self._arena.matches(trainables):
+                if self._arena.needs_repack:
+                    self._arena.repack_leaves()
+                return self._arena
+            # membership/dtype changed: dissolve into per-leaf slots
+            # first so the new arena adopts the live values
+            self.set_flat_arena(False)
+            self._flat_arena = True
+        arena = ParamArena(trainables, slot_names=self._arena_slots,
+                           pow_names=self._arena_pows,
+                           adopt=self._accumulators)
+        for p in trainables:
+            self._accumulators.pop(id(p), None)
+        self._accumulators[id(arena)] = arena.holders()
+        self._arena = arena
+        return arena
+
+    def _arena_apply(self, arena, packed, lr):
+        """Apply the flat update for every packed dtype group (subclass
+        hook — only arena-capable classes are reachable here)."""
+        raise NotImplementedError
+
     def _step_body(self):
         if self._lr_decay is not None:
             # host-side schedule: advance + refresh the device lr tensor
@@ -202,6 +272,24 @@ class Optimizer:
         profiler the whole body runs inside a stable ``opt.<Cls>``
         named_scope, so monitor.profile can attribute the update math —
         one flag check when profiling is off."""
+        if self._flat_arena and self._arena_slots is not None:
+            arena = self._ensure_arena()
+            # the grad pack (one ordered concat per dtype group) happens
+            # OUTSIDE the opt.* scope — it is attributed to arena.pack,
+            # and the opt.* region itself stays pure elementwise math
+            packed = arena.pack_grads(params_grads)
+            if packed is None:
+                self._post_step()
+                return
+            if _monitor.profile.scopes_on:
+                with jax.named_scope(
+                        _monitor.profile.optimizer_scope(self)):
+                    self._arena_apply(arena, packed, lr)
+            else:
+                self._arena_apply(arena, packed, lr)
+            arena.finish_step()
+            self._post_step()
+            return
         if _monitor.profile.scopes_on:
             with jax.named_scope(_monitor.profile.optimizer_scope(self)):
                 return self._apply_update_body(params_grads, lr)
@@ -231,7 +319,12 @@ class Optimizer:
 
     def _ensure_all_slots(self):
         """Create every accumulator eagerly (used by jit.to_static so slot
-        Tensors exist before tracing rather than materializing as tracers)."""
+        Tensors exist before tracing rather than materializing as tracers).
+        In flat-arena mode the arena's flat buffers ARE the accumulators —
+        no per-leaf slots exist."""
+        if self._flat_arena and self._arena_slots is not None:
+            self._ensure_arena()
+            return
         for p in self._params():
             if not p.stop_gradient:
                 self._pre_param(p)
@@ -278,8 +371,15 @@ class Optimizer:
     def state_dict(self):
         out = {"lr": self.get_lr()}
         names = {}
-        for i, p in enumerate(self._params()):
-            pname = p.name or f"param_{i}"
+        named = [(p.name or f"param_{i}", p)
+                 for i, p in enumerate(self._params())]
+        if self._arena is not None:
+            # emit standard per-leaf pname@slot views sliced from the
+            # flat buffers — an arena checkpoint restores into a
+            # per-leaf optimizer unchanged (and vice versa)
+            self._arena.sync_leaves()
+            out.update(self._arena.per_leaf_state(named))
+        for pname, p in named:
             for sname, t in self._accumulators.get(id(p), {}).items():
                 out[f"{pname}@{sname}"] = t
             names[pname] = p
@@ -291,8 +391,20 @@ class Optimizer:
         return out
 
     def set_state_dict(self, state):
+        if self._flat_arena and self._arena_slots is not None:
+            # build (or repack) the arena first so per-leaf checkpoint
+            # slots scatter straight into the flat layout
+            self._ensure_arena()
         for i, p in enumerate(self._params()):
             pname = p.name or f"param_{i}"
+            if self._arena is not None and id(p) in self._arena.param_ids:
+                vals = {k.split("@", 1)[1]:
+                        (v.data if isinstance(v, Tensor) else v)
+                        for k, v in state.items()
+                        if k.startswith(pname + "@")}
+                if vals:
+                    self._arena.load_leaf_state(p, vals)
+                continue
             if not p.stop_gradient:
                 self._pre_param(p)  # scalar slots (beta pows) get real shapes
             for key, value in state.items():
@@ -455,6 +567,9 @@ class Adam(Optimizer):
     use_fused=True routes the update through the Pallas fused-adam kernel
     (reference: the fused multi-tensor adam CUDA path)."""
 
+    _arena_slots = ("moment1", "moment2")
+    _arena_pows = ("beta1_pow", "beta2_pow")
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, lazy_mode=False,
                  use_fused=None, use_multi_tensor=None, **kw):
@@ -548,6 +663,31 @@ class Adam(Optimizer):
             vals.append((float(pair[0]), float(pair[1])))
         return all(v == vals[0] for v in vals[1:])
 
+    def _arena_apply(self, arena, packed, lr):
+        """Flat-arena update: one adam_step_flat call per dtype group,
+        reading/writing the arena buffers in place — no per-step
+        gather/scatter over the param set. Beta-pow bias correction is
+        shared per group (multi-tensor semantics; arena packing already
+        warned if adopted pows disagreed)."""
+        from ..ops.pallas.fused_adam import adam_step_flat
+        for grp, flat_g, mask in packed:
+            m = grp.slots["moment1"]
+            v = grp.slots["moment2"]
+            b1p = grp.pows["beta1_pow"].data * jnp.asarray(
+                self._beta1, grp.pows["beta1_pow"].data.dtype)
+            b2p = grp.pows["beta2_pow"].data * jnp.asarray(
+                self._beta2, grp.pows["beta2_pow"].data.dtype)
+            new_p, new_m, new_v = adam_step_flat(
+                grp.flat.data, flat_g, m.data, v.data, lr, b1p, b2p,
+                beta1=self._beta1, beta2=self._beta2, eps=self._eps,
+                weight_decay=getattr(self, "_wd", 0.0), mask=mask,
+                use_fused=self._use_fused)
+            grp.flat.data = new_p
+            m.data = new_m
+            v.data = new_v
+            grp.pows["beta1_pow"].data = b1p
+            grp.pows["beta2_pow"].data = b2p
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference: AdamW in later paddle; also the
@@ -563,7 +703,10 @@ class AdamW(Adam):
 
     def _rule(self, p, g, slots, lr):
         new_p, new_slots = super()._rule(p, g, slots, lr)
-        new_p = new_p - lr * self._wd * p
+        # cast back per term: a weak-typed f32 lr*wd*p would otherwise
+        # promote bf16 params (and diverge from adam_step_flat's
+        # cast-per-term sequence)
+        new_p = (new_p - lr * self._wd * p).astype(p.dtype)
         return new_p, new_slots
 
 

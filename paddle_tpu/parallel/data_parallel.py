@@ -33,9 +33,10 @@ class DataParallel(Layer):
 
     def __init__(self, layers, strategy=None, mesh=None,
                  grad_sync=None, grad_bits=8, grad_bucket_bytes=None,
-                 async_apply=None):
+                 async_apply=None, flat_arena=None, optimizer=None):
         super().__init__()
         self._layers = layers
+        self.flat_arena = flat_arena
         mesh = mesh or collective.get_mesh()
         if mesh is None and not fleet._initialized:
             fleet.init()
@@ -51,6 +52,13 @@ class DataParallel(Layer):
                 mode=grad_sync, mesh=mesh, bits=grad_bits,
                 bucket_bytes=grad_bucket_bytes or DEFAULT_BUCKET_BYTES,
                 async_apply=async_apply)
+        # optimizer= routes the wrapper-level knobs straight to the
+        # optimizer driving this model (the one-call DDP setup)
+        if optimizer is not None:
+            if self.grad_scheduler is not None:
+                optimizer.set_grad_sync(self.grad_scheduler)
+            if flat_arena is not None:
+                optimizer.set_flat_arena(flat_arena)
 
     def forward(self, *args, **kwargs):
         return self._layers(*args, **kwargs)

@@ -91,6 +91,16 @@ class DistributedStrategy:
         self.nccl_comm_num = 1         # parity no-op
         self.use_local_sgd = False
         self.mode = "collective"
+        # gradient-sync levers (parallel.overlap), routed to the wrapped
+        # optimizer by fleet.distributed_optimizer — the fluid-style user
+        # journey's way to turn compression/overlap on:
+        self.grad_sync = None            # None/"exact"|"quantized"|"overlap"
+        self.quantized_allreduce = False  # int8/int4 wire (implies
+        #                                   "quantized" when no mode is set)
+        self.grad_bits = 8               # wire width for quantized reduces
+        self.grad_bucket_bytes = None    # None -> overlap default (4 MiB)
+        # zero-copy flat parameter arena (optimizer.arena, Adam/AdamW)
+        self.flat_arena = False
 
 
 class RoleMakerBase:
@@ -209,9 +219,29 @@ class Fleet:
     def distributed_optimizer(self, optimizer, strategy=None):
         """reference: fleet.distributed_optimizer — wraps so that optimizer
         state is mesh-placed; with GSPMD the grads arrive already psum'd
-        (XLA inserts the allreduce the reference ran via NCCL)."""
+        (XLA inserts the allreduce the reference ran via NCCL). The
+        strategy's ``grad_sync``/``quantized_allreduce`` knobs attach a
+        parallel.overlap.GradSyncScheduler, and ``flat_arena`` turns on
+        the zero-copy flat parameter arena (Adam/AdamW)."""
         if strategy is not None:
             self._strategy = strategy
+        st = self._strategy
+        if st is not None:
+            mode = getattr(st, "grad_sync", None)
+            quant = bool(getattr(st, "quantized_allreduce", False))
+            if quant and not mode:
+                mode = "quantized"
+            if mode and mode != "exact":
+                from .overlap import (DEFAULT_BUCKET_BYTES,
+                                      GradSyncScheduler)
+                optimizer.set_grad_sync(GradSyncScheduler(
+                    mode=mode, mesh=self._mesh,
+                    bits=int(getattr(st, "grad_bits", 8)),
+                    bucket_bytes=getattr(st, "grad_bucket_bytes", None)
+                    or DEFAULT_BUCKET_BYTES,
+                    quantized=True if quant else None))
+            if getattr(st, "flat_arena", False):
+                optimizer.set_flat_arena(True)
         return DistributedOptimizer(optimizer, self)
 
     def _default_spec_fn(self):

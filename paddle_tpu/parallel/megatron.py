@@ -64,6 +64,13 @@ class MegatronConfig(NamedTuple):
     grad_sync: str = "exact"
     grad_bits: int = 8
     grad_bucket_bytes: int = 4 << 20
+    # flat parameter arena (optimizer/arena.py layout, dp/sp-only meshes):
+    # the whole f32 param tree lives in ONE contiguous buffer; the loss fn
+    # differentiates the buffer itself so the gradient materializes flat —
+    # no per-leaf concat before the dp sync and one fused adam dispatch.
+    # Requires tp == pp == ep == 1 (sharded params can't share a
+    # replicated buffer); ignored with a warning otherwise.
+    flat_arena: bool = False
 
 
 def factorize_mesh(n_devices):
@@ -432,6 +439,85 @@ def _loss_fn(params_local, tokens, cfg):
     return loss
 
 
+def _build_flat_train_step(cfg: MegatronConfig, mesh: Mesh, params):
+    """flat_arena=True path: every (replicated) param leaf lives in one
+    contiguous f32 buffer. The loss fn differentiates the BUFFER — slices
+    and reshapes are views XLA resolves in-register, and the transpose
+    writes each leaf's cotangent straight into one flat gradient, so the
+    dp sync and the adam update both run on a single 1-D array with zero
+    gather/concat traffic. state = {"flat", "opt": {"m", "v"}, "t"};
+    step.layout / step.unpack recover the per-leaf view."""
+    keys = sorted(params)
+    layout, off = [], 0
+    for k in keys:
+        n = int(np.prod(params[k].shape))
+        layout.append((k, off, n, tuple(params[k].shape)))
+        off += n
+    total = off
+    pad = (-total) % 128  # lane-align so the fused flat kernel is eligible
+    flat0 = jnp.concatenate(
+        [jnp.ravel(params[k]).astype(jnp.float32) for k in keys]
+        + ([jnp.zeros((pad,), jnp.float32)] if pad else []))
+    buf_n = total + pad
+    flat0 = jax.device_put(flat0, NamedSharding(mesh, P()))
+    state = {"flat": flat0,
+             "opt": {"m": jnp.zeros_like(flat0),
+                     "v": jnp.zeros_like(flat0)},
+             "t": jnp.zeros((), jnp.int32)}
+    state_spec = {"flat": P(), "opt": {"m": P(), "v": P()}, "t": P()}
+
+    # bucket bounds: contiguous lane-aligned slices of the arena, sized by
+    # grad_bucket_bytes — the scheduler's bucket plan degenerates to plain
+    # index arithmetic on a flat buffer
+    per = max(128, (max(1, int(cfg.grad_bucket_bytes)) // 4 // 128) * 128)
+    bounds = [(i, min(i + per, buf_n)) for i in range(0, buf_n, per)]
+
+    def unpack(flat):
+        return {k: flat[o:o + n].reshape(shape)
+                for k, o, n, shape in layout}
+
+    def device_fn(state, tokens_local):
+        def lf(flat):
+            return _loss_fn(unpack(flat), tokens_local, cfg)
+        loss, flat_g = jax.value_and_grad(lf)(state["flat"])
+        mode = cfg.grad_sync
+        if cfg.quantized_grad_allreduce and mode == "exact":
+            mode = "quantized"  # legacy knob
+        if mode == "exact":
+            flat_g = lax.pmean(flat_g, "dp")
+        else:
+            from .overlap import sync_arena_flat
+            flat_g = sync_arena_flat(flat_g, bounds, axis_name="dp",
+                                     mode=mode, bits=cfg.grad_bits)
+        flat_g = lax.pmean(flat_g, "sp")
+        t = state["t"] + 1
+        tf = t.astype(jnp.float32)
+        b1p = jnp.power(cfg.beta1, tf)
+        b2p = jnp.power(cfg.beta2, tf)
+        from ..ops.pallas.fused_adam import adam_step_flat
+        new_flat, new_m, new_v = adam_step_flat(
+            state["flat"], flat_g, state["opt"]["m"], state["opt"]["v"],
+            cfg.lr, b1p, b2p, beta1=cfg.beta1, beta2=cfg.beta2,
+            eps=cfg.adam_eps)
+        return ({"flat": new_flat, "opt": {"m": new_m, "v": new_v},
+                 "t": t}, loss)
+
+    token_spec = P(None, "dp", "sp")
+    from .collective import shard_map_compat
+    jstep = jax.jit(
+        shard_map_compat(device_fn, mesh=mesh,
+                         in_specs=(state_spec, token_spec),
+                         out_specs=(state_spec, P()),
+                         check_vma=False),
+        donate_argnums=(0,))
+
+    def step(state, tokens):
+        return jstep(state, tokens)
+    step.layout = tuple(layout)
+    step.unpack = unpack
+    return state, step
+
+
 def build_train_step(cfg: MegatronConfig, mesh: Mesh):
     """Returns (state, step_fn). step_fn(state, tokens) -> (state, loss).
     state = {"params", "opt", "t"}; tokens: GLOBAL [n_micro, batch,
@@ -440,8 +526,24 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
     The update rule is the REAL optimizer compute path (reference: fleet
     distributed_optimizer wrapping Adam/SGD): "adam" runs the same fused
     Pallas adam kernel Optimizer.Adam uses (ops/pallas/fused_adam.py) on
-    each param's local shard, slot state sharded exactly like its param."""
+    each param's local shard, slot state sharded exactly like its param.
+
+    cfg.flat_arena=True switches dp/sp-only meshes to the flat parameter
+    arena layout (see _build_flat_train_step); state then carries "flat"
+    instead of "params"."""
     params, specs = init_params(cfg, mesh)
+
+    if cfg.flat_arena:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if (sizes["tp"] == sizes["pp"] == sizes["ep"] == 1
+                and cfg.optimizer == "adam"):
+            return _build_flat_train_step(cfg, mesh, params)
+        import warnings
+        warnings.warn(
+            "MegatronConfig.flat_arena requires tp == pp == ep == 1 and "
+            "optimizer='adam' (sharded params can't share one replicated "
+            "buffer); falling back to the per-leaf path.",
+            RuntimeWarning, stacklevel=2)
 
     pspec_tree = {k: specs[k] for k in params}
     if cfg.optimizer == "adam":

@@ -68,7 +68,7 @@ from ..monitor import trace as _trace
 
 __all__ = [
     "MODES", "SUPPORTED_BITS", "plan_buckets", "wire_bytes", "sync_tree",
-    "local_value_and_grad", "GradSyncScheduler",
+    "sync_arena_flat", "local_value_and_grad", "GradSyncScheduler",
 ]
 
 MODES = ("exact", "quantized", "overlap")
@@ -185,6 +185,40 @@ def sync_tree(tree, axis_name="dp", mode="exact", bits=8,
                 .reshape(leaves[i].shape).astype(leaves[i].dtype)
             off += sizes[i]
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def sync_arena_flat(flat, bounds, axis_name="dp", mode="exact", bits=8,
+                    op="mean"):
+    """Bucketed reduce over a flat-arena gradient buffer *inside* a
+    shard_map region: ``bounds`` is the arena's contiguous-slice bucket
+    plan (``ParamArena.bucket_bounds()[tag]``), so every bucket is a
+    static slice of ``flat`` — the per-leaf gather ``sync_tree`` pays is
+    replaced by pure offsets, and the reassembly is one ordered concat
+    XLA fuses with the downstream flat optimizer update. Padding to the
+    ``io.bucketing`` size family keeps the quantized ring's executable
+    reuse."""
+    _check_mode(mode)
+    if mode == "quantized" and bits not in SUPPORTED_BITS:
+        raise ValueError(
+            f"quantized wire width {bits} unsupported; "
+            f"supported: {SUPPORTED_BITS}")
+    try:
+        n_ranks = axis_size(axis_name)
+    except Exception:
+        n_ranks = 1
+    total = int(flat.shape[0])
+    _account(mode, bits, n_ranks, total, len(bounds))
+    orig = flat.dtype
+    pieces = []
+    for start, stop in bounds:
+        seg = flat[start:stop].astype(jnp.float32)
+        size = stop - start
+        padded = next_bucket(size)
+        if padded > size:
+            seg = jnp.pad(seg, (0, padded - size))
+        red = _reduce_flat(seg, axis_name, mode, bits, op)
+        pieces.append(red[:size].astype(orig))
+    return jnp.concatenate(pieces) if len(pieces) > 1 else pieces[0]
 
 
 # ---------------------------------------------------------------------------
@@ -390,6 +424,19 @@ class GradSyncScheduler:
                 _monitor.counter("comm.lag_warmup").inc()
             return None
         return self._collect(prev)
+
+    def reduce_arena(self, stacked, bounds):
+        """Arena path for explicit-DDP loops: ``stacked`` is ONE
+        ``[n_dp, total]`` flat gradient buffer in arena layout;
+        ``bounds`` its contiguous-slice bucket plan. Each bucket is a
+        cheap contiguous slice (no per-leaf gather) fed through the
+        standard launch/overlap/lag-1 machinery; returns the synced flat
+        buffer (or None on the lag-1 warm-up step)."""
+        segs = [stacked[:, a:b] for a, b in bounds]
+        out = self.reduce(segs)
+        if out is None:
+            return None
+        return jnp.concatenate(out) if len(out) > 1 else out[0]
 
     def _run_bucket(self, fn, bucket, b_id, nbytes):
         with _trace.span("comm.bucket_reduce", bucket=b_id,

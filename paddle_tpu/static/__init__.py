@@ -629,7 +629,7 @@ class Executor:
                            prefetch=0, bucket=False, buckets=None,
                            checkpoint=None, save_steps=None,
                            auto_resume=False, nan_guard=None,
-                           grad_sync=None):
+                           grad_sync=None, flat_arena=None):
         """reference executor.py:train_from_dataset — run the program
         over every batch a fluid.dataset yields. The reference spawns
         C++ DataFeed threads; here each host-assembled MultiSlot batch
@@ -652,7 +652,9 @@ class Executor:
         parallel.overlap.GradSyncScheduler) attaches a gradient-sync
         scheduler to every optimizer the program recorded (see
         docs/performance.md "Communication overlap & quantized
-        sync")."""
+        sync"); ``flat_arena=True`` turns on the zero-copy flat
+        parameter arena for every recorded Adam/AdamW (see
+        docs/performance.md "Flat parameter arena")."""
         if dataset is None:
             raise RuntimeError("dataset is required for train_from_dataset")
         fetch_list = fetch_list or []
@@ -664,6 +666,9 @@ class Executor:
         if grad_sync is not None:
             for _opt, _ in getattr(real_prog, "optimizers", []):
                 _opt.set_grad_sync(grad_sync)
+        if flat_arena is not None:
+            for _opt, _ in getattr(real_prog, "optimizers", []):
+                _opt.set_flat_arena(flat_arena)
         cm = None
         if checkpoint is not None:
             from ..io import CheckpointManager
@@ -923,6 +928,29 @@ class Executor:
                     regularized.append((i, p, g))
                 params_grads = regularized
                 lr = lr_vals[oi]
+                arena = getattr(opt, "_arena", None)
+                if arena is not None and getattr(opt, "_flat_arena",
+                                                 False):
+                    # flat-arena update: params stay per-leaf carried
+                    # state (the Program's contract) but m/v/pow slots
+                    # live flat — see optimizer.arena.static_apply
+                    from ..optimizer.arena import static_apply
+                    aid = id(arena)
+                    sv = {sn: new_slots[k]
+                          for k, (o2, pid, sn) in enumerate(slot_names)
+                          if o2 == oi and pid == aid}
+                    pv = {id(p): new_params[i]
+                          for i, p, _ in params_grads}
+                    new_by_pid, sv_new = static_apply(
+                        opt, [(p, g) for _, p, g in params_grads],
+                        pv, sv, lr)
+                    for i, p, _ in params_grads:
+                        if id(p) in new_by_pid:
+                            new_params[i] = new_by_pid[id(p)]
+                    for k, (o2, pid, sn) in enumerate(slot_names):
+                        if o2 == oi and pid == aid and sn in sv_new:
+                            new_slots[k] = sv_new[sn]
+                    continue
                 for i, p, g in params_grads:
                     slots = {sn: new_slots[k]
                              for k, (o2, pid, sn) in enumerate(slot_names)

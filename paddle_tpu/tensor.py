@@ -28,6 +28,11 @@ _DTYPE_ALIASES = {
 
 _default_dtype = jnp.float32
 
+# optimizer.arena coherence hook: set (module-wide) while any flat param
+# arena is alive; called as _arena_hook(tensor, "read"|"write") so stale
+# per-leaf views materialize lazily and external writes trigger a repack.
+_arena_hook = None
+
 
 def set_default_dtype(dtype):
     """Set the default floating dtype used for tensor creation (cf. reference
@@ -122,6 +127,8 @@ class Tensor:
         self._grad = value
 
     def numpy(self):
+        if _arena_hook is not None:
+            _arena_hook(self, "read")
         return np.asarray(jax.device_get(self.data))
 
     def item(self):
@@ -176,6 +183,8 @@ class Tensor:
         """Overwrite the payload in place (reference: Variable.set_value).
         Copies device arrays so the holder never aliases a buffer that a
         donated compiled step may later invalidate."""
+        if _arena_hook is not None:
+            _arena_hook(self, "write")
         if isinstance(value, Tensor):
             value = value.data
         was_jax = isinstance(value, jax.Array)

@@ -87,6 +87,20 @@ METRICS = [
     ("comm_wire_reduction_int4_x",
      ("comm_wire_reduction_int4_x",), ("comm_wire_reduction_int4_x",),
      "higher", 0.10),
+    # fused-optimizer stage (bench_fused_optimizer / arena_smoke): the
+    # opt.* byte ledger is a deterministic function of the model layout
+    # and the arena packing (tight bands — drift means the packing, the
+    # multi-tensor baseline, or the scope attribution changed); the
+    # post-compile step wall time breathes with CI load (very wide)
+    ("fused_optimizer_opt_bytes_flat",
+     ("fused_optimizer_opt_bytes_flat",),
+     ("fused_optimizer_opt_bytes_flat",), "lower", 0.10),
+    ("fused_optimizer_bytes_reduction",
+     ("fused_optimizer_bytes_reduction",),
+     ("fused_optimizer_bytes_reduction",), "higher", 0.10),
+    ("fused_optimizer_step_time_s",
+     ("fused_optimizer_step_time_s",),
+     ("fused_optimizer_step_time_s",), "lower", 1.00),
     # hotspot stage (bench_hotspot): the ranked fusion menu and the
     # attributed fraction are deterministic functions of the step HLO
     # (tight bands — shrinkage means scope labels or the parser broke);

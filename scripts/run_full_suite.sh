@@ -77,6 +77,16 @@ bash scripts/profile_smoke.sh "$MONITOR_DIR/profile_smoke"
 prf=$?
 [ $prf -ne 0 ] && rc=$((rc == 0 ? prf : rc))
 
+# arena gate: per-leaf vs flat_arena Adam must be bit-identical, cut
+# opt.* bytes >=40% vs the multi-tensor baseline, leave zero
+# concat/gather/scatter in the optimizer scope, and compile exactly
+# once with zero recompiles
+echo ""
+echo "-- arena smoke gate --"
+bash scripts/arena_smoke.sh "$MONITOR_DIR/arena_smoke"
+arn=$?
+[ $arn -ne 0 ] && rc=$((rc == 0 ? arn : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

@@ -164,6 +164,79 @@ def test_inmemory_dataset_batches(tmp_path):
     assert batches[0]["y"].shape == (4, 1)
 
 
+def test_preload_into_memory_matches_serial_load(tmp_path):
+    """preload_into_memory(thread_num) + wait_preload_done must produce
+    the exact record store load_into_memory builds — same count, same
+    order, same batch contents — on both the native-columnar and the
+    python-record parse paths."""
+    files = []
+    for i in range(4):
+        f = tmp_path / f"p{i}.txt"
+        _write_multislot(str(f), n=6)
+        files.append(str(f))
+
+    class V:
+        def __init__(self, name):
+            self.name = name
+
+    def make(native=True):
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(5)
+        ds.set_filelist(files)
+        ds.set_use_var([V("x"), V("y")])
+        ds.use_native_parse = native
+        return ds
+
+    for native in (True, False):
+        a = make(native)
+        a.load_into_memory()
+        b = make(native)
+        b.preload_into_memory(thread_num=4)
+        b.wait_preload_done()
+        assert a.get_memory_data_size() == b.get_memory_data_size() == 24
+        for ba, bb in zip(a._batches(), b._batches()):
+            for k in ba:
+                np.testing.assert_array_equal(ba[k], bb[k])
+    # wait without a preload in flight is a no-op, and double-wait is safe
+    b.wait_preload_done()
+
+
+@pytest.mark.slow
+def test_preload_into_memory_thread_scaling(tmp_path):
+    """4 preload threads must cut wall-clock >= 2x over 1 thread. The
+    per-file cost is pinned in the pipe command (a GIL-releasing
+    subprocess wait), so the bound is deterministic on any host — the
+    only way to beat the serial floor is genuinely concurrent file
+    loads."""
+    import time
+    files = []
+    for i in range(8):
+        f = tmp_path / f"s{i}.txt"
+        _write_multislot(str(f), n=4)
+        files.append(str(f))
+
+    class V:
+        def __init__(self, name):
+            self.name = name
+
+    def run(threads):
+        ds = fluid.DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_batch_size(4)
+        ds.set_filelist(files)
+        ds.set_use_var([V("x"), V("y")])
+        ds.set_pipe_command("sleep 0.2; cat")
+        t0 = time.perf_counter()
+        ds.preload_into_memory(thread_num=threads)
+        ds.wait_preload_done()
+        elapsed = time.perf_counter() - t0
+        assert ds.get_memory_data_size() == 32
+        return elapsed
+
+    serial = run(1)     # >= 8 * 0.2s by construction
+    parallel = run(4)   # ideal ~2 waves of 0.2s
+    assert serial / parallel >= 2.0, (serial, parallel)
+
+
 def test_queue_dataset_shuffle_raises(tmp_path):
     ds = fluid.DatasetFactory().create_dataset("QueueDataset")
     with pytest.raises(NotImplementedError):

@@ -66,6 +66,88 @@ def test_quantized_ring_mean_op(n):
                                       np.asarray(m)[0])
 
 
+# -- satellite (r10): error ENVELOPE over ring lengths {2,4,8,16} ---------
+# Per-hop requantization compounds once per ring hop, so the relative
+# error grows roughly linearly in log2(ring length). The envelope below
+# is the measured worst case (5 seeds, 501-elem odd-length payload)
+# with ~35% headroom; docs/performance.md §6 turns it into dp-size
+# guidance (int8 fine through dp=16, int4 recommended dp<=8).
+
+_QUANT_ENVELOPE = {
+    8: lambda n: 0.006 * np.log2(n) + 0.006,
+    4: lambda n: 0.10 * np.log2(n) + 0.08,
+}
+
+
+def _quant_worst_rel_err(n, bits, mesh):
+    worst = 0.0
+    for seed in range(3):
+        rng = np.random.RandomState(1000 * n + 17 * bits + seed)
+        per_dev = rng.randn(n, 501).astype("f4")
+        exact = per_dev.sum(0)
+        out = np.asarray(jax.jit(collective.shard_map_compat(
+            lambda x: collective.all_reduce_quantized(
+                x, axis_name="dp", bits=bits),
+            mesh, in_specs=P("dp", None), out_specs=P("dp", None),
+            check_vma=False))(per_dev))
+        worst = max(worst, float(np.abs(out[0] - exact).max()
+                                 / np.abs(exact).max()))
+    return worst
+
+
+@pytest.mark.parametrize("n", [2, 4, 8])
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantized_ring_error_envelope(n, bits):
+    """Worst-case relative error stays under the published envelope at
+    every in-process ring length (the envelope is what the dp-size
+    guidance in docs/performance.md promises users)."""
+    err = _quant_worst_rel_err(n, bits, _ring(n))
+    assert err <= _QUANT_ENVELOPE[bits](n), (n, bits, err)
+
+
+def test_quantized_ring_error_envelope_dp16():
+    """Ring length 16 exceeds the suite's 8 virtual devices, so the
+    same envelope check runs in a child process with a 16-device CPU
+    topology — the largest dp size the guidance table covers."""
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    code = textwrap.dedent("""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = \
+            "--xla_force_host_platform_device_count=16"
+        import numpy as np, jax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from paddle_tpu.parallel import collective
+        mesh = Mesh(np.array(jax.devices()[:16]).reshape(16), ("dp",))
+        for bits, bound in ((8, 0.006 * 4 + 0.006), (4, 0.10 * 4 + 0.08)):
+            worst = 0.0
+            for seed in range(3):
+                rng = np.random.RandomState(16000 + 17 * bits + seed)
+                per_dev = rng.randn(16, 501).astype("f4")
+                exact = per_dev.sum(0)
+                out = np.asarray(jax.jit(collective.shard_map_compat(
+                    lambda x: collective.all_reduce_quantized(
+                        x, axis_name="dp", bits=bits),
+                    mesh, in_specs=P("dp", None),
+                    out_specs=P("dp", None), check_vma=False))(per_dev))
+                worst = max(worst, float(np.abs(out[0] - exact).max()
+                                         / np.abs(exact).max()))
+            assert worst <= bound, (bits, worst, bound)
+        print("ENVELOPE_OK")
+    """)
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "ENVELOPE_OK" in proc.stdout
+
+
 def test_quantized_width_and_op_validation():
     """Unsupported widths fail loudly, naming the supported set."""
     with pytest.raises(ValueError, match=r"4, 8"):
