@@ -450,6 +450,43 @@ def bench_fused_optimizer(timeout_s=600):
     }
 
 
+def bench_planner(timeout_s=600):
+    """Auto-sharding planner stage: runs scripts/plan_smoke.py in a
+    subprocess pinned to 8 virtual CPU devices and banks the advisor's
+    decision: candidate count (tight band — drift means the
+    factorization enumeration changed), the winning layout's predicted
+    step seconds (very wide band — a modeled time), and the chosen
+    factorization label. The smoke itself enforces the hard gates
+    (bit-identity with the hand megatron layout, zero extra
+    recompiles, predicted-fastest == measured-fastest)."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "plan_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_plan"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"plan_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    return {
+        "planner_candidates": r["planner_candidates"],
+        "planner_predicted_step_s": r["planner_predicted_step_s"],
+        "planner_chosen": r["planner_chosen"],
+        "planner_gates_pass": bool(r["pass"]),
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -853,6 +890,15 @@ def main():
             print(f"partial fused_optimizer_bytes_reduction="
                   f"{fo['fused_optimizer_bytes_reduction']}", flush=True)
             _RESULTS.update(fo)
+        try:
+            pl = bench_planner()
+        except Exception as e:
+            print(f"planner bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial planner_chosen={pl['planner_chosen']} "
+                  f"candidates={pl['planner_candidates']}", flush=True)
+            _RESULTS.update(pl)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

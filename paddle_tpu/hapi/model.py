@@ -57,6 +57,7 @@ class Model(Layer):
         self._pred_fn = None
         self._bucket_buckets = None  # fit(bucket=True) sets [batch_size]
         self._guard_traced = False   # nan_guard baked into _train_step?
+        self._mesh_plan = None       # fit(mesh_plan=) resolved MeshPlan
         self.stop_training = False
 
     # -- wiring ------------------------------------------------------------
@@ -109,7 +110,7 @@ class Model(Layer):
             self._train_step = jit.to_static(
                 step, models=[self], optimizers=[self._optimizer],
                 bucket=self._bucket_buckets is not None,
-                buckets=self._bucket_buckets)
+                buckets=self._bucket_buckets, plan=self._mesh_plan)
         from ..tensor import to_tensor
         args = [to_tensor(a) for a in list(inputs) + list(labels)]
         loss = self._train_step(*args)
@@ -187,7 +188,7 @@ class Model(Layer):
             callbacks=None, prefetch=0, bucket=False, checkpoint=None,
             save_steps=None, auto_resume=False, nan_guard=None,
             watchdog=None, metrics_port=None, grad_sync=None,
-            flat_arena=None):
+            flat_arena=None, mesh_plan=None):
         """reference hapi/model.py:1128 fit.
 
         TPU pipelining extensions: ``prefetch=N`` stages the next N
@@ -226,12 +227,30 @@ class Model(Layer):
         means at this (GSPMD-synced) level vs explicit-DDP loops.
         ``flat_arena=True`` turns on the zero-copy flat parameter arena
         for the prepared Adam/AdamW (docs/performance.md "Flat
-        parameter arena")."""
+        parameter arena").
+
+        Parallelism extension: ``mesh_plan`` (a
+        parallel.planner.MeshPlan, a tuple of ``(regex, spec)`` rules,
+        or ``"auto"``) places every parameter and optimizer slot under
+        the plan's PartitionSpecs, shards input batches over the
+        plan's data axes, and folds the plan key into the train step's
+        executable cache key — one config line for dp×tp(×sp) hybrid
+        layouts (docs/parallelism.md)."""
         assert self._optimizer is not None, "call prepare() first"
         if grad_sync is not None:
             self._optimizer.set_grad_sync(grad_sync)
         if flat_arena is not None:
             self._optimizer.set_flat_arena(flat_arena)
+        if mesh_plan is not None:
+            from ..parallel import planner as _planner
+            new_plan = _planner.resolve(mesh_plan)
+            old_key = (self._mesh_plan.plan_key()
+                       if self._mesh_plan is not None else None)
+            if new_plan.plan_key() != old_key:
+                self._train_step = None  # never reuse a stale layout
+            self._mesh_plan = new_plan
+            new_plan.place_model(self)
+            new_plan.place_optimizer(self._optimizer)
         from ..resilience import faults as _faults
         from ..resilience._common import record as _rrecord
 

@@ -109,7 +109,7 @@ class StaticFunction:
 
     def __init__(self, fn, models=None, optimizers=None, donate_state=True,
                  jit_kwargs=None, scalers=None, bucket=False, buckets=None,
-                 pad_mode="repeat"):
+                 pad_mode="repeat", plan=None):
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__"),
                                  updated=())
@@ -130,6 +130,10 @@ class StaticFunction:
         self._bucket = bucket
         self._buckets = buckets
         self._pad_mode = pad_mode
+        # parallel.planner.MeshPlan: input batches shard under the
+        # plan's data spec and the plan key joins the cache key (a plan
+        # switch can never silently reuse a stale executable)
+        self._plan = plan
         self._seen_base = set()  # recompile (vs first-compile) accounting
 
     def _resolve_objects(self):
@@ -227,10 +231,14 @@ class StaticFunction:
                 if _monitor.enabled():
                     _monitor.counter("jit.bucket_pad").inc()
 
+        if self._plan is not None:
+            arrays = [self._plan.shard_input(a) for a in arrays]
+
         train_flags = tuple(m.training for m in models)
         base = (treedef, tuple(arr_idx),
                 tuple((i, repr(s)) for i, s in statics), train_flags,
-                tuple(state_names), ast_on)
+                tuple(state_names), ast_on,
+                self._plan.plan_key() if self._plan is not None else None)
         key = base + (tuple((a.shape, str(a.dtype)) for a in arrays),)
 
         fn_label = getattr(self, "__name__", "fn")
@@ -374,7 +382,7 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, models=None, optimizers=None,
               donate_state=True, scalers=None, bucket=False, buckets=None,
-              pad_mode="repeat", **kwargs):
+              pad_mode="repeat", plan=None, **kwargs):
     """Decorator/wrapper: compile a dygraph step into one XLA computation.
 
     reference: paddle.jit.to_static (dygraph_to_static/program_translator.py)
@@ -389,12 +397,17 @@ def to_static(function=None, input_spec=None, models=None, optimizers=None,
     repeat the last real row (``pad_mode="zeros"`` zero-fills) and DO
     participate in scalar reductions — use io.bucketing.batch_mask in the
     loss when exact ragged-batch values matter.
+
+    ``plan=`` (a parallel.planner.MeshPlan) shards input batches under
+    the plan's data axes and folds the plan key into the executable
+    cache key — switching plans recompiles instead of silently reusing
+    a stale layout.
     """
     def wrap(fn):
         return StaticFunction(fn, models=models, optimizers=optimizers,
                               donate_state=donate_state, scalers=scalers,
                               bucket=bucket, buckets=buckets,
-                              pad_mode=pad_mode)
+                              pad_mode=pad_mode, plan=plan)
     if function is not None:
         return wrap(function)
     return wrap

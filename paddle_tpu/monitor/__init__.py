@@ -98,6 +98,25 @@ overlap & quantized sync"; ``paddle_tpu.parallel.overlap``):
   overlap with backward compute is *visible* in the Chrome export) and
   the blocking collect
 
+Auto-sharding planner series (docs/parallelism.md;
+``paddle_tpu.parallel.planner``):
+
+* ``planner.plan`` / ``planner.auto_pick`` — plans built, and how many
+  let the advisor pick the mesh (``plan(auto=True)``)
+* ``planner.candidates`` (gauge) / ``planner.predicted_step_s``
+  (gauge) — size of the last advisor table and the winner's predicted
+  step time; each decision also lands as one ``kind="planner"`` JSONL
+  record (chosen sizes, ranked table head, rule hash) cross-linked to
+  the profiler's current top hotspot region, and as the ``planner``
+  block of ``/snapshot``
+* ``layout.degraded`` — dims a requested spec could not shard on the
+  actual mesh (non-divisible or missing axes) and replicated instead;
+  warned once per (param, dim), counted every time — the advisor's
+  degradation penalty reads the same signal
+* ``arena.flat_fallback`` — flat-arena requests that fell back to the
+  per-leaf path because the layout shards params (tp/pp/ep > 1);
+  warned once per config, counted every time
+
 Span tracing & XLA-measured cost (PR 4's additions):
 
 * ``monitor.trace``  — thread-aware span tracer (``span()`` context

@@ -153,6 +153,14 @@ def snapshot_payload():
         label, info = newest
         xla_cost = {"labels": _xla.labels(), "last_label": label,
                     "last": dict(info or {})}
+    planner_block = None
+    try:
+        # lazy: the planner lives in parallel/ and importing it here
+        # eagerly would couple the telemetry plane to jax.sharding
+        from ..parallel import planner as _planner
+        planner_block = _planner.last_decision()
+    except Exception:
+        planner_block = None
     return {
         "ts": time.time(),
         "pid": os.getpid(),
@@ -161,6 +169,7 @@ def snapshot_payload():
         "flight_dir": _trace.last_flight(),
         "xla_cost": xla_cost,
         "hotspots": _profile.last_summary(),
+        "planner": planner_block,
         "counters": _mon.snapshot(),
     }
 

@@ -355,13 +355,34 @@ class ParamArena:
         return packed or None
 
     # -- grad-sync layout ----------------------------------------------------
-    def bucket_bounds(self, bucket_bytes=None):
+    def bucket_bounds(self, bucket_bytes=None, plan=None):
         """Contiguous-slice bucket plan per group for parallel.overlap:
         ``{tag: [(start, stop), ...]}`` tiles ``[0, total)`` (pad rides
         in the last bucket), each bucket one in-place slice of the flat
         gradient layout — the arena replaces plan_buckets' per-leaf
-        gather with pure offsets."""
+        gather with pure offsets.
+
+        ``plan`` (a parallel.planner.MeshPlan) asserts the layout
+        contract: the arena packs every member into ONE replicated
+        buffer per dtype, so a plan that shards any member param would
+        make these bounds non-contiguous per shard. Such a plan raises
+        here instead of silently producing torn buckets — use the
+        per-leaf path (arena.flat_fallback accounting) for
+        tensor-sharded layouts."""
         from ..parallel.overlap import DEFAULT_BUCKET_BYTES, plan_buckets
+        if plan is not None:
+            named = {}
+            for grp in self.groups:
+                for i, (p, _off, _n, shape) in enumerate(grp.entries):
+                    named[getattr(p, "name", None)
+                          or f"{grp.tag}.param{i}"] = tuple(shape)
+            bad = plan.arena_compatible(named)
+            if bad is not None:
+                raise ValueError(
+                    f"mesh_plan shards arena member {bad[0]!r} as "
+                    f"{bad[1]} — the flat arena requires replicated "
+                    f"params; drop flat_arena or replicate the param "
+                    f"in the plan")
         if bucket_bytes is None:
             bucket_bytes = DEFAULT_BUCKET_BYTES
         out = {}

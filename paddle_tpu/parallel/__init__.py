@@ -11,6 +11,9 @@ from .overlap import (GradSyncScheduler, local_value_and_grad, sync_tree,
                       plan_buckets)
 from . import layout
 from .layout import mesh_signature, extract_layout, adapt_spec, reshard
+from . import planner
+from .planner import (MeshPlan, MEGATRON_RULES, TRANSFORMER_RULES,
+                      advise, plan)
 from .env import ParallelEnv, prepare_context
 from . import fleet as fleet_mod
 from .fleet import fleet, DistributedStrategy, PaddleCloudRoleMaker, init

@@ -33,15 +33,28 @@ class DataParallel(Layer):
 
     def __init__(self, layers, strategy=None, mesh=None,
                  grad_sync=None, grad_bits=8, grad_bucket_bytes=None,
-                 async_apply=None, flat_arena=None, optimizer=None):
+                 async_apply=None, flat_arena=None, optimizer=None,
+                 mesh_plan=None):
         super().__init__()
         self._layers = layers
         self.flat_arena = flat_arena
+        self.mesh_plan = None
+        if mesh_plan is None and strategy is not None:
+            mesh_plan = getattr(strategy, "mesh_plan", None)
         mesh = mesh or collective.get_mesh()
         if mesh is None and not fleet._initialized:
             fleet.init()
             mesh = fleet.mesh
-        if mesh is not None:
+        if mesh_plan is not None:
+            # planner-driven layout: rules decide each param's spec
+            # (tp/sp splits included) instead of blanket replication;
+            # the resolved plan is exposed for jit.to_static(plan=)
+            from . import planner as _planner
+            self.mesh_plan = _planner.resolve(mesh_plan, mesh=mesh)
+            mesh = self.mesh_plan.mesh
+            fleet._mesh = fleet._mesh or mesh
+            self.mesh_plan.place_model(layers)
+        elif mesh is not None:
             fleet._mesh = fleet._mesh or mesh
             fleet.shard_model(layers)
         self.grad_scheduler = None
@@ -51,7 +64,7 @@ class DataParallel(Layer):
             self.grad_scheduler = GradSyncScheduler(
                 mode=grad_sync, mesh=mesh, bits=grad_bits,
                 bucket_bytes=grad_bucket_bytes or DEFAULT_BUCKET_BYTES,
-                async_apply=async_apply)
+                async_apply=async_apply, plan=self.mesh_plan)
         # optimizer= routes the wrapper-level knobs straight to the
         # optimizer driving this model (the one-call DDP setup)
         if optimizer is not None:

@@ -101,6 +101,10 @@ class DistributedStrategy:
         self.grad_bucket_bytes = None    # None -> overlap default (4 MiB)
         # zero-copy flat parameter arena (optimizer.arena, Adam/AdamW)
         self.flat_arena = False
+        # planner-driven layout (parallel.planner): a MeshPlan, a tuple
+        # of (regex, spec) rules, or "auto" — distributed_model places
+        # params by the plan's rules instead of megatron_param_spec
+        self.mesh_plan = None
 
 
 class RoleMakerBase:
@@ -266,7 +270,16 @@ class Fleet:
         """Place a user nn.Layer on the mesh. When the mesh has a >1
         tensor axis, parameters get Megatron column/row shardings by
         default (megatron_param_spec); compose with jit.to_static and
-        GSPMD partitions the whole fwd+bwd+update step across dp×tp."""
+        GSPMD partitions the whole fwd+bwd+update step across dp×tp.
+
+        A ``strategy.mesh_plan`` (parallel.planner rules / MeshPlan /
+        "auto") takes precedence over megatron_param_spec: the plan's
+        regex rules decide every param's spec."""
+        if param_spec_fn is None and self._strategy is not None and \
+                getattr(self._strategy, "mesh_plan", None) is not None:
+            from . import planner as _planner
+            param_spec_fn = _planner.resolve(
+                self._strategy.mesh_plan, mesh=self._mesh).as_spec_fn()
         if param_spec_fn is None:
             param_spec_fn = self._default_spec_fn()
         self.shard_model(model, param_spec_fn)

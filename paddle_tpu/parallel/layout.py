@@ -99,14 +99,38 @@ def extract_layout(named_values):
     return out
 
 
-def adapt_spec(lists, shape, mesh):
+# (param-name, dim, axes) triples already warned about — degradation is
+# warned ONCE per site so a training loop re-placing every step doesn't
+# spam; the layout.degraded counter keeps the full count for the planner.
+_degrade_warned = set()
+
+
+def _note_degraded(name, d, axes, dim, prod, reason):
+    from .. import monitor as _monitor
+    _monitor.counter("layout.degraded").inc()
+    key = (name, d, tuple(axes))
+    if key in _degrade_warned:
+        return
+    _degrade_warned.add(key)
+    import warnings
+    who = f"param {name!r}" if name else "array"
+    warnings.warn(
+        f"layout: {who} dim {d} (size {dim}) degraded to replicated — "
+        f"{reason} (requested axes {list(axes)}, product {prod}). "
+        f"Counted in layout.degraded; further degradations of this dim "
+        f"are silent.", RuntimeWarning, stacklevel=4)
+
+
+def adapt_spec(lists, shape, mesh, name=None):
     """Map a saved spec (lists form) onto `mesh` for an array of `shape`.
 
     Returns ``(PartitionSpec, changed)``. Per dimension: axis names the
     mesh doesn't have are dropped; if the surviving axes' size product
     does not divide the dimension, the whole dimension falls back to
     replicated. `changed` is True when any dim degraded — the signal
-    behind ``ckpt.restore_resharded`` accounting.
+    behind ``ckpt.restore_resharded`` accounting and the planner's
+    degradation penalty. Every degraded dim bumps the
+    ``layout.degraded`` counter and warns once per (name, dim, axes).
     """
     if mesh is None:
         return P(), bool(lists and any(lists))
@@ -124,6 +148,11 @@ def adapt_spec(lists, shape, mesh):
         if not kept or prod <= 0 or dim % prod != 0:
             if kept:
                 changed = True
+                _note_degraded(name, d, e, dim, prod,
+                               "axis product does not divide the dim")
+            elif e:
+                _note_degraded(name, d, e, dim, prod,
+                               "mesh has none of the requested axes")
             entries.append(None)
             continue
         entries.append(kept[0] if len(kept) == 1 else tuple(kept))

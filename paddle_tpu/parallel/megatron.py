@@ -71,6 +71,13 @@ class MegatronConfig(NamedTuple):
     # Requires tp == pp == ep == 1 (sharded params can't share a
     # replicated buffer); ignored with a warning otherwise.
     flat_arena: bool = False
+    # planner rule set (parallel.planner): a tuple of (regex, spec)
+    # rules — spec as PartitionSpec or spec_to_lists form — that
+    # overrides the hand-written init_params specs. None keeps the
+    # hand layout. Must be a tuple (hashable) so configs stay usable
+    # as dict keys; planner.MeshPlan(rules, mesh).spec_for drives the
+    # placement.
+    mesh_plan: tuple = None
 
 
 def factorize_mesh(n_devices):
@@ -112,10 +119,13 @@ def make_mesh(n_devices=None, devices=None, sizes=None):
 # parameter init (per-device LOCAL shards built under shard_map-compatible
 # global specs: we build GLOBAL arrays and device_put with NamedShardings)
 
-def init_params(cfg: MegatronConfig, mesh: Mesh, seed=0):
+def init_params(cfg: MegatronConfig, mesh: Mesh, seed=0, plan=None):
     """Global parameter pytree + its PartitionSpecs. tp splits: qkv/ffn1
     column-wise, out/ffn2 row-wise (Megatron); pp stacks stages; ep stacks
-    experts."""
+    experts. `plan` (a parallel.planner.MeshPlan, or cfg.mesh_plan rules
+    resolved by the caller) replaces the hand specs with rule-matched
+    ones — the planner's reproduction target is bit-identity with the
+    hand layout."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     pp, tp, ep = sizes["pp"], sizes["tp"], sizes["ep"]
     h = cfg.hidden
@@ -176,6 +186,10 @@ def init_params(cfg: MegatronConfig, mesh: Mesh, seed=0):
         specs["moe_router"] = P(None, None)
         specs["moe_w1"] = P("ep", None, None, None)
         specs["moe_w2"] = P("ep", None, None, None)
+
+    if plan is not None:
+        specs = {k: plan.spec_for(k, np.shape(v))
+                 for k, v in params.items()}
 
     placed = {
         k: jax.device_put(jnp.asarray(v), NamedSharding(mesh, specs[k]))
@@ -518,6 +532,30 @@ def _build_flat_train_step(cfg: MegatronConfig, mesh: Mesh, params):
     return state, step
 
 
+# configs (by repr — always hashable, even when mesh_plan carries
+# unhashable spec forms) that have already warned about the flat-arena
+# fallback. Every fallback still counts in arena.flat_fallback so the
+# planner and dashboards see the rate; only the first one per config
+# warns.
+_flat_fallback_warned = set()
+
+
+def _warn_flat_fallback(cfg):
+    from .. import monitor as _monitor
+    _monitor.counter("arena.flat_fallback").inc()
+    key = repr(cfg)
+    if key in _flat_fallback_warned:
+        return
+    _flat_fallback_warned.add(key)
+    import warnings
+    warnings.warn(
+        "MegatronConfig.flat_arena requires tp == pp == ep == 1 and "
+        "optimizer='adam' (sharded params can't share one replicated "
+        "buffer); falling back to the per-leaf path. Counted in "
+        "arena.flat_fallback; this config will not warn again.",
+        RuntimeWarning, stacklevel=3)
+
+
 def build_train_step(cfg: MegatronConfig, mesh: Mesh):
     """Returns (state, step_fn). step_fn(state, tokens) -> (state, loss).
     state = {"params", "opt", "t"}; tokens: GLOBAL [n_micro, batch,
@@ -531,19 +569,19 @@ def build_train_step(cfg: MegatronConfig, mesh: Mesh):
     cfg.flat_arena=True switches dp/sp-only meshes to the flat parameter
     arena layout (see _build_flat_train_step); state then carries "flat"
     instead of "params"."""
-    params, specs = init_params(cfg, mesh)
+    plan = None
+    if cfg.mesh_plan is not None:
+        from .planner import MeshPlan
+        plan = (cfg.mesh_plan if isinstance(cfg.mesh_plan, MeshPlan)
+                else MeshPlan(cfg.mesh_plan, mesh=mesh))
+    params, specs = init_params(cfg, mesh, plan=plan)
 
     if cfg.flat_arena:
         sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
         if (sizes["tp"] == sizes["pp"] == sizes["ep"] == 1
                 and cfg.optimizer == "adam"):
             return _build_flat_train_step(cfg, mesh, params)
-        import warnings
-        warnings.warn(
-            "MegatronConfig.flat_arena requires tp == pp == ep == 1 and "
-            "optimizer='adam' (sharded params can't share one replicated "
-            "buffer); falling back to the per-leaf path.",
-            RuntimeWarning, stacklevel=2)
+        _warn_flat_fallback(cfg)
 
     pspec_tree = {k: specs[k] for k in params}
     if cfg.optimizer == "adam":

@@ -269,8 +269,14 @@ class GradSyncScheduler:
 
     def __init__(self, mode="overlap", mesh=None, axis_name="dp",
                  bits=8, bucket_bytes=DEFAULT_BUCKET_BYTES,
-                 async_apply=None, op="mean", quantized=None):
+                 async_apply=None, op="mean", quantized=None, plan=None):
         _check_mode(mode)
+        if plan is not None:
+            # a parallel.planner.MeshPlan supplies the mesh and the
+            # grad-sync axis, so the scheduler reduces over exactly the
+            # axis the plan shards batches on
+            mesh = mesh if mesh is not None else plan.mesh
+            axis_name = plan.grad_axis()
         if bits not in SUPPORTED_BITS:
             raise ValueError(
                 f"quantized wire width {bits} unsupported; "

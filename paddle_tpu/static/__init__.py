@@ -411,11 +411,12 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, scope=None, bucket=False, buckets=None,
             pad_mode="repeat", async_fetch=False, fetch_period=None,
-            nan_guard=None):
+            nan_guard=None, mesh_plan=None):
         try:
             return self._run_impl(program, feed, fetch_list, return_numpy,
                                   scope, bucket, buckets, pad_mode,
-                                  async_fetch, fetch_period, nan_guard)
+                                  async_fetch, fetch_period, nan_guard,
+                                  mesh_plan)
         except BaseException:
             # unhandled crash: leave the flight-recorder artifact (last
             # spans + counters + active HLO) before the stack unwinds
@@ -425,7 +426,7 @@ class Executor:
 
     def _run_impl(self, program, feed, fetch_list, return_numpy, scope,
                   bucket, buckets, pad_mode, async_fetch, fetch_period,
-                  nan_guard):
+                  nan_guard, mesh_plan=None):
         program = program or default_main_program()
         if isinstance(nan_guard, str):
             from ..resilience.guard import NaNGuard
@@ -474,7 +475,21 @@ class Executor:
             if padded_n is not None and _monitor.enabled():
                 _monitor.counter("executor.bucket_pad").inc()
 
-        if dp_mesh is not None:
+        plan = None
+        if mesh_plan is not None:
+            from ..parallel import planner as _planner
+            plan = _planner.resolve(mesh_plan, mesh=dp_mesh)
+
+        if plan is not None:
+            # planner-driven layout: every feed shards under the plan's
+            # data axes (replicated when the batch dim doesn't divide),
+            # every param takes its rule-matched spec — this is the
+            # generalization of with_data_parallel to dp×tp(×sp) hybrids
+            for k, a in feed_arrays.items():
+                feed_arrays[k] = plan.shard_input(a)
+            for n, holder in program.param_vars.items():
+                holder.data = plan.place(n, holder.data)
+        elif dp_mesh is not None:
             # CompiledProgram.with_data_parallel: batch-shard every feed
             # over the mesh; params ride replicated and GSPMD partitions
             # the compiled step (reference: compiler.py graph replication)
@@ -504,7 +519,8 @@ class Executor:
             self._param_slot_names(program)
 
         base_key = (program.id, program.version, tuple(fetch_names),
-                    self._mesh_sig(dp_mesh, dp_requested),
+                    (plan.plan_key() if plan is not None
+                     else self._mesh_sig(dp_mesh, dp_requested)),
                     nan_guard is not None)
         key = base_key + (tuple(sorted((k, tuple(a.shape), str(a.dtype))
                                        for k, a in feed_arrays.items())),)
@@ -629,7 +645,8 @@ class Executor:
                            prefetch=0, bucket=False, buckets=None,
                            checkpoint=None, save_steps=None,
                            auto_resume=False, nan_guard=None,
-                           grad_sync=None, flat_arena=None):
+                           grad_sync=None, flat_arena=None,
+                           mesh_plan=None):
         """reference executor.py:train_from_dataset — run the program
         over every batch a fluid.dataset yields. The reference spawns
         C++ DataFeed threads; here each host-assembled MultiSlot batch
@@ -654,7 +671,12 @@ class Executor:
         docs/performance.md "Communication overlap & quantized
         sync"); ``flat_arena=True`` turns on the zero-copy flat
         parameter arena for every recorded Adam/AdamW (see
-        docs/performance.md "Flat parameter arena")."""
+        docs/performance.md "Flat parameter arena").
+
+        ``mesh_plan`` (a parallel.planner.MeshPlan, rule tuple, or
+        "auto") lays the program's params and every feed batch out
+        under the plan — same knob as hapi.Model.fit(mesh_plan=); see
+        docs/parallelism.md."""
         if dataset is None:
             raise RuntimeError("dataset is required for train_from_dataset")
         fetch_list = fetch_list or []
@@ -669,6 +691,9 @@ class Executor:
         if flat_arena is not None:
             for _opt, _ in getattr(real_prog, "optimizers", []):
                 _opt.set_flat_arena(flat_arena)
+        if mesh_plan is not None:
+            from ..parallel import planner as _planner
+            mesh_plan = _planner.resolve(mesh_plan)
         cm = None
         if checkpoint is not None:
             from ..io import CheckpointManager
@@ -713,7 +738,7 @@ class Executor:
                     _faults.maybe_raise("host_loss", i)
                 outs = self.run(program, feed=batch, fetch_list=fetch_list,
                                 scope=scope, bucket=bucket, buckets=buckets,
-                                nan_guard=nan_guard)
+                                nan_guard=nan_guard, mesh_plan=mesh_plan)
                 if handler is not None:
                     handler.notify_step(i)
                 if debug and fetch_list and i % max(print_period, 1) == 0:

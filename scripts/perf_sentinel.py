@@ -113,6 +113,15 @@ METRICS = [
     ("hotspot_top_headroom_s",
      ("hotspot_top_headroom_s",), ("hotspot_top_headroom_s",),
      "lower", 1.00),
+    # planner stage (bench_planner / plan_smoke): the candidate count
+    # is a deterministic function of the device count and axis set
+    # (tight band — drift means the factorization enumeration changed);
+    # the winner's predicted step time is a modeled quantity fed by the
+    # cost model's constants (very wide band)
+    ("planner_candidates", ("planner_candidates",),
+     ("planner_candidates",), "higher", 0.10),
+    ("planner_predicted_step_s", ("planner_predicted_step_s",),
+     ("planner_predicted_step_s",), "lower", 1.00),
 ]
 
 

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# CI gate for the profile-guided auto-sharding planner: one
+# MegatronConfig(mesh_plan=MEGATRON_RULES) line must reproduce the
+# hand-written dp/tp megatron layout bit-identically (specs, losses,
+# final params), hapi fit(mesh_plan=) must mint zero extra executables
+# vs the plan-free fit, the advisor table must be non-empty and
+# rank-stable, and its predicted-fastest layout must be the
+# measured-fastest in a dp8-vs-dp2tp4 A/B on 8 virtual CPU devices.
+# Tier-1-safe: tiny configs, CPU, seconds.
+#
+# Usage: scripts/plan_smoke.sh [out_dir]
+# The monitor JSONL lands in out_dir (default
+# /tmp/paddle_tpu_plan_smoke); the last stdout line is one JSON
+# result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_plan_smoke}"
+JAX_PLATFORMS=cpu python scripts/plan_smoke.py --out-dir "$OUT_DIR"

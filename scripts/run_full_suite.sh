@@ -87,6 +87,17 @@ bash scripts/arena_smoke.sh "$MONITOR_DIR/arena_smoke"
 arn=$?
 [ $arn -ne 0 ] && rc=$((rc == 0 ? arn : rc))
 
+# planner gate: MegatronConfig(mesh_plan=MEGATRON_RULES) reproduces the
+# hand dp/tp layout bit-identically, fit(mesh_plan=) mints zero extra
+# executables, the advisor table is non-empty + rank-stable, and its
+# predicted-fastest layout is the measured-fastest in the dp8-vs-dp2tp4
+# A/B on 8 virtual devices
+echo ""
+echo "-- plan smoke gate --"
+bash scripts/plan_smoke.sh "$MONITOR_DIR/plan_smoke"
+pln=$?
+[ $pln -ne 0 ] && rc=$((rc == 0 ? pln : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

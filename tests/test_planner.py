@@ -1,0 +1,227 @@
+"""Profile-guided auto-sharding planner (parallel/planner.py).
+
+Covers the PR 11 satellite checklist: regex precedence (first match
+wins), unmatched-leaf default, mesh-axis validation errors, lists-form
+round-trip for every spec the planner can emit — plus the tentpole
+gates that are cheap enough for tier-1: MEGATRON_RULES bit-identity
+with the hand specs, plan_key stability, degradation accounting, the
+flat-arena fallback warning, advisor determinism, and the arena
+layout-contract raise.
+"""
+import re
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import monitor, nn, optimizer as opt
+from paddle_tpu.parallel import layout, planner
+from paddle_tpu.parallel import megatron as M
+
+
+def _mesh(shape, axes):
+    devs = np.asarray(jax.devices()[: int(np.prod(shape))])
+    return Mesh(devs.reshape(shape), axes)
+
+
+# ---------------------------------------------------------------------------
+# rule matching
+
+def test_first_match_wins():
+    mesh = _mesh((2, 2), ("dp", "tp"))
+    p = planner.MeshPlan(
+        ((r"fc", P(None, "tp")),       # earlier, broader
+         (r"fc1\.weight$", P("tp", None))),  # never reached
+        mesh=mesh)
+    assert p.match("block.fc1.weight") == P(None, "tp")
+    # order flipped: the specific rule now wins
+    p2 = planner.MeshPlan(
+        ((r"fc1\.weight$", P("tp", None)),
+         (r"fc", P(None, "tp"))),
+        mesh=mesh)
+    assert p2.match("block.fc1.weight") == P("tp", None)
+    assert p2.match("block.fc2.weight") == P(None, "tp")
+
+
+def test_unmatched_leaf_gets_default():
+    mesh = _mesh((2, 2), ("dp", "tp"))
+    p = planner.MeshPlan(((r"^qkv", P(None, "tp")),), mesh=mesh)
+    assert p.match("layernorm.weight") == P()           # replicated default
+    assert p.spec_for("layernorm.weight", (8, 8)) == P()
+    pd = planner.MeshPlan(((r"^qkv", P(None, "tp")),), mesh=mesh,
+                          default=P("dp"))
+    assert pd.match("other") == P("dp")
+    # scalars are always replicated, rules notwithstanding
+    assert p.spec_for("qkv_scale", ()) == P()
+
+
+def test_axis_validation_raises():
+    dp_only = _mesh((4,), ("dp",))
+    with pytest.raises(ValueError, match="axis 'tp'"):
+        planner.MeshPlan(((r"w", P(None, "tp")),), mesh=dp_only)
+    with pytest.raises(ValueError, match="data axis"):
+        planner.MeshPlan((), mesh=dp_only, data_axes=("dp", "sp"))
+    with pytest.raises(ValueError):
+        planner.MeshPlan((), mesh=dp_only, default=P("tp"))
+
+
+def test_spec_round_trip_every_emittable_spec():
+    """spec_to_lists/spec_from_lists is lossless on everything the
+    canonical rule tables (and the default) can emit."""
+    specs = ([s for _, s in planner.MEGATRON_RULES]
+             + [s for _, s in planner.TRANSFORMER_RULES]
+             + [P(), P("dp"), P(("dp", "tp"), None)])
+    for spec in specs:
+        nd = max(len(tuple(spec)), 1)
+        lists = layout.spec_to_lists(spec, nd)
+        back = layout.spec_from_lists(lists)
+        assert layout.spec_to_lists(back, nd) == lists, spec
+
+
+# ---------------------------------------------------------------------------
+# tentpole: MEGATRON_RULES reproduce the hand layout
+
+def test_megatron_rules_match_hand_specs():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh, _ = M.make_mesh(8, sizes={"dp": 2, "tp": 2, "pp": 2})
+    cfg = M.MegatronConfig(vocab_size=64, hidden=32, n_heads=2,
+                           layers_per_stage=1, seq_len=16, microbatch=2,
+                           n_micro=2)
+    params, hand = M.init_params(cfg, mesh)
+    plan = planner.MeshPlan(planner.MEGATRON_RULES, mesh=mesh)
+    for name, value in params.items():
+        nd = np.asarray(jax.device_get(value)).ndim
+        want = layout.spec_to_lists(hand[name], nd)
+        got = layout.spec_to_lists(plan.spec_for(name, np.shape(value)), nd)
+        assert got == want, (name, got, want)
+    assert plan.degraded == {}
+
+
+def test_plan_key_stable_and_changes():
+    mesh = _mesh((2, 2), ("dp", "tp"))
+    a = planner.MeshPlan(planner.TRANSFORMER_RULES, mesh=mesh)
+    b = planner.MeshPlan(planner.TRANSFORMER_RULES, mesh=mesh)
+    assert a.plan_key() == b.plan_key()
+    assert a.signature() == b.signature()
+    c = planner.MeshPlan(planner.TRANSFORMER_RULES[:1], mesh=mesh)
+    assert c.plan_key() != a.plan_key()
+    d = planner.MeshPlan(planner.TRANSFORMER_RULES,
+                         mesh=_mesh((2, 2), ("tp", "dp")))
+    assert d.plan_key() != a.plan_key()  # axis order is part of the key
+
+
+# ---------------------------------------------------------------------------
+# degradation accounting (satellite: layout.adapt_spec)
+
+def test_degradation_warns_once_and_counts():
+    mesh = _mesh((2, 2), ("dp", "tp"))
+    before = monitor.registry().value("layout.degraded", 0)
+    name = "degrade_probe_%d" % np.random.randint(1 << 30)
+    p = planner.MeshPlan(((re.escape(name), P(None, "tp")),), mesh=mesh)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        spec = p.spec_for(name, (4, 7))   # 7 % 2 != 0 -> replicated
+        p.spec_for(name, (4, 7))          # second call: counted, no warn
+    assert spec == P()
+    assert p.degraded.get(name) == 28
+    msgs = [str(x.message) for x in w if "degraded" in str(x.message)]
+    assert len(msgs) == 1 and name in msgs[0] and "dim 1" in msgs[0]
+    after = monitor.registry().value("layout.degraded", 0)
+    assert after - before == 2
+
+
+# ---------------------------------------------------------------------------
+# flat-arena fallback (satellite: megatron)
+
+def test_flat_fallback_warns_once_per_config_and_counts():
+    cfg = M.MegatronConfig(vocab_size=64, hidden=32, n_heads=2,
+                           flat_arena=True,
+                           seq_len=16, microbatch=1, n_micro=1)
+    M._flat_fallback_warned.discard(repr(cfg))
+    before = monitor.registry().value("arena.flat_fallback", 0)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        M._warn_flat_fallback(cfg)
+        M._warn_flat_fallback(cfg)
+    msgs = [x for x in w if "flat_arena" in str(x.message)]
+    assert len(msgs) == 1           # once per config...
+    after = monitor.registry().value("arena.flat_fallback", 0)
+    assert after - before == 2      # ...but every occurrence is counted
+
+
+# ---------------------------------------------------------------------------
+# advisor
+
+def test_advise_ranked_and_deterministic():
+    cfg = M.MegatronConfig(vocab_size=64, hidden=32, n_heads=4,
+                           layers_per_stage=1, seq_len=16, microbatch=2,
+                           n_micro=1, use_moe=False)
+    t1 = planner.advise(n_devices=8, cfg=cfg)
+    t2 = planner.advise(n_devices=8, cfg=cfg)
+    assert len(t1) >= 2
+    assert [r["sizes"] for r in t1] == [r["sizes"] for r in t2]
+    assert [r["rank"] for r in t1] == list(range(1, len(t1) + 1))
+    preds = [r["pred_step_s"] for r in t1]
+    assert preds == sorted(preds)
+    for row in t1:
+        assert row["pred_step_s"] > 0
+        assert row["bound"] in ("compute", "memory", "comm")
+
+
+def test_candidate_sizes_complete_factorizations():
+    cands = planner.candidate_sizes(8, axes=("dp", "tp"))
+    as_tuples = {(c["dp"], c["tp"]) for c in cands}
+    assert as_tuples == {(8, 1), (4, 2), (2, 4), (1, 8)}
+    for c in cands:
+        assert c["dp"] * c["tp"] == 8
+
+
+# ---------------------------------------------------------------------------
+# arena layout contract
+
+def test_arena_bucket_bounds_rejects_sharding_plan():
+    from paddle_tpu.optimizer.arena import ParamArena
+    pt.seed(0)
+    m = nn.Linear(8, 8)
+    arena = ParamArena(list(m.parameters()))
+    mesh = _mesh((2, 2), ("dp", "tp"))
+    sharding = planner.MeshPlan(((r"param", P(None, "tp")),), mesh=mesh)
+    with pytest.raises(ValueError, match="mesh_plan shards arena member"):
+        arena.bucket_bounds(plan=sharding)
+    benign = planner.MeshPlan((), mesh=mesh)
+    assert arena.bucket_bounds(plan=benign)  # replicated plan passes
+
+
+# ---------------------------------------------------------------------------
+# plan()/resolve() surface
+
+def test_resolve_accepts_rules_plans_and_none():
+    mesh = _mesh((2, 2), ("dp", "tp"))
+    assert planner.resolve(None) is None
+    p = planner.MeshPlan((), mesh=mesh)
+    assert planner.resolve(p) is p
+    r = planner.resolve(((r"w", P(None, "tp")),), mesh=mesh)
+    assert isinstance(r, planner.MeshPlan)
+    assert r.match("w") == P(None, "tp")
+
+
+def test_plan_auto_records_decision():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = M.MegatronConfig(vocab_size=64, hidden=32, n_heads=4,
+                           layers_per_stage=1, seq_len=16, microbatch=2,
+                           n_micro=1, use_moe=False)
+    p = planner.plan(auto=True, cfg=cfg, n_devices=8)
+    assert p.advice and p.advice[0]["rank"] == 1
+    dec = planner.last_decision()
+    assert dec is not None and dec["auto"]
+    assert dec["chosen"] == p.advice[0]["sizes"]
+    assert dec["candidates"] == len(p.advice)
+    assert monitor.registry().value("planner.plan", 0) >= 1
+    assert monitor.registry().value("planner.auto_pick", 0) >= 1
+    assert monitor.registry().value("planner.candidates", 0) == len(p.advice)
