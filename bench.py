@@ -518,6 +518,36 @@ def bench_hotspot(label=None, top_k=5):
     }
 
 
+def bench_memory(label=None, top_k=5):
+    """Memory stage: run the buffer-liveness model (monitor.memory)
+    over the newest captured step executable and bank the predicted
+    HBM peak next to XLA's own memory_analysis() peak and the live
+    device watermark — which class (param / activation / opt_state /
+    temp) owns the peak, at what attributed fraction. The sentinel
+    bands the reconciliation tight (the model must keep agreeing with
+    the compiler) and the absolute peaks wide (they move with every
+    legitimate model-size change)."""
+    from paddle_tpu import monitor
+    rep = monitor.memory.report(label=label, top_k=top_k,
+                                emit_records=False)
+    if rep is None:
+        return None
+    recon = rep.get("reconciliation")
+    top = rep["contributors"][0] if rep["contributors"] else None
+    return {
+        "memory_predicted_peak_bytes": rep["predicted_peak_bytes"],
+        "memory_xla_peak_bytes": rep["xla_peak_bytes"],
+        "memory_reconciliation": round(recon, 4) if recon else None,
+        "memory_attributed_frac": round(rep["attributed_frac"], 4),
+        "memory_measured_peak_bytes": rep["measured_peak_bytes"],
+        "memory_by_class": rep["by_class"],
+        "memory_top_contributor": (
+            {"class": top["class"], "region": top["region"],
+             "bytes": top["bytes"]} if top else None),
+        "memory_n_donated": rep["n_donated"],
+    }
+
+
 _RESULTS = {}  # metrics banked as each stage finishes (partial-credit)
 
 
@@ -822,6 +852,18 @@ def main():
                   f"attributed={hs['hotspot_attributed_frac']}",
                   flush=True)
             _RESULTS.update(hs)
+    try:
+        mm = bench_memory()  # same capture the hotspot stage read
+    except Exception as e:
+        print(f"memory stage failed: {type(e).__name__}: {e}",
+              flush=True)
+    else:
+        if mm:
+            print(f"partial memory_reconciliation="
+                  f"{mm['memory_reconciliation']} "
+                  f"attributed={mm['memory_attributed_frac']}",
+                  flush=True)
+            _RESULTS.update(mm)
     rn_ips, rn_loss = bench_resnet(measured_key="resnet50_mfu_measured")
     _record_stage_compiles("resnet50")
     print(f"partial resnet_images_per_sec={rn_ips:.1f}", flush=True)

@@ -398,11 +398,16 @@ class Model(Layer):
                 cblist.call("on_epoch_end", epoch, logs)
                 if self.stop_training:
                     break
-        except BaseException:
+        except BaseException as e:
             # unhandled crash in the train loop: leave a flight-recorder
-            # dump (last spans + counters + active HLO) then re-raise
+            # dump (last spans + counters + active HLO) then re-raise.
+            # OOM-shaped errors route through the memory postmortem so
+            # the bundle includes the ranked contributor ledger.
             if _monitor.enabled():
-                _monitor.trace.flight_record("fit_crash", step=global_step)
+                if not _monitor.memory.handle_oom(e, where="fit",
+                                                  step=global_step):
+                    _monitor.trace.flight_record("fit_crash",
+                                                 step=global_step)
             raise
         finally:
             if wd is not None:

@@ -128,6 +128,19 @@ Span tracing & XLA-measured cost (PR 4's additions):
   plus ``xla_cost`` JSONL records; feeds the measured-MFU columns in
   StepMonitor and bench.py.
 
+Memory-observability series (docs/observability.md "Memory
+attribution & budget"; ``paddle_tpu.monitor.memory``):
+
+* ``memory.predicted_peak_bytes.<label>`` /
+  ``memory.attributed_frac.<label>`` — the HLO buffer-liveness
+  model's simulated peak HBM and the fraction of live-at-peak bytes
+  credited to a registered framework scope (``memory.report()``)
+* ``mem.device.<id>.hbm_headroom_bytes`` / ``mem.hbm_headroom_bytes``
+  — sampler-published per-device and total headroom (limit − in-use)
+* ``memory.oom`` — OOM-shaped crashes the Executor/``hapi.fit``
+  handlers caught; each leaves a flight-recorder dump bundling the
+  memory report + peak-contributor ledger next to the op ledger
+
 Everything funnels into one process-global :class:`Registry` and,
 when a sink is configured (``PADDLE_TPU_MONITOR_DIR`` or an explicit
 path to ``enable()``), a JSONL event stream.
@@ -165,7 +178,7 @@ __all__ = [
     "record_collective", "StepMonitor", "mfu", "peak_flops_for_device",
     "transformer_train_flops_per_token", "device_memory_stats",
     "read_jsonl", "trace", "xla", "serve", "export", "sampler",
-    "profile",
+    "profile", "memory",
 ]
 
 _registry = Registry()
@@ -338,4 +351,4 @@ def record_collective(op, axis_name, nbytes):
 
 # imported last: the submodules reach back into this namespace
 # (gauge/emit/snapshot), which is fully populated by this point
-from . import trace, xla, export, sampler, profile  # noqa: E402,F401
+from . import trace, xla, export, sampler, profile, memory  # noqa: E402,F401

@@ -144,6 +144,7 @@ def snapshot_payload():
     including the newest xla_cost capture and the last profiled hotspot
     summary, so one scrape is enough to triage a slow step."""
     from .. import monitor as _mon
+    from . import memory as _memory
     from . import profile as _profile
     from . import trace as _trace
     from . import xla as _xla
@@ -161,6 +162,17 @@ def snapshot_payload():
         planner_block = _planner.last_decision()
     except Exception:
         planner_block = None
+    # the memory block: predicted vs measured peak, top contributors,
+    # and the last OOM flight pointer — the pre-flight budget + the
+    # postmortem, one scrape apart
+    memory_block = None
+    try:
+        summary = _memory.last_summary(top_k=3)
+        oom = _memory.last_oom()
+        if summary is not None or oom is not None:
+            memory_block = {"report": summary, "last_oom": oom}
+    except Exception:
+        memory_block = None
     return {
         "ts": time.time(),
         "pid": os.getpid(),
@@ -169,6 +181,7 @@ def snapshot_payload():
         "flight_dir": _trace.last_flight(),
         "xla_cost": xla_cost,
         "hotspots": _profile.last_summary(),
+        "memory": memory_block,
         "planner": planner_block,
         "counters": _mon.snapshot(),
     }

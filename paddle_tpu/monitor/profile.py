@@ -211,7 +211,7 @@ _DTYPE_BYTES = {
 _TYPE_RE = re.compile(
     r"(pred|bf16|f16|f32|f64|f8\w+|s4|s8|s16|s32|s64|u4|u8|u16|u32|u64"
     r"|c64|c128)\[([0-9,]*)\]")
-_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+) = (.*)$")
+_INSTR_RE = re.compile(r"^\s*(ROOT\s+)?%?([\w.\-]+) = (.*)$")
 _COMP_RE = re.compile(r"^\s*(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\{\s*$")
 _OPNAME_RE = re.compile(r'op_name="([^"]*)"')
 _REF_RE = {
@@ -318,7 +318,7 @@ def _parse_instr(line):
     m = _INSTR_RE.match(line)
     if m is None:
         return None
-    name, rest = m.group(1), m.group(2)
+    root, name, rest = bool(m.group(1)), m.group(2), m.group(3)
     # output type: tuple '(...)' or a single token up to the next space
     if rest.startswith("("):
         end = _balanced(rest, 0)
@@ -340,7 +340,7 @@ def _parse_instr(line):
     return {
         "name": name, "opcode": opcode, "out_type": out_type,
         "operands": _split_top(operands), "attrs": attrs,
-        "op_name": nm.group(1) if nm else "",
+        "op_name": nm.group(1) if nm else "", "root": root,
     }
 
 

@@ -8,11 +8,16 @@ drop to zero or did the gauge just go stale?" — are about state, not
 events, and state must be *sampled*. This daemon publishes, every
 ``interval_s`` (default 1s):
 
-* ``mem.device.<id>.{bytes_in_use,peak_bytes_in_use,bytes_limit}`` and
-  the cross-device totals ``mem.hbm_bytes_in_use`` /
-  ``mem.hbm_peak_bytes_in_use`` — the HBM watermarks, via
-  ``step.device_memory_stats()`` (empty per-device dicts on backends
-  that expose nothing, e.g. CPU)
+* ``mem.device.<id>.{bytes_in_use,peak_bytes_in_use,bytes_limit,
+  hbm_headroom_bytes}`` and the cross-device totals
+  ``mem.hbm_bytes_in_use`` / ``mem.hbm_peak_bytes_in_use`` /
+  ``mem.hbm_headroom_bytes`` (limit − in-use, the number an operator
+  actually watches), via ``step.device_memory_stats()``. A backend
+  that exposes nothing (e.g. CPU) contributes NO ``mem.device.*``
+  gauges at all — empty dicts stay out of the registry. When span
+  tracing is live, each tick also drops one ``hbm.bytes_in_use``
+  Chrome counter ("C") sample so Perfetto shows the measured
+  occupancy under the span timeline.
 * ``mem.host.rss_bytes`` — resident set size of this process
   (/proc/self/status VmRSS, falling back to getrusage peak)
 * registered queue-depth providers — ``prefetch.queue_depth`` (each
@@ -124,9 +129,11 @@ def sample_once(registry=None):
     reg = registry if registry is not None else _mon.registry()
 
     mem = device_memory_stats()
-    total_use = total_peak = 0
-    have_hbm = False
+    total_use = total_peak = total_headroom = 0
+    have_hbm = have_headroom = False
     for did, stats in mem.items():
+        if not stats:
+            continue  # an all-empty dict (CPU) must not mint gauges
         for key, value in stats.items():
             reg.gauge(f"mem.device.{did}.{key}").set(value)
         if "bytes_in_use" in stats:
@@ -134,9 +141,21 @@ def sample_once(registry=None):
             total_use += stats["bytes_in_use"]
             total_peak += stats.get("peak_bytes_in_use",
                                     stats["bytes_in_use"])
+            if "bytes_limit" in stats:
+                have_headroom = True
+                headroom = stats["bytes_limit"] - stats["bytes_in_use"]
+                total_headroom += headroom
+                reg.gauge(
+                    f"mem.device.{did}.hbm_headroom_bytes").set(headroom)
     if have_hbm:
         reg.gauge("mem.hbm_bytes_in_use").set(total_use)
         reg.gauge("mem.hbm_peak_bytes_in_use").set(total_peak)
+        if have_headroom:
+            reg.gauge("mem.hbm_headroom_bytes").set(total_headroom)
+        # live HBM occupancy as a counter track under the span timeline
+        from . import trace as _trace
+        if _trace.enabled():
+            _trace.counter("hbm.bytes_in_use", bytes=total_use)
 
     rss = _host_rss_bytes()
     if rss is not None:

@@ -142,8 +142,13 @@ def device_memory_stats():
         if not entry and not _mem_stats_warned:
             _mem_stats_warned = True
             import warnings
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = "?"
             warnings.warn(
-                f"device_memory_stats: device {d} "
+                f"device_memory_stats: backend '{backend}' platform "
+                f"'{getattr(d, 'platform', '?')}' device {d} "
                 f"({getattr(d, 'device_kind', '?')}) exposes no memory "
                 "stats (expected on CPU backends); its entries will be "
                 "empty dicts")
@@ -190,6 +195,7 @@ class StepMonitor:
         self.records = []
         self._last = None
         self._divergence_warned = False
+        self._mem_peaks = {}     # device id -> last seen peak watermark
 
     def __enter__(self):
         self.start()
@@ -258,6 +264,20 @@ class StepMonitor:
             mem = device_memory_stats()
             if any(mem.values()):  # all-empty dicts (CPU) stay out
                 rec["device_memory"] = mem
+                # the delta since the last sampled step is the signal
+                # (a watermark that keeps climbing is a leak; a raw
+                # snapshot alone can't show that)
+                deltas = {}
+                for did, stats in mem.items():
+                    peak = stats.get("peak_bytes_in_use")
+                    if peak is None:
+                        continue
+                    prev = self._mem_peaks.get(did)
+                    if prev is not None:
+                        deltas[did] = peak - prev
+                    self._mem_peaks[did] = peak
+                if deltas:
+                    rec["device_memory_peak_delta"] = deltas
         self.records.append(rec)
         if enabled():
             gauge(f"step.{self.label}.time_s").set(dt)

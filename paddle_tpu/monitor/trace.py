@@ -57,7 +57,7 @@ import time
 
 __all__ = [
     "enable", "disable", "enabled", "clear", "span", "complete",
-    "instant", "traced", "events", "export_chrome_trace",
+    "instant", "counter", "traced", "events", "export_chrome_trace",
     "flight_record", "last_flight",
 ]
 
@@ -217,6 +217,27 @@ def instant(name, **args):
     _events.append(("I", name, tid, _CLOCK(), args or None))
 
 
+def counter(name, values=None, ts=None, **kw):
+    """A Chrome counter ("C") sample: ``values`` (dict) and/or keyword
+    series render as a stacked counter track in Perfetto —
+    ``trace.counter("hbm", bytes_in_use=x)``. ``ts=`` back/forward
+    dates the sample on the perf_counter timeline (memory.report uses
+    it to lay the predicted-occupancy curve out as one synthetic
+    microsecond per schedule slot). Disabled mode is one flag check."""
+    if not _active:
+        return
+    vals = dict(values) if values else {}
+    if kw:
+        vals.update(kw)
+    if not vals:
+        return
+    tid = threading.get_ident()
+    if tid not in _thread_names:
+        _note_thread(tid)
+    _events.append(("C", name, tid, _CLOCK() if ts is None else ts,
+                    vals))
+
+
 def traced(name=None):
     """Decorator form: ``@trace.traced`` or ``@trace.traced("label")``.
     Disabled mode adds one flag check per call."""
@@ -283,6 +304,10 @@ def export_chrome_trace(path=None, last=None):
             rec = {"ph": "X", "pid": pid, "tid": tid, "name": name,
                    "ts": _us(t), "dur": round(max(0.0, dur) * 1e6, 3),
                    "cat": "op"}
+        elif kind == "C":
+            _, name, tid, t, args = ev
+            rec = {"ph": "C", "pid": pid, "tid": tid, "name": name,
+                   "ts": _us(t), "cat": "counter"}
         else:
             _, name, tid, t, args = ev
             rec = {"ph": "i", "pid": pid, "tid": tid, "name": name,
@@ -322,6 +347,8 @@ def flight_record(reason, step=None, directory=None, extra=None):
             counters.json   full registry snapshot
             trace.json      the span ring buffer as a Chrome trace
             hlo-<label>.txt HLO of the last captured executable (if any)
+            op_ledger.json  monitor.profile per-op cost ledger (if any)
+            memory_report.json  monitor.memory peak-contributor ledger
 
     ``base`` is ``directory=``, else $PADDLE_TPU_FLIGHT_DIR, else a
     ``flight/`` sibling of the monitor JSONL sink, else the system temp
@@ -392,6 +419,21 @@ def flight_record(reason, step=None, directory=None, extra=None):
                 with open(os.path.join(d, "op_ledger.json"), "w",
                           encoding="utf-8") as fh:
                     json.dump(ledger, fh, default=str, indent=1)
+        except Exception:
+            pass
+
+        # the memory report + peak-contributor ledger next to the op
+        # ledger (an OOM postmortem is exactly this pair): cached if
+        # one exists, else a fresh simulation of the same executable
+        try:
+            from . import memory as _memory
+            mrep = _memory.last_report()
+            if mrep is None:
+                mrep = _memory.report(emit_records=False)
+            if mrep:
+                with open(os.path.join(d, "memory_report.json"), "w",
+                          encoding="utf-8") as fh:
+                    json.dump(mrep, fh, default=str, indent=1)
         except Exception:
             pass
 

@@ -509,8 +509,19 @@ def megatron_candidate_stats(cfg, sizes, global_batch=None):
         comm.append(("sp", 4.0 * L * tokens_dev * h * 4.0
                      / max(heads_split, 1), sp))
     degraded = (heads_split == 1 and tp > 1) or (ffn_split == 1 and tp > 1)
+
+    # predicted peak HBM residency per device (the pre-flight budget):
+    # training state = params + grads + 2 Adam slots (4× param bytes),
+    # plus the backward's saved activations (residual + ffn streams per
+    # block) and the replicated logits buffer — f32 throughout. An
+    # ordering model, same honesty contract as the flops/bytes halves.
+    state_elems = 4.0 * param_local
+    act_elems = L * tokens_dev * (2.0 * h + ffn / ffn_split)
+    logits_elems = tokens_dev * V
+    peak_hbm = 4.0 * (state_elems + act_elems + logits_elems)
     return {"flops": float(flops), "hbm_bytes": hbm, "comm": comm,
-            "degraded_frac": 1.0 if degraded else 0.0}
+            "degraded_frac": 1.0 if degraded else 0.0,
+            "peak_hbm_bytes": float(peak_hbm)}
 
 
 def stats_from_profile(sizes, report=None, param_elems=0,
@@ -542,17 +553,42 @@ def stats_from_profile(sizes, report=None, param_elems=0,
                      float(wire_bytes(int(param_elems // model_split),
                                       grad_mode, bits=grad_bits,
                                       n_ranks=dp)), dp))
+    # peak residency from the measured liveness model when one exists:
+    # state bytes (params/opt slots) divide over the model axes, the
+    # activation/temp working set over the data axes
+    peak_hbm = None
+    try:
+        from ..monitor import memory as _mem
+        mrep = _mem.last_report()
+        if mrep:
+            bc = mrep.get("by_class", {})
+            state = float(bc.get("param", 0) + bc.get("opt_state", 0))
+            work = float(bc.get("activation", 0) + bc.get("temp", 0))
+            peak_hbm = state / model_split + work / max(dp, 1)
+    except Exception:
+        peak_hbm = None
     return {"flops": flops / n, "hbm_bytes": nbytes / n, "comm": comm,
-            "degraded_frac": 0.0}
+            "degraded_frac": 0.0, "peak_hbm_bytes": peak_hbm}
 
 
 def advise(n_devices=None, cfg=None, candidates=None, axes=("dp", "tp"),
            global_batch=None, report=None, param_elems=0,
-           ceilings=None, link_gbps=None, timeshared=None):
+           ceilings=None, link_gbps=None, timeshared=None,
+           hbm_limit=None):
     """Ranked layout table, best first. Each row:
     ``{rank, sizes, pred_step_s, compute_s, hbm_s, comm_s, bound,
-    degraded_frac}``. Deterministic: ties break on degradation then on
-    the sizes dict, so repeated calls are rank-stable.
+    degraded_frac, peak_hbm_bytes, feasible}``. Deterministic: ties
+    break on degradation then on the sizes dict, so repeated calls are
+    rank-stable.
+
+    The pre-flight HBM budget (ROADMAP item 4): each candidate carries
+    its predicted per-device peak residency, and a candidate whose peak
+    exceeds ``hbm_limit`` (default: ``monitor.memory.device_hbm_limit()``
+    — env override, live ``bytes_limit``, or the device-kind capacity
+    table) is marked ``feasible: False`` and ranked BELOW every feasible
+    layout regardless of its predicted step time — a layout that OOMs
+    has no step time. With no limit (CPU, unknown device) and no
+    override, everything stays feasible: no invented verdicts.
 
     ``timeshared`` (default: auto-true on CPU): the "devices" are
     virtual shards of one host, so per-device work does NOT run
@@ -579,6 +615,12 @@ def advise(n_devices=None, cfg=None, candidates=None, axes=("dp", "tp"),
         ceilings = {"peak_flops": gf * 1e9,
                     "hbm_bytes_per_sec": 2.0 * gf * 1e9,
                     "device_kind": "timeshared-host", "assumed": True}
+    if hbm_limit is None:
+        try:
+            from ..monitor import memory as _mem
+            hbm_limit = _mem.device_hbm_limit()
+        except Exception:
+            hbm_limit = None
     rows = []
     for sizes in candidates:
         if cfg is not None:
@@ -594,8 +636,16 @@ def advise(n_devices=None, cfg=None, candidates=None, axes=("dp", "tp"),
         row = score(stats, ceilings=ceilings, link_gbps=link_gbps)
         row["sizes"] = dict(sizes)
         row["degraded_frac"] = float(stats.get("degraded_frac", 0.0))
+        peak = stats.get("peak_hbm_bytes")
+        row["peak_hbm_bytes"] = (float(peak) if peak is not None
+                                 else None)
+        row["hbm_limit_bytes"] = hbm_limit
+        row["feasible"] = not (hbm_limit is not None
+                               and peak is not None
+                               and peak > hbm_limit)
         rows.append(row)
-    rows.sort(key=lambda r: (round(r["pred_step_s"], 15),
+    rows.sort(key=lambda r: (0 if r["feasible"] else 1,
+                             round(r["pred_step_s"], 15),
                              r["degraded_frac"],
                              json.dumps(r["sizes"], sort_keys=True)))
     for i, r in enumerate(rows):
@@ -645,14 +695,21 @@ def _record(p, table, auto):
         "chosen": dict(winner["sizes"]) if winner else dict(p.sizes),
         "predicted_step_s": (winner["pred_step_s"] if winner else None),
         "bound": winner["bound"] if winner else None,
+        "peak_hbm_bytes": (winner.get("peak_hbm_bytes")
+                           if winner else None),
+        "hbm_limit_bytes": (winner.get("hbm_limit_bytes")
+                            if winner else None),
+        "infeasible": sum(1 for r in (table or [])
+                          if not r.get("feasible", True)),
         "degraded": dict(p.degraded),
         # cross-link: the hotspot the profiler currently blames most —
         # grep the JSONL for this region to see what the layout choice
         # was reacting to
         "hotspot": hotspot,
-        "table": [{k: r[k] for k in
+        "table": [{k: r.get(k) for k in
                    ("rank", "sizes", "pred_step_s", "bound",
-                    "degraded_frac")} for r in (table or [])[:8]],
+                    "degraded_frac", "peak_hbm_bytes", "feasible")}
+                  for r in (table or [])[:8]],
     }
     _last_decision = decision
     if _monitor.enabled():
@@ -679,7 +736,16 @@ def plan(rules=None, mesh=None, auto=False, cfg=None, n_devices=None,
                        **advise_kw)
         if not table:
             raise ValueError("advisor produced no candidate layouts")
-        winner = table[0]["sizes"]
+        winner_row = next((r for r in table if r.get("feasible", True)),
+                          None)
+        if winner_row is None:
+            lim = table[0].get("hbm_limit_bytes")
+            raise ValueError(
+                "advisor: every candidate layout exceeds the device "
+                f"HBM budget ({lim and int(lim)} bytes) — shrink the "
+                "model/batch, add devices, or raise "
+                "PADDLE_TPU_HBM_LIMIT_BYTES")
+        winner = winner_row["sizes"]
         if mesh is None:
             if cfg is not None:
                 from .megatron import make_mesh as _mk

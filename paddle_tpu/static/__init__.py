@@ -417,11 +417,15 @@ class Executor:
                                   scope, bucket, buckets, pad_mode,
                                   async_fetch, fetch_period, nan_guard,
                                   mesh_plan)
-        except BaseException:
+        except BaseException as e:
             # unhandled crash: leave the flight-recorder artifact (last
-            # spans + counters + active HLO) before the stack unwinds
+            # spans + counters + active HLO) before the stack unwinds.
+            # RESOURCE_EXHAUSTED gets the richer OOM postmortem: the
+            # flight bundle then carries the ranked memory-contributor
+            # ledger alongside the op ledger.
             if _monitor.enabled():
-                _monitor.trace.flight_record("executor_crash")
+                if not _monitor.memory.handle_oom(e, where="executor.run"):
+                    _monitor.trace.flight_record("executor_crash")
             raise
 
     def _run_impl(self, program, feed, fetch_list, return_numpy, scope,

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# CI gate for memory observability: the 2-layer MLP + Adam to_static
+# step's simulated HBM peak must reconcile with memory_analysis()
+# within 10% and attribute >= 90% of live-at-peak bytes to named
+# scopes; an injected RESOURCE_EXHAUSTED in hapi.fit must leave an
+# `oom` flight bundle with op_ledger.json + memory_report.json; the
+# planner must mark over-budget layouts infeasible and never auto-pick
+# one; disabled mode must retain nothing. Tier-1-safe: tiny MLP, CPU,
+# seconds.
+#
+# Usage: scripts/mem_smoke.sh [out_dir]
+# The monitor JSONL lands in out_dir (default
+# /tmp/paddle_tpu_mem_smoke); the last stdout line is one JSON
+# result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_mem_smoke}"
+JAX_PLATFORMS=cpu python scripts/mem_smoke.py --out-dir "$OUT_DIR"

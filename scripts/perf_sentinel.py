@@ -122,6 +122,20 @@ METRICS = [
      ("planner_candidates",), "higher", 0.10),
     ("planner_predicted_step_s", ("planner_predicted_step_s",),
      ("planner_predicted_step_s",), "lower", 1.00),
+    # memory stage (bench_memory / mem_smoke): the liveness model's
+    # agreement with memory_analysis() and the attributed fraction are
+    # deterministic functions of the step HLO (tight bands — drift
+    # means the parser or the scope labels broke); the absolute peak
+    # moves with any legitimate model change (wide band)
+    ("memory_reconciliation",
+     ("memory_reconciliation",), ("memory_reconciliation",),
+     "higher", 0.10),
+    ("memory_attributed_frac",
+     ("memory_attributed_frac",), ("memory_attributed_frac",),
+     "higher", 0.10),
+    ("memory_predicted_peak_bytes",
+     ("memory_predicted_peak_bytes",), ("memory_predicted_peak_bytes",),
+     "lower", 0.50),
 ]
 
 

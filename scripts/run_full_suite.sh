@@ -98,6 +98,16 @@ bash scripts/plan_smoke.sh "$MONITOR_DIR/plan_smoke"
 pln=$?
 [ $pln -ne 0 ] && rc=$((rc == 0 ? pln : rc))
 
+# memory gate: the to_static step's simulated HBM peak must reconcile
+# with memory_analysis() within 10% and attribute >=90% of live-at-peak
+# bytes, an injected RESOURCE_EXHAUSTED must leave the full oom flight
+# bundle, and the planner must never auto-pick an over-budget layout
+echo ""
+echo "-- mem smoke gate --"
+bash scripts/mem_smoke.sh "$MONITOR_DIR/mem_smoke"
+mem=$?
+[ $mem -ne 0 ] && rc=$((rc == 0 ? mem : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

@@ -1,0 +1,449 @@
+"""paddle_tpu.monitor.memory — buffer liveness over scheduled HLO,
+peak-occupancy simulation + XLA reconciliation, per-scope contributor
+attribution, planner HBM feasibility, OOM forensics, and the
+zero-cost-when-disabled contract."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import jit, monitor, nn, optimizer as opt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.monitor import memory, profile, trace
+from paddle_tpu.monitor.registry import read_jsonl
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """memory + profile + monitor are process-global; start dark."""
+    for var in ("PADDLE_TPU_HBM_LIMIT_BYTES", "PADDLE_TPU_HBM_GB",
+                "PADDLE_TPU_PROFILE"):
+        monkeypatch.delenv(var, raising=False)
+    monitor.disable(flush_counters=False)
+    monitor.reset()
+    profile.disable()
+    profile.reset()
+    memory.reset()
+    trace.disable()
+    trace.clear()
+    # the flight recorder's rate cap is a process-global counter; restore
+    # it so the dumps these tests trigger don't starve later test files
+    flight_dumps = trace._flight_dumps
+    yield
+    trace._flight_dumps = flight_dumps
+    monitor.disable(flush_counters=False)
+    monitor.reset()
+    profile.disable()
+    profile.reset()
+    memory.reset()
+    trace.disable()
+    trace.clear()
+
+
+# -- synthetic HLO for the liveness units -------------------------------------
+
+# two temps with overlapping intervals feeding the root
+CHAIN_HLO = """\
+HloModule chain, is_scheduled=true
+
+ENTRY %main.1 (a: f32[4,8], b: f32[8,16]) -> f32[4,16] {
+  %a = f32[4,8]{1,0} parameter(0)
+  %b = f32[8,16]{1,0} parameter(1)
+  %dot.1 = f32[4,16]{1,0} dot(f32[4,8]{1,0} %a, f32[8,16]{1,0} %b), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(f)/jit(main)/root/L0/dot_general"}
+  %exp.1 = f32[4,16]{1,0} exponential(f32[4,16]{1,0} %dot.1), metadata={op_name="jit(f)/jit(main)/root/L0/exp"}
+  ROOT %add.1 = f32[4,16]{1,0} add(f32[4,16]{1,0} %dot.1, f32[4,16]{1,0} %exp.1), metadata={op_name="jit(f)/jit(main)/root/L0/add"}
+}
+"""
+
+# output 0 is written in place into donated parameter 0
+DONATED_HLO = """\
+HloModule donate, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias) }
+
+ENTRY %main.2 (p0: f32[8,8], p1: f32[8,8]) -> (f32[8,8], f32[8,8]) {
+  %p0 = f32[8,8]{1,0} parameter(0)
+  %p1 = f32[8,8]{1,0} parameter(1)
+  %add.1 = f32[8,8]{1,0} add(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p1)
+  %mul.1 = f32[8,8]{1,0} multiply(f32[8,8]{1,0} %p0, f32[8,8]{1,0} %p1)
+  ROOT %tuple.1 = (f32[8,8]{1,0}, f32[8,8]{1,0}) tuple(f32[8,8]{1,0} %add.1, f32[8,8]{1,0} %mul.1)
+}
+"""
+
+# the fusion body's %exp.1 is internal — only the fusion output is a buffer
+FUSED_HLO = """\
+HloModule fused, is_scheduled=true
+
+%fused_computation (p0: f32[4,8]) -> f32[4,8] {
+  %p0 = f32[4,8]{1,0} parameter(0)
+  %exp.1 = f32[4,8]{1,0} exponential(f32[4,8]{1,0} %p0)
+  ROOT %iadd.1 = f32[4,8]{1,0} add(f32[4,8]{1,0} %exp.1, f32[4,8]{1,0} %p0)
+}
+
+%region.1 (x: f32[], y: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  %y = f32[] parameter(1)
+  ROOT %radd.2 = f32[] add(f32[] %x, f32[] %y)
+}
+
+ENTRY %main.3 (a: f32[4,8]) -> f32[4] {
+  %a = f32[4,8]{1,0} parameter(0)
+  %fus = f32[4,8]{1,0} fusion(f32[4,8]{1,0} %a), kind=kLoop, calls=%fused_computation
+  %c0 = f32[] constant(0)
+  ROOT %reduce.1 = f32[4]{0} reduce(f32[4,8]{1,0} %fus, f32[] %c0), dimensions={1}, to_apply=%region.1
+}
+"""
+
+# params labeled the way jit.to_static labels them; one consumed only
+# by the optimizer scope, one a data array, one a weight
+CLASS_HLO = """\
+HloModule klass, is_scheduled=true
+
+ENTRY %main.4 (w: f32[8,8], x: f32[8,8], m: f32[8,8]) -> f32[8,8] {
+  %w = f32[8,8]{1,0} parameter(0), metadata={op_name="state_vals[0]"}
+  %x = f32[8,8]{1,0} parameter(1), metadata={op_name="arrays[0]"}
+  %m = f32[8,8]{1,0} parameter(2), metadata={op_name="state_vals[1]"}
+  %dot.1 = f32[8,8]{1,0} dot(f32[8,8]{1,0} %w, f32[8,8]{1,0} %x), lhs_contracting_dims={1}, rhs_contracting_dims={0}, metadata={op_name="jit(f)/jit(main)/root/L0/dot_general"}
+  %madd.1 = f32[8,8]{1,0} add(f32[8,8]{1,0} %m, f32[8,8]{1,0} %m), metadata={op_name="jit(f)/jit(main)/root/opt.Adam/add"}
+  ROOT %mul.1 = f32[8,8]{1,0} multiply(f32[8,8]{1,0} %dot.1, f32[8,8]{1,0} %madd.1), metadata={op_name="jit(f)/jit(main)/root/opt.Adam/mul"}
+}
+"""
+
+SCOPES = {"root": "root", "L0": "layer", "opt.Adam": "optimizer"}
+
+
+# -- liveness units -----------------------------------------------------------
+
+def test_intervals_overlap_at_peak():
+    live = memory.liveness(CHAIN_HLO, scope_map=SCOPES)
+    b = live["buffers"]
+    # params resident the whole schedule
+    assert b["a"]["def_idx"] == 0 and b["a"]["last_use"] == 4
+    assert b["a"]["space"] == "argument"
+    # dot.1 defined at slot 2, kept alive through the root's read
+    assert (b["dot.1"]["def_idx"], b["dot.1"]["last_use"]) == (2, 4)
+    assert (b["exp.1"]["def_idx"], b["exp.1"]["last_use"]) == (3, 4)
+    # root output lives to the end
+    assert b["add.1"]["space"] == "output"
+    sim = memory.simulate(CHAIN_HLO, scope_map=SCOPES)
+    # peak: both params + dot + exp + out all live at the last slot
+    args = 4 * (4 * 8 + 8 * 16)
+    assert sim["argument_bytes"] == args
+    assert sim["predicted_peak_bytes"] == args + 3 * (4 * 4 * 16)
+    assert sim["peak_index"] == 4
+    assert sim["curve"][0] == args          # only params before slot 2
+    assert sim["attributed_frac"] == 1.0    # everything reaches L0
+
+
+def test_donated_output_contributes_no_bytes():
+    assert memory.parse_io_alias(DONATED_HLO) == {0: 0}
+    sim = memory.simulate(DONATED_HLO)
+    b = memory.liveness(DONATED_HLO)["buffers"]
+    assert b["add.1"]["donated"] and not b["mul.1"]["donated"]
+    assert b["mul.1"]["space"] == "output"
+    assert sim["n_donated"] == 1
+    assert sim["donated_bytes"] == 256      # f32[8,8] counted once
+    # peak = two 256B params + the one non-donated output
+    assert sim["predicted_peak_bytes"] == 2 * 256 + 256
+    assert sim["output_bytes"] == 256
+
+
+def test_fusion_internal_temps_excluded():
+    live = memory.liveness(FUSED_HLO)
+    b = live["buffers"]
+    # the fusion body's exp never allocates at top level; the constant
+    # and the ROOT reduce's to_apply body don't either
+    assert "exp.1" not in b and "iadd.1" not in b and "radd.2" not in b
+    assert "c0" not in b
+    assert set(b) == {"a", "fus", "reduce.1"}
+    assert live["schedule_len"] == 4
+    sim = memory.simulate(FUSED_HLO)
+    # peak: param 128 + fusion out 128 + reduce out 16
+    assert sim["predicted_peak_bytes"] == 128 + 128 + 16
+
+
+def test_contributor_classification():
+    sim = memory.simulate(CLASS_HLO, scope_map=SCOPES)
+    k = {c["name"]: c["class"] for c in sim["contributors"]}
+    assert k["w"] == "param"            # weight read by a layer
+    assert k["x"] == "activation"       # arrays[...] data input
+    assert k["m"] == "opt_state"        # consumed only by opt.Adam
+    assert k["dot.1"] == "activation"   # layer-scope intermediate
+    assert k["madd.1"] == "opt_state"   # optimizer-scope intermediate
+    by = sim["by_class"]
+    # w | x + dot.1 | m + madd.1 + the opt-scoped root output mul.1
+    assert by["param"] == 256 and by["opt_state"] == 3 * 256
+    assert sim["attributed_frac"] == 1.0
+    # ledger is ranked, largest first, ranks dense from 1
+    ranks = [c["rank"] for c in sim["contributors"]]
+    assert ranks == list(range(1, len(ranks) + 1))
+    sizes = [c["bytes"] for c in sim["contributors"]]
+    assert sizes == sorted(sizes, reverse=True)
+
+
+def test_curve_counter_events_decimate_and_preserve_peak():
+    sim = memory.simulate(CHAIN_HLO, scope_map=SCOPES)
+    sim["label"] = "unit"
+    evs = memory.curve_counter_events(sim, max_points=2)
+    assert 0 < len(evs) <= 2
+    assert all(name == "hbm.predicted[unit]" for name, _, _ in evs)
+    assert max(v["bytes"] for _, v, _ in evs) == \
+        sim["predicted_peak_bytes"]
+    ts = [t for _, _, t in evs]
+    assert ts == sorted(ts)
+
+
+# -- the device budget --------------------------------------------------------
+
+def test_device_hbm_limit_env_overrides(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_HBM_LIMIT_BYTES", "12345")
+    assert memory.device_hbm_limit() == 12345
+    monkeypatch.delenv("PADDLE_TPU_HBM_LIMIT_BYTES")
+    monkeypatch.setenv("PADDLE_TPU_HBM_GB", "2")
+    assert memory.device_hbm_limit() == 2 * (1 << 30)
+
+
+def test_device_hbm_limit_kind_table():
+    assert memory.device_hbm_limit("TPU v5p") == 95 * (1 << 30)
+    assert memory.device_hbm_limit("TPU v5 lite") == 16 * (1 << 30)
+    # unknown kind: no budget, no invented verdicts
+    assert memory.device_hbm_limit("M2 Ultra") is None
+
+
+# -- OOM detection ------------------------------------------------------------
+
+def test_is_oom_error_shapes():
+    assert memory.is_oom_error(MemoryError())
+    assert memory.is_oom_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+        "1073741824 bytes"))
+    assert memory.is_oom_error(RuntimeError("Allocation of 4.0G exceeds "
+                                            "free HBM"))
+    assert not memory.is_oom_error(ValueError("shapes do not match"))
+    # "OOM" must match as a word — not the tail of "boom"/"zoom"
+    assert memory.is_oom_error(RuntimeError("OOM when allocating tensor"))
+    assert not memory.is_oom_error(RuntimeError("boom"))
+    # the cause chain is walked
+    try:
+        try:
+            raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+        except RuntimeError as inner:
+            raise ValueError("step failed") from inner
+    except ValueError as outer:
+        assert memory.is_oom_error(outer)
+
+
+def test_handle_oom_ignores_non_oom():
+    assert memory.handle_oom(ValueError("nope"), where="unit") is None
+    assert memory.last_oom() is None
+
+
+# -- end-to-end: jitted MLP + Adam on CPU -------------------------------------
+
+def _mlp_step(tmp_path, hidden=32):
+    monitor.enable(str(tmp_path))
+    profile.enable()
+    model = nn.Sequential(nn.Linear(16, hidden), nn.ReLU(),
+                          nn.Linear(hidden, 10))
+    adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    @jit.to_static(models=[model], optimizers=[adam])
+    def step(x, y):
+        logits = model(x)
+        loss = F.cross_entropy(logits, y)
+        loss.backward()
+        adam.step()
+        return loss
+
+    x = pt.to_tensor(np.random.RandomState(0).randn(8, 16)
+                     .astype("float32"))
+    y = pt.to_tensor(np.arange(8).astype("int64") % 10)
+    step(x, y)
+    return step
+
+
+def test_mlp_adam_reconciliation_and_attribution(tmp_path):
+    _mlp_step(tmp_path)
+    rep = memory.report(top_k=8)
+    assert rep is not None and rep["label"] == "jit.step"
+    # the acceptance bars: predicted within 10% of XLA's own peak,
+    # ≥90% of live-at-peak bytes attributed to a framework scope
+    assert rep["xla_peak_bytes"] and rep["xla_peak_bytes"] > 0
+    assert rep["reconciliation"] == pytest.approx(1.0, abs=0.10)
+    assert rep["attributed_frac"] >= 0.90
+    # donation found: Adam updates weights/slots in place
+    assert rep["n_donated"] > 0 and rep["donated_bytes"] > 0
+    # all four classes carry bytes in a train step
+    by = rep["by_class"]
+    assert by["param"] > 0 and by["opt_state"] > 0
+    assert by["activation"] > 0
+    # ledger sorted + Adam slots visible among contributors
+    classes = {c["class"] for c in rep["contributors"]}
+    assert "param" in classes and "opt_state" in classes
+    # gauges + JSONL landed
+    assert monitor.registry().value(
+        "memory.predicted_peak_bytes.jit.step", 0) == \
+        rep["predicted_peak_bytes"]
+    recs = [r for r in read_jsonl(monitor.jsonl_path())
+            if r.get("kind") == "memory_report"]
+    assert recs and recs[-1]["label"] == "jit.step"
+    assert recs[-1]["attributed_frac"] >= 0.90
+    # /snapshot carries the compact block
+    snap = monitor.export.snapshot_payload()
+    assert snap["memory"]["report"]["label"] == "jit.step"
+    assert len(snap["memory"]["report"]["contributors"]) <= 3
+
+
+def test_report_emits_curve_when_tracing(tmp_path):
+    _mlp_step(tmp_path)
+    trace.enable()
+    memory.report()
+    cs = [e for e in trace.events() if e[0] == "C"]
+    assert cs and all(e[1] == "hbm.predicted[jit.step]" for e in cs)
+    assert len(cs) <= 512
+
+
+def test_chrome_export_renders_counter_events():
+    trace.enable()
+    trace.counter("hbm.predicted[x]", {"bytes": 7})
+    doc = trace.export_chrome_trace()
+    recs = [e for e in doc["traceEvents"] if e.get("ph") == "C"]
+    assert recs and recs[0]["name"] == "hbm.predicted[x]"
+    assert recs[0]["args"] == {"bytes": 7}
+
+
+def test_oom_flight_record_bundles_memory_report(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_MAX", "10000")
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path / "fl"))
+    _mlp_step(tmp_path)
+    memory.report()
+    err = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying "
+                       "to allocate 99 bytes")
+    d = memory.handle_oom(err, where="unit", step=3)
+    assert d is not None
+    mem = json.load(open(f"{d}/memory_report.json"))
+    assert mem["label"] == "jit.step"
+    assert mem["contributors"] and mem["contributors"][0]["rank"] == 1
+    meta = json.load(open(f"{d}/meta.json"))
+    assert meta["reason"] == "oom"
+    last = memory.last_oom()
+    assert last["where"] == "unit" and last["step"] == 3
+    assert monitor.registry().value("memory.oom", 0) >= 1
+    # /snapshot points at the postmortem
+    snap = monitor.export.snapshot_payload()
+    assert snap["memory"]["last_oom"]["path"] == d
+
+
+def test_executor_crash_path_routes_oom(tmp_path, monkeypatch):
+    """An OOM-shaped crash inside Executor.run leaves an 'oom' flight
+    record (with the memory report) instead of a generic crash dump."""
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_MAX", "10000")
+    monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path / "fl"))
+    _mlp_step(tmp_path)
+    from paddle_tpu import static
+    exe = static.Executor()
+    boom = RuntimeError("RESOURCE_EXHAUSTED: Out of memory while "
+                        "trying to allocate 123 bytes")
+    monkeypatch.setattr(static.Executor, "_run_impl",
+                        lambda self, *a, **k: (_ for _ in ()).throw(boom))
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        exe.run(feed={}, fetch_list=[])
+    last = memory.last_oom()
+    assert last is not None and last["where"] == "executor.run"
+    meta = json.load(open(f"{last['path']}/meta.json"))
+    assert meta["reason"] == "oom"
+
+
+# -- planner feasibility ------------------------------------------------------
+
+def _mcfg():
+    from paddle_tpu.parallel import megatron as M
+    return M.MegatronConfig(vocab_size=64, hidden=32, n_heads=4,
+                            layers_per_stage=1, seq_len=16, microbatch=2,
+                            n_micro=1, use_moe=False)
+
+
+def test_advise_rows_carry_budget_columns(monkeypatch):
+    from paddle_tpu.parallel import planner
+    table = planner.advise(n_devices=8, cfg=_mcfg())
+    for row in table:
+        assert row["peak_hbm_bytes"] > 0
+        assert row["feasible"] is True          # no limit -> no verdicts
+        assert row["hbm_limit_bytes"] is None
+
+
+def test_advise_marks_over_budget_infeasible_and_sorts_last(monkeypatch):
+    from paddle_tpu.parallel import planner
+    cfg = _mcfg()
+    free = planner.advise(n_devices=8, cfg=cfg)
+    peaks = sorted(r["peak_hbm_bytes"] for r in free)
+    # a budget below the largest candidate but above the smallest:
+    # at least one row flips infeasible, at least one survives
+    limit = (peaks[0] + peaks[-1]) / 2.0
+    monkeypatch.setenv("PADDLE_TPU_HBM_LIMIT_BYTES", str(limit))
+    table = planner.advise(n_devices=8, cfg=cfg)
+    flags = [r["feasible"] for r in table]
+    assert True in flags and False in flags
+    # every feasible row ranks strictly ahead of every infeasible one
+    assert flags == sorted(flags, reverse=True)
+    assert all(r["hbm_limit_bytes"] == limit for r in table)
+    for r in table:
+        assert r["feasible"] == (r["peak_hbm_bytes"] <= limit)
+
+
+def test_plan_auto_never_picks_infeasible(monkeypatch):
+    import jax
+    from paddle_tpu.parallel import planner
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    cfg = _mcfg()
+    free = planner.advise(n_devices=8, cfg=cfg)
+    peaks = sorted(r["peak_hbm_bytes"] for r in free)
+    limit = (peaks[0] + peaks[-1]) / 2.0
+    monkeypatch.setenv("PADDLE_TPU_HBM_LIMIT_BYTES", str(limit))
+    p = planner.plan(auto=True, cfg=cfg, n_devices=8)
+    chosen = planner.last_decision()["chosen"]
+    row = next(r for r in p.advice if dict(r["sizes"]) == dict(chosen))
+    assert row["feasible"]
+    assert planner.last_decision()["infeasible"] >= 1
+    assert planner.last_decision()["hbm_limit_bytes"] == limit
+
+
+def test_plan_auto_all_infeasible_raises(monkeypatch):
+    import jax
+    from paddle_tpu.parallel import planner
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    monkeypatch.setenv("PADDLE_TPU_HBM_LIMIT_BYTES", "1")
+    with pytest.raises(ValueError, match="exceeds the device HBM"):
+        planner.plan(auto=True, cfg=_mcfg(), n_devices=8)
+
+
+# -- disabled mode: nothing runs, nothing is retained -------------------------
+
+def test_counter_noop_when_trace_disabled():
+    trace.counter("hbm.predicted[x]", {"bytes": 1})
+    assert trace.events() == []
+
+
+def test_disabled_step_leaves_no_memory_state(monkeypatch):
+    """An ordinary (monitor-off) jitted step must never touch the
+    liveness machinery or retain a report."""
+    bomb = lambda *a, **k: (_ for _ in ()).throw(
+        AssertionError("memory model touched while disabled"))
+    monkeypatch.setattr(memory, "liveness", bomb)
+    monkeypatch.setattr(memory, "simulate", bomb)
+    model = nn.Sequential(nn.Linear(4, 4), nn.ReLU())
+    adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    @jit.to_static(models=[model], optimizers=[adam])
+    def step(x, y):
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        adam.step()
+        return loss
+
+    x = pt.to_tensor(np.ones((2, 4), dtype="float32"))
+    y = pt.to_tensor(np.zeros((2,), dtype="int64"))
+    step(x, y)
+    assert memory.last_report() is None
+    assert memory.last_oom() is None
