@@ -487,6 +487,51 @@ def bench_planner(timeout_s=600):
     }
 
 
+def bench_memory_plan(timeout_s=600):
+    """Planned-memory stage: runs scripts/remat_smoke.py in a
+    subprocess and banks the memory-policy loop's decision: how many
+    times past the no-remat ceiling the picked policy trains (tight
+    band — the headline capability must not shrink), the picked rung,
+    predicted vs simulated peak under the policy, the offload worker's
+    exposed-wait fraction, and warm step seconds under none/remat
+    (very wide bands — CPU wall-clock noise). The smoke itself
+    enforces the hard gates (pre-flight peak under the limit, picker
+    never infeasible or host-over-budget, bit-identity)."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "remat_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_memory_plan"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"remat_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    return {
+        "memory_plan_ceiling_multiple": r["ceiling_multiple"],
+        "memory_plan_picked": r["picked"],
+        "memory_plan_predicted_peak_bytes": r["predicted_peak_bytes"],
+        "memory_plan_measured_peak_bytes":
+            r["measured_peak_under_policy"],
+        "memory_plan_offload_exposed_frac": r["offload_exposed_frac"],
+        "memory_plan_offload_transfer_s":
+            round(r["offload_transfer_s"], 6),
+        "memory_plan_step_s_none": round(r["step_s_none"], 6),
+        "memory_plan_step_s_remat": round(r["step_s_remat"], 6),
+        "memory_plan_gates_pass": bool(r["pass"]),
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -941,6 +986,16 @@ def main():
             print(f"partial planner_chosen={pl['planner_chosen']} "
                   f"candidates={pl['planner_candidates']}", flush=True)
             _RESULTS.update(pl)
+        try:
+            mpl = bench_memory_plan()
+        except Exception as e:
+            print(f"memory_plan bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial memory_plan_picked={mpl['memory_plan_picked']} "
+                  f"ceiling_multiple="
+                  f"{mpl['memory_plan_ceiling_multiple']}", flush=True)
+            _RESULTS.update(mpl)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

@@ -58,6 +58,10 @@ class Model(Layer):
         self._bucket_buckets = None  # fit(bucket=True) sets [batch_size]
         self._guard_traced = False   # nan_guard baked into _train_step?
         self._mesh_plan = None       # fit(mesh_plan=) resolved MeshPlan
+        self._memory = None          # fit(memory=) MemoryPolicy | "auto"
+        self._train_step_split = False  # offload: fwd/bwd + eager apply
+        self._split_trainables = None
+        self._split_has_grad = None
         self.stop_training = False
 
     # -- wiring ------------------------------------------------------------
@@ -98,23 +102,98 @@ class Model(Layer):
         labels = labels if isinstance(labels, (list, tuple)) else \
             ([] if labels is None else [labels])
         if self._train_step is None:
-            def step(*args):
-                n_in = len(inputs)
-                ins, labs = args[:n_in], args[n_in:]
-                outs = self(*ins)
-                loss = self._compute_loss(outs, list(labs))
-                loss.backward()
-                self._optimizer.step()
-                self._optimizer.clear_grad()
-                return loss
-            self._train_step = jit.to_static(
-                step, models=[self], optimizers=[self._optimizer],
-                bucket=self._bucket_buckets is not None,
-                buckets=self._bucket_buckets, plan=self._mesh_plan)
+            self._compile_train_step(inputs)
         from ..tensor import to_tensor
         args = [to_tensor(a) for a in list(inputs) + list(labels)]
-        loss = self._train_step(*args)
+        if self._train_step_split:
+            # offload split step: the jitted part is fwd/bwd only, with
+            # the grads threaded out as explicit outputs; the fused
+            # apply runs eagerly so the arena moments can live on host
+            # between applies (the fwd/bwd executable never carries
+            # them). The grads round-trip through p._grad exactly as
+            # the fused path would have seen them.
+            outs = self._train_step(*args)
+            loss = outs[0]
+            gi = iter(outs[1:])
+            for p, has in zip(self._split_trainables,
+                              self._split_has_grad):
+                p._grad = next(gi).data if has else None
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        else:
+            loss = self._train_step(*args)
         return [float(np.asarray(loss.numpy()))]
+
+    def _compile_train_step(self, inputs):
+        """Build the compiled train step under the active memory
+        policy: remat joins the to_static cache key, master_weights
+        wraps the body in amp.auto_cast over the arena's fp32 master,
+        and offload switches to the split fwd/bwd + eager-apply shape."""
+        from ..memory_plan import MemoryPolicy
+        pol = self._memory if isinstance(self._memory, MemoryPolicy) \
+            else None
+        remat = pol.remat if pol is not None else None
+        mw = pol is not None and pol.master_weights
+        offload = pol is not None and pol.offload
+        if mw:
+            import jax.numpy as jnp
+            self._optimizer.set_flat_arena(True)
+            self._optimizer._arena_view_dtype = jnp.bfloat16
+
+        def fwd_loss(ins, labs):
+            if mw:
+                from .. import amp as _amp
+                with _amp.auto_cast(True, dtype="bfloat16"):
+                    outs = self(*ins)
+                    return self._compute_loss(outs, list(labs))
+            outs = self(*ins)
+            return self._compute_loss(outs, list(labs))
+
+        if offload:
+            from ..memory_plan import attach_offload
+            from ..tensor import Tensor
+            attach_offload(self._optimizer)
+            trainables = [p for p in self.parameters()
+                          if not p.stop_gradient]
+            self._split_trainables = trainables
+            self._split_has_grad = has = []
+
+            def fwd_bwd(*args):
+                n_in = len(inputs)
+                loss = fwd_loss(args[:n_in], args[n_in:])
+                loss.backward()
+                # which params actually received grads is a structural
+                # fact of the trace — record it so the eager apply
+                # skips exactly the params the fused path would skip
+                has.clear()
+                grads = []
+                for p in trainables:
+                    has.append(p._grad is not None)
+                    if p._grad is not None:
+                        grads.append(Tensor(p._grad))
+                return tuple([loss] + grads)
+
+            self._train_step = jit.to_static(
+                fwd_bwd, models=[self], optimizers=[],
+                bucket=self._bucket_buckets is not None,
+                buckets=self._bucket_buckets, plan=self._mesh_plan,
+                remat=remat)
+            self._train_step_split = True
+            return
+
+        def step(*args):
+            n_in = len(inputs)
+            loss = fwd_loss(args[:n_in], args[n_in:])
+            loss.backward()
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+            return loss
+        self._train_step = jit.to_static(
+            step, models=[self], optimizers=[self._optimizer],
+            bucket=self._bucket_buckets is not None,
+            buckets=self._bucket_buckets, plan=self._mesh_plan,
+            remat=remat)
+        self._train_step_split = False
 
     def eval_batch(self, inputs, labels=None):
         """reference hapi/model.py:eval_batch — loss + metric updates."""
@@ -188,7 +267,7 @@ class Model(Layer):
             callbacks=None, prefetch=0, bucket=False, checkpoint=None,
             save_steps=None, auto_resume=False, nan_guard=None,
             watchdog=None, metrics_port=None, grad_sync=None,
-            flat_arena=None, mesh_plan=None):
+            flat_arena=None, mesh_plan=None, memory=None):
         """reference hapi/model.py:1128 fit.
 
         TPU pipelining extensions: ``prefetch=N`` stages the next N
@@ -235,7 +314,19 @@ class Model(Layer):
         the plan's PartitionSpecs, shards input batches over the
         plan's data axes, and folds the plan key into the train step's
         executable cache key — one config line for dp×tp(×sp) hybrid
-        layouts (docs/parallelism.md)."""
+        layouts (docs/parallelism.md).
+
+        Memory extension: ``memory`` (``"none"|"dots"|"full"``, a tuple
+        of ``(regex, policy)`` rules, ``"offload"``, a dict like
+        ``{"remat": "full", "offload": True, "master_weights": True}``,
+        a memory_plan.MemoryPolicy, or ``"auto"``) installs a memory
+        policy on the train step: rematerialization via jax.checkpoint,
+        optimizer-state host offload (double-buffered, overlapped with
+        fwd/bwd), and bf16 device params over fp32 master weights.
+        ``"auto"`` compiles the baseline once, reads the predicted-peak
+        model (monitor.memory.simulate) and picks the cheapest policy
+        that fits ``device_hbm_limit()`` — see docs/performance.md
+        "Memory as a planned resource"."""
         assert self._optimizer is not None, "call prepare() first"
         if grad_sync is not None:
             self._optimizer.set_grad_sync(grad_sync)
@@ -251,6 +342,12 @@ class Model(Layer):
             self._mesh_plan = new_plan
             new_plan.place_model(self)
             new_plan.place_optimizer(self._optimizer)
+        if memory is not None:
+            from .. import memory_plan as _mp
+            new_mem = _mp.resolve(memory)
+            if _mp.policy_key(new_mem) != _mp.policy_key(self._memory):
+                self._train_step = None  # policy change: one recompile
+            self._apply_memory_policy(new_mem)
         from ..resilience import faults as _faults
         from ..resilience._common import record as _rrecord
 
@@ -350,6 +447,12 @@ class Model(Layer):
                     finally:
                         if wd_ctx is not None:
                             wd_ctx.__exit__(None, None, None)
+                    if self._memory == "auto":
+                        # the first batch compiled the baseline and left
+                        # its aot capture in the monitor ledger — pick
+                        # the policy now, recompile (once) on the next
+                        # batch under the pick
+                        self._finish_auto_memory()
                     ok = True
                     if nan_guard is not None:
                         ok = nan_guard.check_host(
@@ -418,6 +521,45 @@ class Model(Layer):
                 handler.uninstall()
         cblist.call("on_train_end", {"loss": history["loss"]})
         return history
+
+    def _apply_memory_policy(self, pol):
+        """Install a resolved memory policy (MemoryPolicy or "auto"),
+        detaching mechanisms the new policy drops: a toggle away from
+        offload materialises the arena back on device and stops the
+        worker; a toggle away from master_weights clears the bf16 view
+        dtype (the arena itself stays — it is still exact fp32)."""
+        from ..memory_plan import MemoryPolicy, detach_offload
+        self._memory = pol
+        opt = self._optimizer
+        if opt is None:
+            return
+        if not (isinstance(pol, MemoryPolicy) and pol.offload) and \
+                getattr(opt, "_offloader", None) is not None:
+            detach_offload(opt)
+            self._train_step_split = False
+        if not (isinstance(pol, MemoryPolicy) and pol.master_weights):
+            opt._arena_view_dtype = None
+
+    def _finish_auto_memory(self):
+        """memory="auto" deferral: the baseline step just compiled, so
+        monitor.memory.simulate() now has an HLO to cost. Pick the
+        cheapest policy that fits the HBM budget and install it; if it
+        differs from the baseline the next batch recompiles exactly
+        once."""
+        from .. import memory_plan as _mp
+        if not _monitor.enabled():
+            import warnings
+            warnings.warn(
+                'memory="auto" needs the monitor enabled (the compiled '
+                "step's aot capture feeds the predicted-peak model); "
+                "keeping the baseline policy", RuntimeWarning)
+            self._memory = None
+            return
+        decision = _mp.plan_memory(auto=True)
+        pol = decision["policy"]
+        if _mp.policy_key(pol) != "none":
+            self._train_step = None  # recompile under the pick
+        self._apply_memory_policy(pol)
 
     @staticmethod
     def _poison(a):

@@ -109,7 +109,7 @@ class StaticFunction:
 
     def __init__(self, fn, models=None, optimizers=None, donate_state=True,
                  jit_kwargs=None, scalers=None, bucket=False, buckets=None,
-                 pad_mode="repeat", plan=None):
+                 pad_mode="repeat", plan=None, remat=None):
         functools.update_wrapper(self, fn,
                                  assigned=("__name__", "__doc__"),
                                  updated=())
@@ -134,6 +134,13 @@ class StaticFunction:
         # plan's data spec and the plan key joins the cache key (a plan
         # switch can never silently reuse a stale executable)
         self._plan = plan
+        # memory_plan remat policy: layers called inside the traced body
+        # checkpoint under this ambient policy; the canonical key joins
+        # the cache key so a policy toggle is exactly one recompile
+        if remat is not None:
+            from . import memory_plan as _mp
+            remat = _mp._canon_remat(remat)
+        self._remat = remat
         self._seen_base = set()  # recompile (vs first-compile) accounting
 
     def _resolve_objects(self):
@@ -238,7 +245,8 @@ class StaticFunction:
         base = (treedef, tuple(arr_idx),
                 tuple((i, repr(s)) for i, s in statics), train_flags,
                 tuple(state_names), ast_on,
-                self._plan.plan_key() if self._plan is not None else None)
+                self._plan.plan_key() if self._plan is not None else None,
+                self._remat)
         key = base + (tuple((a.shape, str(a.dtype)) for a in arrays),)
 
         fn_label = getattr(self, "__name__", "fn")
@@ -346,8 +354,14 @@ class StaticFunction:
                     saved_views.append(a.bind_views())
                 # tag the whole step's HLO with the function name (shows
                 # up in XLA profiles / the flight recorder's HLO dump)
-                with jax.named_scope(fn_scope):
-                    out = fn(*args, **kwargs)
+                if self._remat is not None:
+                    from . import memory_plan as _mp
+                    with _mp.remat_scope(self._remat):
+                        with jax.named_scope(fn_scope):
+                            out = fn(*args, **kwargs)
+                else:
+                    with jax.named_scope(fn_scope):
+                        out = fn(*args, **kwargs)
                 new_state = [hs[n].data for n in state_names]
                 # flatten outputs treating Tensors as leaves (don't let the
                 # pytree registration split them — we need to tag them)
@@ -382,7 +396,7 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, models=None, optimizers=None,
               donate_state=True, scalers=None, bucket=False, buckets=None,
-              pad_mode="repeat", plan=None, **kwargs):
+              pad_mode="repeat", plan=None, remat=None, **kwargs):
     """Decorator/wrapper: compile a dygraph step into one XLA computation.
 
     reference: paddle.jit.to_static (dygraph_to_static/program_translator.py)
@@ -402,12 +416,19 @@ def to_static(function=None, input_spec=None, models=None, optimizers=None,
     the plan's data axes and folds the plan key into the executable
     cache key — switching plans recompiles instead of silently reusing
     a stale layout.
+
+    ``remat=`` (memory_plan): activation rematerialization for the
+    traced body — ``"dots"``/``"full"`` or ``((pattern, policy), ...)``
+    per-layer rules. Layers called inside the step checkpoint under the
+    ambient policy; the policy joins the cache key, so toggling it
+    recompiles exactly once instead of silently reusing an executable
+    with the wrong memory shape.
     """
     def wrap(fn):
         return StaticFunction(fn, models=models, optimizers=optimizers,
                               donate_state=donate_state, scalers=scalers,
                               bucket=bucket, buckets=buckets,
-                              pad_mode=pad_mode, plan=plan)
+                              pad_mode=pad_mode, plan=plan, remat=remat)
     if function is not None:
         return wrap(function)
     return wrap
@@ -416,16 +437,22 @@ def to_static(function=None, input_spec=None, models=None, optimizers=None,
 # ---------------------------------------------------------------------------
 # recompute (gradient checkpointing)
 
-def recompute(layer_or_fn, *args, **kwargs):
+def recompute(layer_or_fn, *args, policy=None, **kwargs):
     """Run a Layer/function with rematerialization (reference:
     RecomputeOptimizer / fleet recompute; TPU-native: jax.checkpoint).
 
     Usage: ``out = jit.recompute(block, x)`` — activations inside `block`
     are recomputed during backward, trading FLOPs for HBM.
+
+    ``policy=`` names what the checkpoint may keep: ``"full"`` (default —
+    save only the inputs), or ``"dots"`` (checkpoint_dots: matmul
+    outputs stay, the elementwise tail recomputes).
     """
     from .dispatch import apply
-    from .nn.layer import bind_state
+    from .nn.layer import bind_state, _remat_suspended
     from . import autograd as _ag
+    from .memory_plan import checkpoint_policy
+    ckpt_policy = checkpoint_policy(policy)
 
     if isinstance(layer_or_fn, Layer):
         from .nn.moe import MoEFFN
@@ -460,16 +487,22 @@ def recompute(layer_or_fn, *args, **kwargs):
             saved = prandom._global_key.data
             prandom._global_key.data = rng_key
             try:
-                with bind_state(layer, state):
-                    with _ag.no_grad():
-                        out = layer(*full, **kwargs)
+                # suspend the layer remat hook: the subtree is already
+                # inside THIS checkpoint (re-wrapping would nest
+                # checkpoints — and recurse, since the hook calls back
+                # into recompute). Set inside impl so the backward
+                # replay is covered too.
+                with _remat_suspended():
+                    with bind_state(layer, state):
+                        with _ag.no_grad():
+                            out = layer(*full, **kwargs)
             finally:
                 prandom._global_key.data = saved
             out = out.data if isinstance(out, Tensor) else out
             auxs = tuple(l.aux_loss.data for l in moe_subs)
             return (out,) + auxs if moe_subs else out
 
-        ckpt = jax.checkpoint(impl)
+        ckpt = jax.checkpoint(impl, policy=ckpt_policy)
         tensors = (prandom.next_key_graph(),) + live_args + tuple(
             holder_map[n] for n in names)
         if not moe_subs:
@@ -494,13 +527,14 @@ def recompute(layer_or_fn, *args, **kwargs):
         saved = prandom._global_key.data
         prandom._global_key.data = rng_key
         try:
-            with _ag.no_grad():
-                out = fn(*full, **kwargs)
+            with _remat_suspended():
+                with _ag.no_grad():
+                    out = fn(*full, **kwargs)
         finally:
             prandom._global_key.data = saved
         return out.data if isinstance(out, Tensor) else out
 
-    return apply(jax.checkpoint(impl),
+    return apply(jax.checkpoint(impl, policy=ckpt_policy),
                  (prandom.next_key_graph(),) + live_args, name="recompute")
 
 

@@ -59,7 +59,15 @@ __all__ = [
     "reset", "CLASSES",
 ]
 
-CLASSES = ("param", "activation", "opt_state", "temp")
+CLASSES = ("param", "activation", "opt_state", "temp", "remat")
+
+# HLO op-name markers jax.checkpoint leaves on the backward's replay
+# (the primal forward keeps plain scope names — only recomputation is
+# tagged): the jvp(checkpoint) transpose path and remat2's
+# rematted_computation sub-scope. Buffers born under either are
+# recomputed activations, not stored ones.
+_REMAT_NAME_RE = re.compile(
+    r"rematted_computation|remat2|jvp\(checkpoint\)")
 
 # view opcodes: they alias operand storage, never allocate
 _TUPLE_OPS = frozenset(("tuple",))
@@ -276,7 +284,10 @@ def liveness(text, scope_map=None):
 
 
 def _classify(row):
-    """param / activation / opt_state / temp for one buffer row."""
+    """param / activation / opt_state / temp / remat for one buffer
+    row. remat = an activation recomputed inside a jax.checkpoint
+    replay — split out so a rematerialized step's by-class report stays
+    honest about what is stored state vs transient recompute."""
     if row["space"] == "argument":
         # jit.to_static labels entry params "state_vals[k]"/"arrays[k]";
         # data arrays are input activations, not weights
@@ -286,6 +297,8 @@ def _classify(row):
         if kinds and all(k == "optimizer" for k in kinds):
             return "opt_state"
         return "param"
+    if _REMAT_NAME_RE.search(row["op_name"]):
+        return "remat"
     if row["scope_kind"] == "optimizer":
         return "opt_state"
     if row["scope_kind"] in ("layer", "functional", "op"):

@@ -160,6 +160,16 @@ def sample_once(registry=None):
     rss = _host_rss_bytes()
     if rss is not None:
         reg.gauge("mem.host.rss_bytes").set(rss)
+        # host headroom vs PADDLE_TPU_HOST_MEM_LIMIT_BYTES (or the
+        # autodetected MemTotal) — the budget the offload auto-picker
+        # consults before paging optimizer state onto this host
+        try:
+            from ..memory_plan import host_mem_limit
+            limit = host_mem_limit()
+        except Exception:
+            limit = None
+        if limit is not None:
+            reg.gauge("mem.host.headroom_bytes").set(limit - rss)
 
     _poll_providers(reg)
 

@@ -14,6 +14,7 @@ re-declares the model as a static Program).
 from __future__ import annotations
 
 import contextlib
+import threading
 from collections import OrderedDict
 
 import numpy as np
@@ -22,6 +23,24 @@ import jax
 from ..tensor import Tensor, Parameter, convert_dtype, get_default_dtype
 from .. import initializer as I
 from ..monitor import profile as _profile
+
+# Remat hook (memory_plan): None until the first remat feature is used
+# (mirrors tensor._arena_hook's cost discipline), then consulted once
+# per __call__. The thread-local suspends it inside jit.recompute's
+# checkpointed body — the subtree is already under a checkpoint, and
+# the suspension must also hold during the backward replay.
+_remat_hook = None
+_remat_tls = threading.local()
+
+
+@contextlib.contextmanager
+def _remat_suspended():
+    prev = getattr(_remat_tls, "skip", False)
+    _remat_tls.skip = True
+    try:
+        yield
+    finally:
+        _remat_tls.skip = prev
 
 
 # Global structure version: bumped whenever any Layer's parameter /
@@ -45,7 +64,7 @@ def struct_version():
 class Layer:
     """Base network building block (reference: dygraph/layers.py:Layer)."""
 
-    def __init__(self, name_scope=None, dtype=None):
+    def __init__(self, name_scope=None, dtype=None, remat=None):
         self._parameters = OrderedDict()
         self._sub_layers = OrderedDict()
         self._buffers = OrderedDict()
@@ -54,6 +73,13 @@ class Layer:
         self._forward_pre_hooks = OrderedDict()
         self._forward_post_hooks = OrderedDict()
         self._name_scope = name_scope or self.__class__.__name__
+        # memory_plan: this layer's own remat policy ("dots"/"full"/
+        # rules; "none" pins the layer out of an ambient policy).
+        # Assignable after construction too — it's a plain attribute.
+        self._remat = remat
+        if remat is not None:
+            from ..memory_plan import install_layer_hook
+            install_layer_hook()
 
     # -- attribute plumbing -------------------------------------------------
     def __setattr__(self, name, value):
@@ -286,8 +312,13 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *args, **kwargs):
-        # cost discipline: profiling off (the default) costs exactly one
-        # module-flag check here — no scope name, no context manager
+        # cost discipline: each disarmed hook (the default) costs one
+        # module-flag check — no scope name, no context manager
+        if _remat_hook is not None and \
+                not getattr(_remat_tls, "skip", False):
+            out = _remat_hook(self, args, kwargs)
+            if out is not NotImplemented:
+                return out
         if _profile.scopes_on:
             with jax.named_scope(_profile.layer_scope(self)):
                 return self._run_forward(args, kwargs)

@@ -50,6 +50,11 @@ class Optimizer:
         self._parameter_list = list(parameters) if parameters else None
         self._arena = None
         self._flat_arena = False
+        # memory_plan hooks: host offload of the arena's slot buffers
+        # (memory_plan.attach_offload) and the bf16-view dtype the
+        # arena binds inside traces (fp32 master weights)
+        self._offloader = None
+        self._arena_view_dtype = None
         if flat_arena:
             self.set_flat_arena(True)
         # gradient-sync scheduler (parallel.overlap): a mode string
@@ -195,6 +200,7 @@ class Optimizer:
             if self._arena.matches(trainables):
                 if self._arena.needs_repack:
                     self._arena.repack_leaves()
+                self._arena.view_dtype = self._arena_view_dtype
                 return self._arena
             # membership/dtype changed: dissolve into per-leaf slots
             # first so the new arena adopts the live values
@@ -206,6 +212,7 @@ class Optimizer:
         for p in trainables:
             self._accumulators.pop(id(p), None)
         self._accumulators[id(arena)] = arena.holders()
+        arena.view_dtype = self._arena_view_dtype
         self._arena = arena
         return arena
 
@@ -274,6 +281,15 @@ class Optimizer:
         one flag check when profiling is off."""
         if self._flat_arena and self._arena_slots is not None:
             arena = self._ensure_arena()
+            # offload is an EAGER-path mechanism (the split step runs
+            # the apply outside jit); inside a trace the transfers would
+            # clobber tracers, so the hooks are gated on a clean trace
+            offload = (self._offloader is not None
+                       and jax.core.trace_state_clean())
+            if offload:
+                # wait for the H2D prefetch and rebind the moments
+                # before the fused apply reads them
+                self._offloader.collect(arena)
             # the grad pack (one ordered concat per dtype group) happens
             # OUTSIDE the opt.* scope — it is attributed to arena.pack,
             # and the opt.* region itself stays pure elementwise math
@@ -288,6 +304,10 @@ class Optimizer:
             else:
                 self._arena_apply(arena, packed, lr)
             arena.finish_step()
+            if offload:
+                # page the just-updated moments out + start the next
+                # prefetch; both overlap the next step's fwd/bwd
+                self._offloader.page_out(arena)
             self._post_step()
             return
         if _monitor.profile.scopes_on:
@@ -376,7 +396,12 @@ class Optimizer:
         if self._arena is not None:
             # emit standard per-leaf pname@slot views sliced from the
             # flat buffers — an arena checkpoint restores into a
-            # per-leaf optimizer unchanged (and vice versa)
+            # per-leaf optimizer unchanged (and vice versa). Offloaded
+            # moments come back device-resident first: the per-leaf
+            # slicing needs settled arrays, and checkpoint exactness
+            # requires the in-flight round trip to have landed.
+            if self._offloader is not None:
+                self._offloader.materialize(self._arena)
             self._arena.sync_leaves()
             out.update(self._arena.per_leaf_state(named))
         for pname, p in named:
@@ -395,6 +420,8 @@ class Optimizer:
             # build (or repack) the arena first so per-leaf checkpoint
             # slots scatter straight into the flat layout
             self._ensure_arena()
+            if self._offloader is not None:
+                self._offloader.materialize(self._arena)
         for i, p in enumerate(self._params()):
             pname = p.name or f"param_{i}"
             if self._arena is not None and id(p) in self._arena.param_ids:

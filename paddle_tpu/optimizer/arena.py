@@ -139,6 +139,11 @@ class ParamArena:
         (mid-training enable)."""
         self.slot_names = tuple(slot_names)
         self.pow_names = tuple(pow_names)
+        # memory_plan bf16-master: when set, bind_views casts the
+        # IN-TRACE leaf views to this dtype while the flat buffer (the
+        # fp32 master) stays the carried state — eager reads and
+        # checkpoints keep seeing exact fp32 leaves
+        self.view_dtype = None
         self.groups = []
         self._by_pid = {}   # id(param) -> (group, entry index)
         self._pid_set = set()
@@ -215,12 +220,22 @@ class ParamArena:
 
     def matches(self, params):
         """True when ``params`` (ordered trainables) are exactly the
-        members this arena was built over, same dtypes and sizes."""
-        want = []
-        for p in params:
+        members this arena was built over, same dtypes and sizes.
+        Inside a traced step ``bind_views`` may have rebound the leaves
+        to ``view_dtype`` casts of the fp32 master — that is this
+        arena's own doing, not a membership change, so the view dtype
+        counts as a match."""
+        view = (jnp.dtype(self.view_dtype).name
+                if self.view_dtype is not None else None)
+        sig = self.signature()
+        if len(params) != len(sig):
+            return False
+        for p, (si, sd, sn) in zip(params, sig):
             n = int(np.prod(p.data.shape)) if p.data.shape else 1
-            want.append((id(p), jnp.dtype(p.data.dtype).name, n))
-        return tuple(want) == self.signature()
+            dt = jnp.dtype(p.data.dtype).name
+            if id(p) != si or n != sn or (dt != sd and dt != view):
+                return False
+        return True
 
     def holders(self):
         """name → Tensor map of every flat buffer, registered as one
@@ -250,10 +265,20 @@ class ParamArena:
         saved = {} if resave else None
         for grp in self.groups:
             flat = grp.flat.data
+            cast = (self.view_dtype is not None and _is_tracer(flat)
+                    and jnp.dtype(self.view_dtype) != grp.dtype)
             for p, off, n, shape in grp.entries:
                 if resave:
                     saved[id(p)] = (p, p.data)
-                p.data = flat[off:off + n].reshape(shape)
+                v = flat[off:off + n].reshape(shape)
+                if cast:
+                    # bf16 device-resident views over the fp32 master:
+                    # the forward reads half-width params, grads cast
+                    # back to fp32 in pack_grads, the update applies to
+                    # the master. Trace-only on purpose — eager views
+                    # stay exact fp32.
+                    v = v.astype(self.view_dtype)
+                p.data = v
         return saved
 
     def unbind_views(self, saved):

@@ -78,6 +78,14 @@ class MegatronConfig(NamedTuple):
     # as dict keys; planner.MeshPlan(rules, mesh).spec_for drives the
     # placement.
     mesh_plan: tuple = None
+    # activation rematerialization (memory_plan policy names): "dots"
+    # or "full" wraps every transformer block in jax.checkpoint under
+    # that policy, so the pipeline's backward recomputes block
+    # activations instead of storing them — same math, ~one extra
+    # forward of flops per block; XLA may refuse the recomputed ops so
+    # losses track the stored-activation run to float rounding, not
+    # guaranteed bit-for-bit (the jit.to_static surface IS bit-exact).
+    remat: str = None
 
 
 def factorize_mesh(n_devices):
@@ -349,8 +357,19 @@ def _moe_ffn(x, p, cfg):
 
 
 def _stage_fn(x, stage_params, cfg, is_last):
+    blk = None
+    if cfg.remat is not None and cfg.remat != "none":
+        from ..memory_plan import checkpoint_policy
+        pol = checkpoint_policy(cfg.remat)
+        # per-block checkpoint: the backward replays one block at a
+        # time, so peak activation memory is one block's worth (plus
+        # the saved block inputs) instead of layers_per_stage worths
+        blk = jax.checkpoint(
+            functools.partial(_block, cfg=cfg), policy=pol,
+            static_argnums=(2,))
     for li in range(cfg.layers_per_stage):
-        x = _block(x, stage_params, li, cfg)
+        x = blk(x, stage_params, li) if blk is not None \
+            else _block(x, stage_params, li, cfg)
     if is_last and cfg.use_moe:
         x = _moe_ffn(x, stage_params, cfg)
     return x

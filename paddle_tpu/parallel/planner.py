@@ -521,7 +521,12 @@ def megatron_candidate_stats(cfg, sizes, global_batch=None):
     peak_hbm = 4.0 * (state_elems + act_elems + logits_elems)
     return {"flops": float(flops), "hbm_bytes": hbm, "comm": comm,
             "degraded_frac": 1.0 if degraded else 0.0,
-            "peak_hbm_bytes": float(peak_hbm)}
+            "peak_hbm_bytes": float(peak_hbm),
+            # decomposition for the memory-policy advisory columns:
+            # activations are what remat removes, the two Adam slots
+            # (half the training state) are what offload removes
+            "peak_act_bytes": 4.0 * float(act_elems),
+            "peak_opt_bytes": 4.0 * float(2.0 * param_local)}
 
 
 def stats_from_profile(sizes, report=None, param_elems=0,
@@ -556,19 +561,23 @@ def stats_from_profile(sizes, report=None, param_elems=0,
     # peak residency from the measured liveness model when one exists:
     # state bytes (params/opt slots) divide over the model axes, the
     # activation/temp working set over the data axes
-    peak_hbm = None
+    peak_hbm = act_bytes = opt_bytes = None
     try:
         from ..monitor import memory as _mem
         mrep = _mem.last_report()
         if mrep:
             bc = mrep.get("by_class", {})
             state = float(bc.get("param", 0) + bc.get("opt_state", 0))
-            work = float(bc.get("activation", 0) + bc.get("temp", 0))
+            work = float(bc.get("activation", 0) + bc.get("remat", 0)
+                         + bc.get("temp", 0))
             peak_hbm = state / model_split + work / max(dp, 1)
+            act_bytes = work / max(dp, 1)
+            opt_bytes = float(bc.get("opt_state", 0)) / model_split
     except Exception:
         peak_hbm = None
     return {"flops": flops / n, "hbm_bytes": nbytes / n, "comm": comm,
-            "degraded_frac": 0.0, "peak_hbm_bytes": peak_hbm}
+            "degraded_frac": 0.0, "peak_hbm_bytes": peak_hbm,
+            "peak_act_bytes": act_bytes, "peak_opt_bytes": opt_bytes}
 
 
 def advise(n_devices=None, cfg=None, candidates=None, axes=("dp", "tp"),
@@ -577,7 +586,11 @@ def advise(n_devices=None, cfg=None, candidates=None, axes=("dp", "tp"),
            hbm_limit=None):
     """Ranked layout table, best first. Each row:
     ``{rank, sizes, pred_step_s, compute_s, hbm_s, comm_s, bound,
-    degraded_frac, peak_hbm_bytes, feasible}``. Deterministic: ties
+    degraded_frac, peak_hbm_bytes, feasible, remat, offload,
+    mem_overhead_s}`` — the last three are ADVISORY memory-policy
+    columns (the cheapest memory_plan ladder rung that would fit the
+    candidate under the HBM budget and its predicted overhead; "none"/
+    False/0.0 when it already fits). Deterministic: ties
     break on degradation then on the sizes dict, so repeated calls are
     rank-stable.
 
@@ -643,6 +656,8 @@ def advise(n_devices=None, cfg=None, candidates=None, axes=("dp", "tp"),
         row["feasible"] = not (hbm_limit is not None
                                and peak is not None
                                and peak > hbm_limit)
+        row["remat"], row["offload"], row["mem_overhead_s"] = \
+            _mem_advice(row, stats, hbm_limit)
         rows.append(row)
     rows.sort(key=lambda r: (0 if r["feasible"] else 1,
                              round(r["pred_step_s"], 15),
@@ -651,6 +666,35 @@ def advise(n_devices=None, cfg=None, candidates=None, axes=("dp", "tp"),
     for i, r in enumerate(rows):
         r["rank"] = i + 1
     return rows
+
+
+def _mem_advice(row, stats, hbm_limit):
+    """Advisory memory-policy columns for an advise() row: the cheapest
+    memory_plan ladder rung (none → dots-remat → full-remat → +offload)
+    that would bring this candidate's predicted peak under the budget,
+    plus its predicted step-time overhead. Purely informational —
+    ``feasible`` and the ranking still describe the layout AS-IS;
+    enacting the suggestion is fit(memory=)/plan_memory()'s job."""
+    peak = row.get("peak_hbm_bytes")
+    if peak is None or hbm_limit is None or peak <= hbm_limit:
+        return "none", False, 0.0
+    act = float(stats.get("peak_act_bytes") or 0.0)
+    opt = float(stats.get("peak_opt_bytes") or 0.0)
+    # fwd ≈ 1/3 of the fwd+bwd flop time already priced into the row
+    fwd_s = float(row.get("compute_s", 0.0)) / 3.0
+    from ..memory_plan import host_link_bandwidth
+    link = host_link_bandwidth()
+    ladder = (("dots", peak - 0.5 * act, False, 0.25 * fwd_s),
+              ("full", peak - 0.9 * act, False, fwd_s),
+              ("full", peak - 0.9 * act - opt, True,
+               fwd_s + (2.0 * opt / link if link else 0.0)))
+    for name, p2, off, over in ladder:
+        if p2 <= hbm_limit:
+            return name, off, float(over)
+    # even the deepest rung stays over budget: report it anyway so the
+    # row shows how close the best effort gets
+    name, _, off, over = ladder[-1]
+    return name, off, float(over)
 
 
 # ---------------------------------------------------------------------------

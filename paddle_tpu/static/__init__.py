@@ -379,6 +379,7 @@ class Executor:
         self._seen_base = set()   # (program, fetches, mesh) combos compiled
         self._pending_fetches = None
         self._async_runs = 0
+        self._mem_warned = False  # offload-on-static fallback warned once
 
     @staticmethod
     def _mesh_sig(dp_mesh, dp_requested):
@@ -411,12 +412,12 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, scope=None, bucket=False, buckets=None,
             pad_mode="repeat", async_fetch=False, fetch_period=None,
-            nan_guard=None, mesh_plan=None):
+            nan_guard=None, mesh_plan=None, memory=None):
         try:
             return self._run_impl(program, feed, fetch_list, return_numpy,
                                   scope, bucket, buckets, pad_mode,
                                   async_fetch, fetch_period, nan_guard,
-                                  mesh_plan)
+                                  mesh_plan, memory)
         except BaseException as e:
             # unhandled crash: leave the flight-recorder artifact (last
             # spans + counters + active HLO) before the stack unwinds.
@@ -430,8 +431,41 @@ class Executor:
 
     def _run_impl(self, program, feed, fetch_list, return_numpy, scope,
                   bucket, buckets, pad_mode, async_fetch, fetch_period,
-                  nan_guard, mesh_plan=None):
+                  nan_guard, mesh_plan=None, memory=None):
         program = program or default_main_program()
+        mem_remat = None
+        mem_key = "none"
+        if memory is not None:
+            from .. import memory_plan as _mp
+            mem_pol = _mp.resolve(memory)
+            if mem_pol == "auto":
+                raise ValueError(
+                    'memory="auto" is a loop-level knob: use '
+                    'train_from_dataset(memory="auto"), or call '
+                    "memory_plan.plan_memory(auto=True) yourself and "
+                    "pass the decision's policy here")
+            if mem_pol is not None:
+                mem_key = _mp.policy_key(mem_pol)
+                mem_remat = mem_pol.remat
+                if mem_pol.offload or mem_pol.master_weights:
+                    # a static Program carries params and slots as
+                    # explicit (donated) executable arguments, so paging
+                    # them to host would just re-upload everything each
+                    # run with no HBM saving, and the bf16 view dtype is
+                    # an arena-trace feature — remat is the mechanism
+                    # that applies here. Fall back loudly, once.
+                    if not self._mem_warned:
+                        self._mem_warned = True
+                        import warnings
+                        warnings.warn(
+                            "Executor.run(memory=): offload/"
+                            "master_weights only apply to the eager "
+                            "arena path (hapi.Model.fit / "
+                            "optimizer.step); applying the remat part "
+                            "only", RuntimeWarning)
+                    if _monitor.enabled():
+                        _monitor.counter(
+                            "executor.memory_policy_fallback").inc()
         if isinstance(nan_guard, str):
             from ..resilience.guard import NaNGuard
             nan_guard = NaNGuard(nan_guard)
@@ -525,7 +559,7 @@ class Executor:
         base_key = (program.id, program.version, tuple(fetch_names),
                     (plan.plan_key() if plan is not None
                      else self._mesh_sig(dp_mesh, dp_requested)),
-                    nan_guard is not None)
+                    nan_guard is not None, mem_key)
         key = base_key + (tuple(sorted((k, tuple(a.shape), str(a.dtype))
                                        for k, a in feed_arrays.items())),)
         if _monitor.enabled():
@@ -545,7 +579,7 @@ class Executor:
                 self._cache[key] = self._compile(
                     program, fetch_names, sorted(feed_arrays),
                     param_names, slot_names,
-                    nan_guard=nan_guard is not None)
+                    nan_guard=nan_guard is not None, remat=mem_remat)
         compiled = self._cache[key]
 
         param_vals = [program.param_vars[n].data for n in param_names]
@@ -650,7 +684,7 @@ class Executor:
                            checkpoint=None, save_steps=None,
                            auto_resume=False, nan_guard=None,
                            grad_sync=None, flat_arena=None,
-                           mesh_plan=None):
+                           mesh_plan=None, memory=None):
         """reference executor.py:train_from_dataset — run the program
         over every batch a fluid.dataset yields. The reference spawns
         C++ DataFeed threads; here each host-assembled MultiSlot batch
@@ -680,7 +714,17 @@ class Executor:
         ``mesh_plan`` (a parallel.planner.MeshPlan, rule tuple, or
         "auto") lays the program's params and every feed batch out
         under the plan — same knob as hapi.Model.fit(mesh_plan=); see
-        docs/parallelism.md."""
+        docs/parallelism.md.
+
+        ``memory`` ("none"/"dots"/"full", a policy dict, a
+        memory_plan.MemoryPolicy, or "auto") applies a memory policy to
+        the compiled program — on this surface the remat mechanism
+        (offload/master_weights fall back with a warning, see
+        Executor.run). "auto" compiles the first batch as the baseline,
+        asks memory_plan.plan_memory(auto=True) for the cheapest policy
+        that fits the HBM budget, and runs the rest of the dataset
+        under the pick (one recompile). See docs/performance.md
+        "Memory as a planned resource"."""
         if dataset is None:
             raise RuntimeError("dataset is required for train_from_dataset")
         fetch_list = fetch_list or []
@@ -698,6 +742,14 @@ class Executor:
         if mesh_plan is not None:
             from ..parallel import planner as _planner
             mesh_plan = _planner.resolve(mesh_plan)
+        mem_pol = None
+        mem_auto = False
+        if memory is not None:
+            from .. import memory_plan as _mp
+            mem_pol = _mp.resolve(memory)
+            if mem_pol == "auto":
+                mem_auto = True
+                mem_pol = None  # first batch runs (and costs) baseline
         cm = None
         if checkpoint is not None:
             from ..io import CheckpointManager
@@ -742,7 +794,23 @@ class Executor:
                     _faults.maybe_raise("host_loss", i)
                 outs = self.run(program, feed=batch, fetch_list=fetch_list,
                                 scope=scope, bucket=bucket, buckets=buckets,
-                                nan_guard=nan_guard, mesh_plan=mesh_plan)
+                                nan_guard=nan_guard, mesh_plan=mesh_plan,
+                                memory=mem_pol)
+                if mem_auto:
+                    # the baseline batch just compiled (its aot capture
+                    # feeds the predicted-peak model) — pick once, run
+                    # the remainder under the chosen policy
+                    mem_auto = False
+                    from .. import memory_plan as _mp
+                    if _monitor.enabled():
+                        mem_pol = _mp.plan_memory(auto=True)["policy"]
+                    else:
+                        import warnings
+                        warnings.warn(
+                            'memory="auto" needs the monitor enabled '
+                            "(the compiled step's aot capture feeds the "
+                            "predicted-peak model); keeping the "
+                            "baseline policy", RuntimeWarning)
                 if handler is not None:
                     handler.notify_step(i)
                 if debug and fetch_list and i % max(print_period, 1) == 0:
@@ -817,7 +885,7 @@ class Executor:
         param_names, opt_entries, slot_names = \
             self._param_slot_names(program)
         base_key = (program.id, program.version, tuple(fetch_names),
-                    self._mesh_sig(dp_mesh, dp_requested), False)
+                    self._mesh_sig(dp_mesh, dp_requested), False, "none")
         key = base_key + (tuple(sorted((k, s, str(d))
                                        for k, (s, d) in specs.items())),)
         if key in self._cache:
@@ -869,7 +937,15 @@ class Executor:
         return key
 
     def _compile(self, program, fetch_names, feed_order, param_names,
-                 slot_names, nan_guard=False):
+                 slot_names, nan_guard=False, remat=None):
+        # remat: canonical policy from memory_plan._canon_remat — a name
+        # ("dots"/"full") checkpoints the whole fwd pass under that
+        # jax.checkpoint policy; per-layer rules degrade to "full" here
+        # (a graph Program has no Layer boundaries to match against)
+        ckpt_policy = None
+        if remat is not None and isinstance(remat, str):
+            from ..memory_plan import checkpoint_policy
+            ckpt_policy = checkpoint_policy(remat)
         if _monitor.enabled():
             _monitor.counter("executor.compile").inc()
             _monitor.emit(kind="executor_compile", program_id=program.id,
@@ -930,14 +1006,33 @@ class Executor:
                     return jnp.sum(env2[loss_name]), env2
 
                 tp = [new_params[i] for i in trainable_idx]
-                grads, env = jax.grad(loss_of, has_aux=True)(tp)
+                if remat is not None:
+                    # rematerialized backward: the whole forward is one
+                    # jax.checkpoint region. The aux is NARROWED to the
+                    # fetches + loss — returning the whole env would pin
+                    # every intermediate as a residual and undo the
+                    # remat. Exact: same primals, recomputed not stored.
+                    def loss_of_ckpt(tp):
+                        pv = list(new_params)
+                        for j, i in enumerate(trainable_idx):
+                            pv[i] = tp[j]
+                        env2 = forward(feed_vals, pv, rng_vals)
+                        return jnp.sum(env2[loss_name]), (
+                            [env2[n] for n in fetch_names],
+                            env2[loss_name])
+                    grads, (fvals, lval) = jax.grad(
+                        jax.checkpoint(loss_of_ckpt, policy=ckpt_policy),
+                        has_aux=True)(tp)
+                else:
+                    grads, env = jax.grad(loss_of, has_aux=True)(tp)
+                    fvals = [env[n] for n in fetch_names]
+                    lval = env[loss_name]
                 if fetches is None:
-                    fetches = [env[n] for n in fetch_names]
+                    fetches = fvals
                 if nan_guard:
                     from ..amp import tree_all_finite
                     finite = jnp.logical_and(
-                        finite, tree_all_finite(
-                            list(grads) + [env[loss_name]]))
+                        finite, tree_all_finite(list(grads) + [lval]))
 
                 # reference order: clip raw grads first, then regularize
                 params_grads = [(i, program.param_vars[param_names[i]],

@@ -136,6 +136,24 @@ METRICS = [
     ("memory_predicted_peak_bytes",
      ("memory_predicted_peak_bytes",), ("memory_predicted_peak_bytes",),
      "lower", 0.50),
+    # memory-plan stage (bench_memory_plan / remat_smoke): how far past
+    # the no-remat ceiling the picked policy trains is the headline
+    # capability (tight band — it must not quietly shrink below 4x);
+    # the picked rung's predicted peak moves with any legitimate model
+    # change (wide band); the offload exposed-wait fraction and the
+    # warm step timings are CPU wall-clock (very wide bands)
+    ("memory_plan_ceiling_multiple",
+     ("memory_plan_ceiling_multiple",), ("memory_plan_ceiling_multiple",),
+     "higher", 0.10),
+    ("memory_plan_predicted_peak_bytes",
+     ("memory_plan_predicted_peak_bytes",),
+     ("memory_plan_predicted_peak_bytes",), "lower", 0.50),
+    ("memory_plan_offload_exposed_frac",
+     ("memory_plan_offload_exposed_frac",),
+     ("memory_plan_offload_exposed_frac",), "lower", 1.00),
+    ("memory_plan_step_s_remat",
+     ("memory_plan_step_s_remat",), ("memory_plan_step_s_remat",),
+     "lower", 1.00),
 ]
 
 

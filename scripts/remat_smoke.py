@@ -1,0 +1,277 @@
+"""Memory-plan smoke gate (tier-1-safe: tiny MLPs, CPU, ~a minute).
+
+Exercises PR 13's planned-memory loop end to end under a virtual
+``PADDLE_TPU_HBM_LIMIT_BYTES`` budget:
+
+* **ceiling scan** — compile the same activation-heavy MLP step at
+  growing batch sizes and read each no-remat predicted peak; place the
+  budget so the no-remat ceiling is ``--ceil-batch`` and then train a
+  model 4x past it with the policy ``plan_memory(auto=True)`` picked,
+  losses staying finite (ROADMAP item 4's >=4x gate)
+* **pre-flight** — the picked candidate's predicted peak is under the
+  budget *before* the step recompiles, and the pick is never an
+  infeasible or host-over-budget row
+* **picker sanity** — a generous budget picks "none" (zero-overhead
+  baseline), an impossible budget refuses every candidate with
+  ValueError, and a budget only the offload rung satisfies is refused
+  when ``PADDLE_TPU_HOST_MEM_LIMIT_BYTES`` can't take the paged state
+  but picked once the host budget allows it
+* **offload overlap** — ``fit(memory="offload")`` pages the arena's
+  Adam moments through the comm-worker-thread pattern: ``offload.d2h``
+  / ``offload.h2d`` spans land on a non-main trace track and the
+  exposed wait is <= 40% of the blocking transfer time
+* **bit-identity** — remat ("full") losses equal the no-remat run
+  bit-for-bit on the ``to_static`` surface, and offload-on equals the
+  same split step with paging no-opped (paging is value-preserving)
+
+Writes the monitor JSONL to --out-dir and prints one JSON result line.
+Exit code 0 iff every gate passes.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "--xla_force_host_platform_device_count" not in os.environ["XLA_FLAGS"]:
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+DIN, HID, DEPTH = 32, 32, 10
+
+
+def _build(nn, pt):
+    pt.seed(0)
+    layers = [nn.Linear(DIN, HID), nn.ReLU()]
+    for _ in range(DEPTH):
+        layers += [nn.Linear(HID, HID), nn.ReLU()]
+    layers += [nn.Linear(HID, 10)]
+    return nn.Sequential(*layers)
+
+
+def _spans(events):
+    """Pair B/E trace events into (name, tid, t0, t1) via per-tid
+    stacks (spans nest properly within a thread)."""
+    stacks, out = {}, []
+    for ev in events:
+        kind, name, tid, ts = ev[0], ev[1], ev[2], ev[3]
+        if kind == "B":
+            stacks.setdefault(tid, []).append((name, ts))
+        elif kind == "E" and stacks.get(tid):
+            name0, t0 = stacks[tid].pop()
+            out.append((name0, tid, t0, ts))
+        elif kind == "X":                    # complete span: ts + dur
+            out.append((name, tid, ts, ts + ev[4]))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="/tmp/paddle_tpu_remat_smoke")
+    ap.add_argument("--ceil-batch", type=int, default=64,
+                    help="target no-remat ceiling batch; the big model "
+                         "trains at 4x this")
+    args = ap.parse_args()
+
+    import paddle_tpu as pt
+    from paddle_tpu import hapi, jit, memory_plan as mp, monitor, nn, \
+        optimizer as opt
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.monitor import memory, trace
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    jsonl = monitor.enable(os.path.join(args.out_dir, "remat_smoke.jsonl"))
+    monitor.profile.enable()
+    gates = {}
+
+    # -- part 1: ceiling scan --------------------------------------------
+    def step_at(batch, remat=None, steps=1):
+        model = _build(nn, pt)
+        adam = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+        @jit.to_static(models=[model], optimizers=[adam], remat=remat)
+        def step(x, y):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            adam.step()
+            return loss
+
+        rng = np.random.RandomState(0)
+        x = pt.to_tensor(rng.randn(batch, DIN).astype("f4"))
+        y = pt.to_tensor(rng.randint(0, 10, (batch,)).astype("i8"))
+        losses = [float(step(x, y).numpy())]   # warmup pays the compile
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            losses.append(float(step(x, y).numpy()))
+        dt = (time.perf_counter() - t0) / steps
+        return memory.report(emit_records=False), losses, dt
+
+    ceil_b, big_b = args.ceil_batch, 4 * args.ceil_batch
+    scan = {}
+    for b in (ceil_b // 2, ceil_b, 2 * ceil_b, big_b):
+        rep, _, _ = step_at(b)
+        scan[b] = rep["predicted_peak_bytes"]
+    big_rep, _, t_none = step_at(big_b)  # newest capture feeds the picker
+    bc = big_rep["by_class"]
+    big_act = float(bc.get("activation", 0)) + float(bc.get("remat", 0))
+    full_pred = scan[big_b] - 0.9 * big_act
+
+    # the budget: above the ceiling batch's no-remat peak (and the big
+    # model's full-remat predicted peak) but below the next batch up —
+    # so batch=ceil_b is the honest no-remat ceiling and the 4x model
+    # only fits rematerialized
+    lo = max(scan[ceil_b], full_pred)
+    hi = min(scan[2 * ceil_b], scan[big_b])
+    gates["ceiling_window_exists"] = lo < hi
+    limit = (lo + hi) / 2.0
+    os.environ["PADDLE_TPU_HBM_LIMIT_BYTES"] = str(int(limit))
+
+    decision = mp.plan_memory(auto=True)
+    pick_row = next(r for r in decision["table"]
+                    if r["name"] == decision["picked"])
+    gates["preflight_peak_under_limit"] = (
+        decision["predicted_peak_bytes"] <= limit)
+    gates["pick_not_baseline"] = decision["picked"] != "none"
+    gates["pick_feasible_and_host_ok"] = (
+        pick_row["feasible"] and pick_row["host_ok"])
+
+    # train the 4x model under the picked policy: the >=4x gate
+    pol = decision["policy"]
+    rep_remat, losses_big, t_remat = step_at(
+        big_b, remat=pol.remat if pol.remat else None, steps=3)
+    gates["trained_4x_finite"] = all(np.isfinite(losses_big))
+    ceiling_multiple = float(big_b) / float(ceil_b)
+    gates["ceiling_multiple>=4"] = ceiling_multiple >= 4.0
+
+    # -- part 2: picker sanity -------------------------------------------
+    os.environ["PADDLE_TPU_HBM_LIMIT_BYTES"] = str(1 << 40)
+    gates["generous_limit_picks_none"] = (
+        mp.plan_memory(auto=True)["picked"] == "none")
+
+    os.environ["PADDLE_TPU_HBM_LIMIT_BYTES"] = "1"
+    refused_all = False
+    try:
+        mp.plan_memory(auto=True)
+    except ValueError:
+        refused_all = True
+    gates["impossible_limit_refused"] = refused_all
+
+    # a budget only the offload rung satisfies: refused when the host
+    # can't take the paged state, picked when it can
+    table = decision["table"]
+    off_row = next(r for r in table if r["name"] == "full+offload")
+    full_row = next(r for r in table if r["name"] == "full")
+    off_limit = (off_row["predicted_peak_bytes"]
+                 + full_row["predicted_peak_bytes"]) / 2.0
+    os.environ["PADDLE_TPU_HBM_LIMIT_BYTES"] = str(int(off_limit))
+    os.environ["PADDLE_TPU_HOST_MEM_LIMIT_BYTES"] = "1"
+    host_refused = False
+    try:
+        mp.plan_memory(auto=True)
+    except ValueError:
+        host_refused = True
+    gates["host_over_budget_refused"] = host_refused
+    os.environ["PADDLE_TPU_HOST_MEM_LIMIT_BYTES"] = str(1 << 40)
+    gates["host_ok_picks_offload"] = (
+        mp.plan_memory(auto=True)["picked"] == "full+offload")
+    del os.environ["PADDLE_TPU_HOST_MEM_LIMIT_BYTES"]
+    del os.environ["PADDLE_TPU_HBM_LIMIT_BYTES"]
+
+    # -- part 3: offload overlap ------------------------------------------
+    rng = np.random.RandomState(1)
+    w = rng.randn(DIN, 3)
+    fx = rng.randn(128, DIN).astype("f4")
+    fy = (fx @ w).argmax(-1).astype("i4")
+
+    def fit_offload(paging=True, epochs=2):
+        pt.seed(3)
+        net = nn.Sequential(nn.Linear(DIN, 256), nn.ReLU(),
+                            nn.Linear(256, 256), nn.ReLU(),
+                            nn.Linear(256, 3))
+        m = hapi.Model(net)
+        m.prepare(optimizer=opt.Adam(learning_rate=1e-3,
+                                     parameters=m.parameters()),
+                  loss_function=hapi.CrossEntropy())
+        orig = mp.ArenaOffloader
+        if not paging:
+            class _Noop(mp.ArenaOffloader):
+                def collect(self, arena, count_exposed=True):
+                    pass
+
+                def page_out(self, arena):
+                    pass
+            mp.ArenaOffloader = _Noop
+        try:
+            h = m.fit(TensorDataset(fx, fy), batch_size=32,
+                      epochs=epochs, verbose=0, shuffle=False,
+                      memory="offload")
+        finally:
+            mp.ArenaOffloader = orig
+        return m, h["loss"]
+
+    trace.enable()
+    m_off, losses_off = fit_offload()
+    events = list(trace.events())
+    trace.disable()
+    off = m_off._optimizer._offloader
+    spans = _spans(events)
+    d2h = [s for s in spans if s[0] == "offload.d2h"]
+    h2d = [s for s in spans if s[0] == "offload.h2d"]
+    waits = [s for s in spans if s[0] == "offload.wait"]
+    main_tids = {s[1] for s in waits}
+    worker_tids = {s[1] for s in d2h} | {s[1] for s in h2d}
+    gates["offload_spans_present"] = bool(d2h) and bool(h2d)
+    gates["offload_own_track"] = (
+        bool(worker_tids) and not (worker_tids & main_tids))
+    exposed_frac = (off.exposed_wait_s / off.transfer_s
+                    if off.transfer_s else 0.0)
+    gates["exposed_wait<=40pct"] = (
+        off.transfer_s > 0 and exposed_frac <= 0.40)
+
+    # -- part 4: bit-identity ---------------------------------------------
+    _, l_none, _ = step_at(32, remat=None, steps=3)
+    _, l_full, _ = step_at(32, remat="full", steps=3)
+    gates["remat_bit_identical"] = l_none == l_full
+
+    _, l_page = fit_offload(paging=True, epochs=1)
+    _, l_noop = fit_offload(paging=False, epochs=1)
+    gates["offload_bit_identical"] = l_page == l_noop
+
+    monitor.disable()
+
+    result = {
+        "metric": "remat_smoke",
+        "ceiling_batch": ceil_b,
+        "big_batch": big_b,
+        "ceiling_multiple": ceiling_multiple,
+        "hbm_limit_bytes": int(limit),
+        "scan_peaks": {str(k): v for k, v in scan.items()},
+        "picked": decision["picked"],
+        "predicted_peak_bytes": decision["predicted_peak_bytes"],
+        "baseline_peak_bytes": decision["baseline_peak_bytes"],
+        "measured_peak_under_policy": rep_remat["predicted_peak_bytes"],
+        "remat_class_bytes": rep_remat["by_class"].get("remat", 0),
+        "plan_overhead_s": decision["overhead_s"],
+        "step_s_none": t_none,
+        "step_s_remat": t_remat,
+        "offload_exposed_wait_s": off.exposed_wait_s,
+        "offload_transfer_s": off.transfer_s,
+        "offload_exposed_frac": round(exposed_frac, 4),
+        "offload_bytes_out": off.bytes_out,
+        "offload_steps": off.steps,
+        "jsonl": jsonl,
+    }
+    result["gates"] = gates
+    result["pass"] = all(gates.values())
+    print(json.dumps(result))
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI gate for planned memory: under a virtual PADDLE_TPU_HBM_LIMIT_BYTES
+# budget the no-remat ceiling is found by scanning predicted peaks, a
+# model 4x past it trains under the policy plan_memory(auto=True)
+# picked (predicted peak under the limit pre-flight), offload.d2h/h2d
+# spans ride their own trace track with exposed wait <= 40% of the
+# blocking transfer, the picker chooses "none" when everything fits and
+# never an infeasible or host-over-budget rung, and remat/offload are
+# bit-identical where exactness is claimed. Tier-1-safe: tiny MLPs,
+# CPU, ~a minute.
+#
+# Usage: scripts/remat_smoke.sh [out_dir]
+# The monitor JSONL lands in out_dir (default
+# /tmp/paddle_tpu_remat_smoke); the last stdout line is one JSON
+# result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_remat_smoke}"
+JAX_PLATFORMS=cpu python scripts/remat_smoke.py --out-dir "$OUT_DIR"

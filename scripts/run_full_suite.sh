@@ -108,6 +108,17 @@ bash scripts/mem_smoke.sh "$MONITOR_DIR/mem_smoke"
 mem=$?
 [ $mem -ne 0 ] && rc=$((rc == 0 ? mem : rc))
 
+# memory-plan gate: under a virtual HBM budget, a model 4x past the
+# no-remat ceiling trains under the auto-picked policy (predicted peak
+# under the limit pre-flight), offload spans ride their own track with
+# exposed wait <=40% of the transfer, the picker never chooses an
+# infeasible or host-over-budget rung, remat/offload bit-identical
+echo ""
+echo "-- remat smoke gate --"
+bash scripts/remat_smoke.sh "$MONITOR_DIR/remat_smoke"
+rmt=$?
+[ $rmt -ne 0 ] && rc=$((rc == 0 ? rmt : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)
