@@ -414,6 +414,45 @@ def bench_collective_overlap(timeout_s=600):
     }
 
 
+def bench_serving_degraded(timeout_s=600):
+    """Degraded-serving stage: runs scripts/serving_chaos_smoke.py in a
+    subprocess pinned to 4 virtual CPU devices and banks what the fleet
+    keeps while broken — goodput with 1 of 4 replicas hung mid-load,
+    high-priority goodput under 2x overload, and the hedge overhead
+    (hedged fraction of traffic) paid for the straggler rescue. The
+    sentinel bands the goodputs as floors and the hedge fraction as a
+    ceiling — resilience regressions show up here before they show up
+    in an outage."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "serving_chaos_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_serving_chaos"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"serving_chaos_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    hedge = r["hedge_win"]
+    return {
+        "serving_degraded_goodput": r["hang_failover"]["goodput"],
+        "serving_degraded_high_goodput":
+            r["overload_shed"]["high_goodput"],
+        "serving_degraded_hedge_frac":
+            round(hedge["hedged"] / max(hedge["submitted"], 1), 4),
+        "serving_degraded_failovers": r["hang_failover"]["failovers"],
+        "serving_degraded_shed": r["overload_shed"]["total_shed"],
+    }
+
+
 def bench_fused_optimizer(timeout_s=600):
     """Fused-optimizer stage: runs scripts/arena_smoke.py in a
     subprocess (CPU-pinned — the arena layout and the opt.* byte ledger
@@ -932,6 +971,16 @@ def main():
                         serving_qps=round(sqps, 1),
                         serving_batch_fill=round(sfill, 2))
     _record_stage_compiles("serving")
+    try:
+        sd = bench_serving_degraded()
+    except Exception as e:
+        print(f"serving_degraded bench failed: {type(e).__name__}: {e}",
+              flush=True)
+    else:
+        print(f"partial serving_degraded_goodput="
+              f"{sd['serving_degraded_goodput']} "
+              f"high={sd['serving_degraded_high_goodput']}", flush=True)
+        _RESULTS.update(sd)
     if not args.fast:
         try:
             pipe_ips, loader_ips = bench_resnet_pipeline()

@@ -109,9 +109,27 @@ def render_openmetrics(registry=None):
     return "\n".join(lines) + "\n"
 
 
+def _serving_health():
+    """Per-fleet serving health blocks (lazy: only consulted when the
+    serving tier was actually imported — the telemetry plane must not
+    drag it in). Returns (blocks|None, any_fleet_all_open)."""
+    import sys
+    smulti = sys.modules.get("paddle_tpu.serving.multi")
+    if smulti is None:
+        return None, False
+    try:
+        blocks = smulti.health()
+    except Exception:   # noqa: BLE001 - health must not 500 on a race
+        return None, False
+    if not blocks:
+        return None, False
+    return blocks, any(b.get("all_open") for b in blocks)
+
+
 def health_payload():
     """(http_status, dict) for /healthz: 200 while healthy, 503 while
-    any running watchdog's in-flight step is past its deadline."""
+    any running watchdog's in-flight step is past its deadline OR every
+    replica of a serving fleet's breakers are open (no capacity)."""
     from .. import monitor as _mon
     from ..resilience import guard as _guard
     from ..resilience import watchdog as _watchdog
@@ -119,9 +137,11 @@ def health_payload():
 
     wds = _watchdog.health()
     stalled = any(h.get("stalled") for h in wds)
+    serving, all_open = _serving_health()
     reg = _mon.registry()
     payload = {
-        "status": "stalled" if stalled else "ok",
+        "status": ("stalled" if stalled
+                   else "degraded" if all_open else "ok"),
         "pid": os.getpid(),
         "uptime_s": (round(time.monotonic() - _t_started, 3)
                      if _t_started is not None else None),
@@ -136,7 +156,9 @@ def health_payload():
         },
         "flight_dir": _trace.last_flight(),
     }
-    return (503 if stalled else 200), payload
+    if serving is not None:
+        payload["serving"] = serving
+    return (503 if (stalled or all_open) else 200), payload
 
 
 def snapshot_payload():
@@ -173,6 +195,18 @@ def snapshot_payload():
             memory_block = {"report": summary, "last_oom": oom}
     except Exception:
         memory_block = None
+    # serving block: fleet health + the supervisor's latest verdict —
+    # "why did the fleet change shape?" answered the planner way
+    serving_block = None
+    try:
+        import sys
+        blocks, _ = _serving_health()
+        ssup = sys.modules.get("paddle_tpu.serving.supervisor")
+        decision = ssup.last_decision() if ssup is not None else None
+        if blocks is not None or decision is not None:
+            serving_block = {"fleets": blocks, "last_decision": decision}
+    except Exception:
+        serving_block = None
     return {
         "ts": time.time(),
         "pid": os.getpid(),
@@ -183,6 +217,7 @@ def snapshot_payload():
         "hotspots": _profile.last_summary(),
         "memory": memory_block,
         "planner": planner_block,
+        "serving": serving_block,
         "counters": _mon.snapshot(),
     }
 

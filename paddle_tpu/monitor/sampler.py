@@ -181,6 +181,14 @@ def sample_once(registry=None):
             smetrics.publish_rollups()
         except Exception:
             pass
+    # fleet health gauges (per-replica breaker state, active count) —
+    # same lazy discipline
+    smulti = sys.modules.get("paddle_tpu.serving.multi")
+    if smulti is not None:
+        try:
+            smulti.publish_gauges()
+        except Exception:
+            pass
 
 
 class Sampler:

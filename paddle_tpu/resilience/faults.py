@@ -24,6 +24,18 @@ Well-known kinds (the registry itself is string-keyed and open):
 * ``host_loss``       — raise :class:`HostLossError` in the train loop:
                         ``lost`` devices vanish and the elastic
                         supervisor must resize the mesh and resume
+* ``replica_error``   — raise inside one serving replica's batch
+                        execution (default: a transient error; the
+                        breaker must absorb it)
+* ``replica_hang``    — sleep ``delay`` (default 30s) inside a serving
+                        replica's batch execution — the supervisor must
+                        trip the breaker and fail the batch over
+* ``replica_slow``    — sleep ``delay`` inside a replica's batch
+                        execution (straggler; hedged-request food)
+
+Serving faults target replicas, not steps: pass ``replica=1`` (or a
+list) to :func:`inject` and the spec only fires for that replica id —
+this is how the chaos gate hangs exactly one of four replicas.
 
 Every injection site is behind :func:`enabled` — an empty registry
 costs one truthiness check.
@@ -62,7 +74,7 @@ class FaultSpec:
     devices for ``host_loss``)."""
 
     def __init__(self, kind, step=None, probability=1.0, times=1,
-                 exc=None, delay=0.0, seed=0, lost=1):
+                 exc=None, delay=0.0, seed=0, lost=1, replica=None):
         self.kind = kind
         self.lost = int(lost)
         if step is None:
@@ -71,6 +83,12 @@ class FaultSpec:
             self.steps = frozenset(int(s) for s in step)
         else:
             self.steps = frozenset((int(step),))
+        if replica is None:
+            self.replicas = None
+        elif isinstance(replica, (list, tuple, set, frozenset)):
+            self.replicas = frozenset(int(r) for r in replica)
+        else:
+            self.replicas = frozenset((int(replica),))
         self.probability = float(probability)
         self.times = None if times is None else int(times)
         self.exc = exc
@@ -78,11 +96,14 @@ class FaultSpec:
         self._rng = random.Random(seed)
         self.fired = 0
 
-    def should_fire(self, step):
+    def should_fire(self, step, replica=None):
         if self.times is not None and self.fired >= self.times:
             return False
         if self.steps is not None and (
                 step is None or int(step) not in self.steps):
+            return False
+        if self.replicas is not None and (
+                replica is None or int(replica) not in self.replicas):
             return False
         if self.probability >= 1.0:
             return True
@@ -109,11 +130,12 @@ _specs = {}   # kind -> [FaultSpec]
 
 
 def inject(kind, step=None, probability=1.0, times=1, exc=None,
-           delay=0.0, seed=0, lost=1):
+           delay=0.0, seed=0, lost=1, replica=None):
     """Register a fault. Returns the spec (its ``.fired`` counter is the
     test-side evidence the injection actually happened)."""
     spec = FaultSpec(kind, step=step, probability=probability, times=times,
-                     exc=exc, delay=delay, seed=seed, lost=lost)
+                     exc=exc, delay=delay, seed=seed, lost=lost,
+                     replica=replica)
     with _lock:
         _specs.setdefault(kind, []).append(spec)
     return spec
@@ -134,7 +156,7 @@ def enabled():
     return bool(_specs)
 
 
-def fire(kind, step=None):
+def fire(kind, step=None, replica=None):
     """Consume one firing of `kind` at `step` if a spec matches.
     Returns the spec (or None). Emits ``resilience.fault_injected``."""
     specs = _specs.get(kind)
@@ -142,29 +164,45 @@ def fire(kind, step=None):
         return None
     with _lock:
         for spec in specs:
-            if spec.should_fire(step):
+            if spec.should_fire(step, replica=replica):
                 spec.fired += 1
                 record("fault_injected", fault=kind, step=step,
-                       fire=spec.fired)
+                       replica=replica, fire=spec.fired)
                 return spec
     return None
 
 
-def maybe_raise(kind, step=None):
+def maybe_raise(kind, step=None, replica=None):
     """Raise the spec's exception if a `kind` fault fires at `step`."""
-    spec = fire(kind, step)
+    spec = fire(kind, step, replica=replica)
     if spec is not None:
         raise spec.make_exc()
 
 
-def maybe_sleep(kind, step=None):
+def maybe_sleep(kind, step=None, replica=None):
     """Sleep the spec's ``delay`` if a `kind` fault fires at `step`
     (slow-step simulation). Returns True when it slept."""
-    spec = fire(kind, step)
+    spec = fire(kind, step, replica=replica)
     if spec is not None and spec.delay > 0:
         time.sleep(spec.delay)
         return True
     return spec is not None
+
+
+def maybe_serving_fault(replica, step=None):
+    """The one injection site inside a serving replica's batch
+    execution: ``replica_error`` raises, ``replica_hang`` sleeps a long
+    default (30s — long enough that only supervision, never patience,
+    resolves it), ``replica_slow`` sleeps its ``delay`` (straggler)."""
+    spec = fire("replica_error", step, replica=replica)
+    if spec is not None:
+        raise spec.make_exc()
+    spec = fire("replica_hang", step, replica=replica)
+    if spec is not None:
+        time.sleep(spec.delay if spec.delay > 0 else 30.0)
+    spec = fire("replica_slow", step, replica=replica)
+    if spec is not None and spec.delay > 0:
+        time.sleep(spec.delay)
 
 
 def garble_file(path, nbytes=16, seed=0):

@@ -110,12 +110,19 @@ def retry_call(fn, *args, policy=None, label="", on_retry=None, **kwargs):
                    attempt=attempt + 1, error=repr(e))
             if on_retry is not None:
                 on_retry(e, attempt)
+            d = policy.delay(attempt)
+            # a server-side shed hint (serving.ShedError.retry_after_s)
+            # floors the backoff: the endpoint told us when it expects
+            # capacity, sleeping less just feeds the ladder
+            ra = getattr(e, "retry_after_s", None)
+            if ra is not None:
+                d = max(d, float(ra))
             from .. import monitor as _monitor
             with _monitor.trace.span(
                     "resilience.backoff",
                     where=label or getattr(fn, "__name__", "call"),
                     attempt=attempt + 1):
-                time.sleep(policy.delay(attempt))
+                time.sleep(d)
     raise RetryExhausted(
         f"{label or getattr(fn, '__name__', 'call')}: "
         f"{policy.max_attempts} attempts exhausted (last: {last!r})"

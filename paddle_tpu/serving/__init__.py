@@ -25,7 +25,16 @@ traffic into them.
   gauge / histogram series + ``serving.{enqueue,batch_assemble,
   execute,scatter}`` trace spans
 * :mod:`~paddle_tpu.serving.multi`     — :class:`MultiDeviceEngine`:
-  round-robin fan-out over per-device state replicas
+  health-aware fan-out over per-device state replicas, with per-replica
+  circuit breakers, hedged stragglers, and failover re-dispatch
+* :mod:`~paddle_tpu.serving.breaker`   — the three-state
+  :class:`CircuitBreaker` (closed → open → half_open) each replica
+  carries
+* :mod:`~paddle_tpu.serving.supervisor` — :class:`ServingSupervisor`:
+  the closed control loop turning heartbeats + the live ``slo.*``
+  window into failover / probe / restart / scale decisions
+
+See docs/robustness.md ("Self-healing serving") for the failure model.
 
 Quickstart::
 
@@ -46,16 +55,24 @@ from __future__ import annotations
 from . import batcher  # noqa: F401
 from . import admission  # noqa: F401
 from . import metrics  # noqa: F401
+from . import breaker  # noqa: F401
 from . import engine  # noqa: F401
 from . import multi  # noqa: F401
+from . import supervisor  # noqa: F401
 from .admission import (AdmissionController, QueueFullError,  # noqa: F401
-                        DeadlineExpired)
+                        DeadlineExpired, ShedError, PRIORITIES)
 from .batcher import DynamicBatcher, Request  # noqa: F401
+from .breaker import CircuitBreaker  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
-from .multi import MultiDeviceEngine, replicate  # noqa: F401
+from .multi import (MultiDeviceEngine, NoHealthyReplicaError,  # noqa: F401
+                    replicate)
+from .supervisor import ServingSupervisor  # noqa: F401
 
 __all__ = [
-    "batcher", "admission", "metrics", "engine", "multi",
+    "batcher", "admission", "metrics", "engine", "multi", "breaker",
+    "supervisor",
     "ServingEngine", "MultiDeviceEngine", "replicate", "DynamicBatcher",
     "Request", "AdmissionController", "QueueFullError", "DeadlineExpired",
+    "ShedError", "PRIORITIES", "CircuitBreaker", "NoHealthyReplicaError",
+    "ServingSupervisor",
 ]

@@ -40,14 +40,15 @@ class Request:
     deadline. Created by ``ServingEngine.submit``."""
 
     __slots__ = ("inputs", "n", "signature", "future", "deadline",
-                 "t_enqueue")
+                 "t_enqueue", "priority")
 
-    def __init__(self, inputs, n, signature, deadline=None):
+    def __init__(self, inputs, n, signature, deadline=None, priority=1):
         self.inputs = inputs              # tuple of host arrays
         self.n = int(n)                   # rows along the batch axis
         self.signature = signature        # per-example (shape, dtype) tuple
         self.future = concurrent.futures.Future()
         self.deadline = deadline
+        self.priority = int(priority)     # admission.PRIORITIES rank
         self.t_enqueue = time.monotonic()
 
     def age(self, now=None):
@@ -88,6 +89,11 @@ class DynamicBatcher:
         self._closed = False      # no further submits
         self._draining = False
         self._thread = None
+        # the group currently inside _process (supervision + the
+        # close(drain=False) no-stranded-future guarantee)
+        self._inflight = []
+        self._inflight_t0 = None
+        self._last_progress = time.monotonic()
 
     # -- producer side ----------------------------------------------------
 
@@ -111,6 +117,67 @@ class DynamicBatcher:
         with self._lock:
             return len(self._queue)
 
+    # -- supervision hooks ------------------------------------------------
+
+    def inflight_age(self, now=None):
+        """Seconds the current in-flight group has been inside
+        ``process`` (None when idle) — the supervisor's hang signal."""
+        with self._lock:
+            t0 = self._inflight_t0
+        if t0 is None:
+            return None
+        return (now if now is not None else time.monotonic()) - t0
+
+    def inflight_token(self):
+        """Opaque identity of the current in-flight dispatch (None when
+        idle). The supervisor keys its one-failover-per-dispatch rule on
+        this so a still-hung batch isn't failed over twice."""
+        with self._lock:
+            return self._inflight_t0
+
+    def last_progress_age(self, now=None):
+        with self._lock:
+            t = self._last_progress
+        return (now if now is not None else time.monotonic()) - t
+
+    def steal_pending(self):
+        """Take every queued (not yet dispatched) request — failover
+        moves them to a healthy replica without re-admission."""
+        with self._lock:
+            taken = list(self._queue)
+            self._queue.clear()
+            metrics.record_queue_depth(0)
+        return taken
+
+    def disown_inflight(self):
+        """Take ownership of the currently dispatched group (failover:
+        the requests will be re-run elsewhere; first resolution wins
+        because Request resolution is idempotent). After this, neither
+        the worker's failure path nor close() touches their futures."""
+        with self._lock:
+            taken = list(self._inflight)
+            self._inflight = []
+        return taken
+
+    def requeue(self, requests):
+        """Front-of-queue insert of already-admitted requests (failover
+        re-dispatch). Bypasses admission — these requests already paid
+        it on their original replica; shedding them now would turn a
+        replica fault into caller-visible errors."""
+        if not requests:
+            return
+        with self._cond:
+            if self._closed:
+                for r in requests:
+                    r.resolve_exception(
+                        RuntimeError("serving engine closed"))
+                return
+            for r in reversed(requests):
+                self._queue.appendleft(r)
+            depth = len(self._queue)
+            self._cond.notify()
+        metrics.record_queue_depth(depth)
+
     # -- lifecycle --------------------------------------------------------
 
     def start(self):
@@ -127,8 +194,12 @@ class DynamicBatcher:
         """Stop accepting work and stop the drain thread. With
         ``drain=True`` (default) queued requests are flushed first;
         anything still queued afterwards (``drain=False``, or no thread
-        ever started) fails with RuntimeError — a future is never
-        silently lost."""
+        ever started) fails with RuntimeError. If the drain thread is
+        wedged inside ``process`` (a hung replica) the join times out
+        and the *dispatched* group's unresolved futures fail too — a
+        future is never silently lost, even when its executor never
+        comes back. Disowned in-flight requests (failover took them)
+        are someone else's to resolve and are left alone."""
         with self._cond:
             if self._closed:
                 return
@@ -138,12 +209,22 @@ class DynamicBatcher:
             self._cond.notify_all()
         t = self._thread
         if t is not None and t is not threading.current_thread():
+            # a hung process() would otherwise hold close() forever;
+            # drain=False is the "replica is dead, get out" path, so it
+            # always gets a bounded join
+            if timeout is None and not drain:
+                timeout = 5.0
             t.join(timeout)
         with self._lock:
             leftovers = list(self._queue)
             self._queue.clear()
+            stranded = [r for r in self._inflight if not r.future.done()]
         for r in leftovers:
             r.resolve_exception(RuntimeError("serving engine closed"))
+        for r in stranded:
+            r.resolve_exception(RuntimeError(
+                "serving engine closed with the request still dispatched "
+                "(replica hung or died mid-batch)"))
 
     # -- drain thread -----------------------------------------------------
 
@@ -153,9 +234,28 @@ class DynamicBatcher:
             for r in expired:
                 self._admission.expire(r)
             if group:
-                with _monitor.trace.span("serving.batch",
-                                         requests=len(group)):
-                    self._process(group)
+                with self._lock:
+                    self._inflight = group
+                    self._inflight_t0 = time.monotonic()
+                try:
+                    with _monitor.trace.span("serving.batch",
+                                             requests=len(group)):
+                        self._process(group)
+                except BaseException as e:  # noqa: BLE001 - to futures
+                    # process() resolves its own failures; this is the
+                    # belt-and-braces path for an unexpected escape, so
+                    # the group can never strand. Disowned requests
+                    # (failover took them mid-dispatch) are excluded —
+                    # they'll resolve on their new replica.
+                    with self._lock:
+                        owned = list(self._inflight)
+                    for r in owned:
+                        r.resolve_exception(e)
+                finally:
+                    with self._lock:
+                        self._inflight = []
+                        self._inflight_t0 = None
+                        self._last_progress = time.monotonic()
                 continue
             with self._cond:
                 if not self._running:
@@ -190,11 +290,20 @@ class DynamicBatcher:
 
             head = self._queue[0]
             sig = head.signature
+            # overload shrinks the largest batch the picker may build
+            # (admission ladder rung 2+) so service latency stays
+            # bounded while the queue is deep
+            cap = self._admission.effective_max_batch(
+                self.max_batch, len(self._queue)) \
+                if hasattr(self._admission, "effective_max_batch") \
+                else self.max_batch
             cand, rows, overflow = [], 0, False
             for r in self._queue:
                 if r.signature != sig:
                     continue
-                if rows + r.n > self.max_batch:
+                # the head is always taken even if it alone exceeds a
+                # shrunken cap — progress must not depend on the cap
+                if cand and rows + r.n > cap:
                     # keep FIFO within a signature: stop rather than
                     # skip-fill with later, smaller requests
                     overflow = True
@@ -202,7 +311,7 @@ class DynamicBatcher:
                 cand.append(r)
                 rows += r.n
 
-            flush_now = (overflow or rows >= self.max_batch
+            flush_now = (overflow or rows >= cap
                          or head.age(now) >= self.timeout_s
                          or self._draining or not self._running)
             if not flush_now:

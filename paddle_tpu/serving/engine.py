@@ -28,8 +28,9 @@ import numpy as np
 
 from .. import monitor as _monitor
 from ..io.bucketing import next_bucket, pad_to_bucket, split_rows
+from ..resilience import faults as _faults
 from ..tensor import Tensor
-from .admission import AdmissionController
+from .admission import AdmissionController, resolve_priority
 from .batcher import DynamicBatcher, Request
 from . import metrics
 
@@ -81,8 +82,16 @@ class ServingEngine:
 
     def __init__(self, predictor, buckets=None, max_batch=32,
                  timeout_ms=5.0, queue_depth=256, deadline_ms=None,
-                 retry_policy=None, start=True, metrics_port=None):
+                 retry_policy=None, start=True, metrics_port=None,
+                 replica_id=None, on_outcome=None, shed=True,
+                 slo_goodput_floor=0.90):
         self.predictor = predictor
+        # identity inside a MultiDeviceEngine fleet (fault targeting,
+        # breaker gauges); None for a standalone engine
+        self.replica_id = replica_id
+        # breaker feedback: called with (ok: bool, exc|None) after each
+        # batch execution attempt settles
+        self.on_outcome = on_outcome
         self.max_batch = int(max_batch)
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -98,16 +107,22 @@ class ServingEngine:
         self.admission = AdmissionController(
             max_queue_depth=queue_depth,
             default_deadline_ms=deadline_ms,
-            retry_policy=retry_policy)
+            retry_policy=retry_policy, shed=shed,
+            slo_goodput_floor=slo_goodput_floor)
         self.admission.on_event = self._admission_event
         self._batcher = DynamicBatcher(
             self._process, self.admission,
             max_batch=self.max_batch, timeout_ms=timeout_ms)
         self._stats_lock = threading.Lock()
         self._stats = {"submitted": 0, "completed": 0, "failed": 0,
-                       "rejected": 0, "expired": 0, "batches": 0,
-                       "coalesced_rows": 0, "padded_rows": 0,
-                       "compiles": 0, "retries": 0, "isolated": 0}
+                       "rejected": 0, "expired": 0, "shed": 0,
+                       "batches": 0, "coalesced_rows": 0,
+                       "padded_rows": 0, "compiles": 0, "retries": 0,
+                       "isolated": 0}
+        # a 1-row copy of the first submit's inputs: the supervisor's
+        # half-open probe replays it as budgeted test traffic
+        self._probe_template = None
+        self._last_ok_t = time.monotonic()
         # live-telemetry wiring: the sampler republishes this engine's
         # queue depth each tick (a gauge set only at enqueue/dequeue
         # edges goes stale the moment traffic stops), weakly so an
@@ -133,13 +148,12 @@ class ServingEngine:
 
     # -- client surface ---------------------------------------------------
 
-    def submit(self, *inputs, deadline_ms=None):
-        """Enqueue one request (each input shaped ``(n, ...)``, all with
-        the same leading ``n <= max_batch``); returns a
-        ``concurrent.futures.Future`` resolving to what
-        ``Predictor.run`` on the same inputs returns. Raises
-        ``QueueFullError`` when the queue is at depth, ``ValueError``
-        on malformed inputs."""
+    def make_request(self, inputs, deadline_ms=None, priority=None):
+        """Validate + canonicalize one submit's inputs into a
+        ``Request`` (not yet enqueued — ``MultiDeviceEngine`` builds
+        the request once, then picks which replica's
+        :meth:`submit_request` gets it). Raises ``ValueError`` on
+        malformed inputs."""
         if not inputs:
             raise ValueError("submit() needs at least one input array")
         arrays = tuple(_as_host_array(x) for x in inputs)
@@ -161,17 +175,37 @@ class ServingEngine:
         deadline = (Deadline.after_ms(deadline_ms)
                     if deadline_ms is not None else None)
         sig = tuple((a.shape[1:], str(a.dtype)) for a in arrays)
-        req = Request(arrays, n, sig, deadline=deadline)
-        with _monitor.trace.span("serving.enqueue", rows=n):
+        return Request(arrays, n, sig, deadline=deadline,
+                       priority=resolve_priority(priority))
+
+    def submit_request(self, req):
+        """Enqueue an already-built ``Request``; returns its future.
+        Raises ``ShedError`` / ``QueueFullError`` from admission."""
+        if self._probe_template is None:
+            self._probe_template = tuple(a[:1].copy() for a in req.inputs)
+        with _monitor.trace.span("serving.enqueue", rows=req.n):
             fut = self._batcher.submit(req)
         with self._stats_lock:
             self._stats["submitted"] += 1
         return fut
 
-    def run(self, *inputs, deadline_ms=None, timeout=None):
+    def submit(self, *inputs, deadline_ms=None, priority=None):
+        """Enqueue one request (each input shaped ``(n, ...)``, all with
+        the same leading ``n <= max_batch``); returns a
+        ``concurrent.futures.Future`` resolving to what
+        ``Predictor.run`` on the same inputs returns. ``priority`` is
+        'high'/'normal'/'low' (default 'normal') — under overload the
+        admission ladder sheds low classes first. Raises ``ShedError``
+        / ``QueueFullError`` under overload, ``ValueError`` on
+        malformed inputs."""
+        return self.submit_request(self.make_request(
+            inputs, deadline_ms=deadline_ms, priority=priority))
+
+    def run(self, *inputs, deadline_ms=None, timeout=None, priority=None):
         """Blocking submit: enqueue, wait, return the outputs (or raise
         what the request's future raised)."""
-        return self.submit(*inputs, deadline_ms=deadline_ms).result(timeout)
+        return self.submit(*inputs, deadline_ms=deadline_ms,
+                           priority=priority).result(timeout)
 
     def warmup(self, *signatures):
         """AOT-compile every (bucket, signature) pair. Each signature is
@@ -193,6 +227,13 @@ class ServingEngine:
                 for b in self.buckets:
                     self.predictor.warmup(
                         [((b,) + shape, dtype) for shape, dtype in norm])
+                if self._probe_template is None and norm:
+                    # a freshly (re)started replica has served nothing:
+                    # synthesize probe input from the warmup signature so
+                    # the supervisor can still test it back to health
+                    self._probe_template = tuple(
+                        np.zeros((1,) + shape, dtype=dtype)
+                        for shape, dtype in norm)
         fresh = len(self.predictor._compiled) - before
         if fresh:
             metrics.record_compiles(fresh)
@@ -215,9 +256,77 @@ class ServingEngine:
     def __exit__(self, *exc):
         self.close()
 
+    # -- supervision surface ----------------------------------------------
+
+    def heartbeat(self, now=None):
+        """Liveness signals for the ``ServingSupervisor``: queue depth,
+        whether a batch is currently dispatched and for how long, time
+        since the drain thread last made progress, and time since the
+        last successful batch."""
+        now = time.monotonic() if now is None else now
+        return {
+            "queue_depth": self._batcher.depth(),
+            "inflight_age_s": self._batcher.inflight_age(now),
+            "inflight_token": self._batcher.inflight_token(),
+            "last_progress_age_s": self._batcher.last_progress_age(now),
+            "last_ok_age_s": now - self._last_ok_t,
+        }
+
+    def probe(self, timeout_s=1.0):
+        """Half-open test traffic: replay a 1-row copy of real input
+        through the full assemble→execute path on a side thread (the
+        drain thread may be wedged — that's exactly what we're probing)
+        and report whether it finished in time. No future, no queue:
+        the probe must not compete with, or be blocked by, real work."""
+        template = self._probe_template
+        if template is None:
+            return None     # nothing served yet — nothing to replay
+        done = threading.Event()
+        err = []
+
+        def _go():
+            try:
+                sig = tuple((a.shape[1:], str(a.dtype)) for a in template)
+                req = Request(tuple(a.copy() for a in template), 1, sig)
+                arrays, _real, _bucket = self._assemble([req])
+                self._run_batch(arrays)
+            except BaseException as e:  # noqa: BLE001 - probe verdict
+                err.append(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=_go, daemon=True,
+                         name="paddle_tpu-serving-probe").start()
+        ok = done.wait(timeout_s) and not err
+        if ok:
+            self._last_ok_t = time.monotonic()
+        return bool(ok)
+
+    def steal_pending(self):
+        """Failover: hand every queued request to the caller."""
+        return self._batcher.steal_pending()
+
+    def disown_inflight(self):
+        """Failover: hand over the currently dispatched group."""
+        return self._batcher.disown_inflight()
+
+    def requeue(self, requests):
+        """Failover: accept already-admitted requests at queue front."""
+        self._batcher.requeue(requests)
+
+    def _note_outcome(self, ok, exc=None):
+        if ok:
+            self._last_ok_t = time.monotonic()
+        cb = self.on_outcome
+        if cb is not None:
+            try:
+                cb(ok, exc)
+            except Exception:   # noqa: BLE001 - observer must not kill
+                pass            # the drain thread
+
     def _admission_event(self, event):
         key = {"rejected": "rejected", "expired": "expired",
-               "poisoned": "failed"}.get(event)
+               "poisoned": "failed", "shed": "shed"}.get(event)
         if key is not None:
             with self._stats_lock:
                 self._stats[key] += 1
@@ -273,6 +382,11 @@ class ServingEngine:
         outputs plus whether the model is multi-output. Counts fresh
         executables into ``serving.compiles`` (zero in steady state)."""
         before = len(self.predictor._compiled)
+        if _faults.enabled():
+            # the chaos gate's injection site: replica_error raises,
+            # replica_hang/replica_slow stall right where a wedged
+            # device runtime would
+            _faults.maybe_serving_fault(self.replica_id)
         with _monitor.trace.span("serving.execute",
                                  rows=int(arrays[0].shape[0])):
             out = self.predictor.run_device(*arrays)
@@ -292,8 +406,11 @@ class ServingEngine:
         attempt = 0
         while True:
             try:
-                return self._run_batch(arrays)
+                out = self._run_batch(arrays)
+                self._note_outcome(True)
+                return out
             except BaseException as e:  # noqa: BLE001 - triaged below
+                self._note_outcome(False, e)
                 if policy.is_transient(e) \
                         and attempt + 1 < policy.max_attempts:
                     metrics.record_retry(where="serving.execute")

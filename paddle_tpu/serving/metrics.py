@@ -26,6 +26,22 @@ the serving pipeline shows up as:
   transient batch retries, batches re-run request-by-request after a
   terminal failure, and the requests that individually failed
 
+Resilience series (the self-healing layer):
+
+* ``serving.shed`` — requests shed by the admission ladder (below the
+  top-rung ``serving.rejected``); ``serving.shed_level`` gauge is the
+  ladder rung currently in force
+* ``serving.breaker_state.<replica>`` — per-replica breaker gauge
+  (0 = closed, 1 = half_open, 2 = open); ``serving.breaker_open`` /
+  ``serving.breaker_closed`` count the transitions
+* ``serving.hedged`` / ``serving.hedge_wins`` — straggler re-dispatches
+  and how many beat the primary
+* ``serving.failover`` — batches re-dispatched off a tripped replica
+* ``serving.replica_hung`` / ``serving.replica_restarts`` — supervision
+  verdicts and the restarts they caused
+* ``serving.active_replicas`` — gauge, replicas currently taking
+  traffic (the supervisor's scaling output)
+
 SLO rollups (published by the telemetry sampler via
 :func:`publish_rollups`, rolling :data:`SLO_WINDOW_S` window):
 
@@ -236,3 +252,95 @@ def record_poisoned(error=""):
     if _monitor.enabled():
         _monitor.counter("serving.poisoned").inc()
         _monitor.emit(kind="serving", event="poisoned", error=error)
+
+
+def goodput_window(now=None):
+    """Cheap read of the slo window for control loops: (goodput|None,
+    submitted). Unlike :func:`slo_rollup` this publishes nothing and
+    skips the latency sort — it's called from the admission hot path.
+    The window only fills while the monitor is enabled, so SLO-driven
+    shedding (like the rest of the slo plane) needs ``monitor.enable()``."""
+    now = time.monotonic() if now is None else now
+    with _slo_lock:
+        _sweep(_slo_submits, now, SLO_WINDOW_S, key=lambda t: t)
+        _sweep(_slo_done, now, SLO_WINDOW_S)
+        submitted = len(_slo_submits)
+        ok = sum(1 for _, _, w in _slo_done if w)
+    return ((ok / submitted) if submitted else None), submitted
+
+
+# -- resilience series ------------------------------------------------------
+
+_BREAKER_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
+
+
+def record_shed(priority, level, retry_after_ms):
+    if _monitor.enabled():
+        _monitor.counter("serving.shed").inc()
+        _monitor.gauge("serving.shed_level").set(int(level))
+        _monitor.emit(kind="serving", event="shed", priority=priority,
+                      level=int(level), retry_after_ms=float(retry_after_ms))
+
+
+def record_shed_level(level):
+    if _monitor.enabled():
+        _monitor.gauge("serving.shed_level").set(int(level))
+
+
+def record_breaker_transition(name, old, new, reason=""):
+    if _monitor.enabled():
+        _monitor.gauge(f"serving.breaker_state.{name}").set(
+            _BREAKER_STATE_NUM.get(new, -1))
+        if new == "open":
+            _monitor.counter("serving.breaker_open").inc()
+        elif new == "closed":
+            _monitor.counter("serving.breaker_closed").inc()
+        _monitor.emit(kind="serving", event="breaker", name=name,
+                      old=old, new=new, reason=reason)
+
+
+def record_hedge(replica=None):
+    if _monitor.enabled():
+        _monitor.counter("serving.hedged").inc()
+        _monitor.emit(kind="serving", event="hedged", replica=replica)
+
+
+def record_hedge_win(replica=None):
+    if _monitor.enabled():
+        _monitor.counter("serving.hedge_wins").inc()
+        _monitor.emit(kind="serving", event="hedge_win", replica=replica)
+
+
+def record_failover(replica, n_requests):
+    if _monitor.enabled():
+        _monitor.counter("serving.failover").inc()
+        _monitor.emit(kind="serving", event="failover", replica=replica,
+                      requests=int(n_requests))
+
+
+def record_replica_hung(replica, age_s):
+    if _monitor.enabled():
+        _monitor.counter("serving.replica_hung").inc()
+        _monitor.emit(kind="serving", event="replica_hung",
+                      replica=replica, inflight_age_s=round(float(age_s), 3))
+
+
+def record_replica_restart(replica):
+    if _monitor.enabled():
+        _monitor.counter("serving.replica_restarts").inc()
+        _monitor.emit(kind="serving", event="replica_restart",
+                      replica=replica)
+
+
+def record_active_replicas(n):
+    if _monitor.enabled():
+        _monitor.gauge("serving.active_replicas").set(int(n))
+
+
+def record_supervisor(decision, **fields):
+    """Planner-style decision record: a ledger event the monitor JSONL
+    (and /snapshot) can replay to explain why the fleet changed shape."""
+    if _monitor.enabled():
+        _monitor.counter("serving.supervisor_decisions").inc()
+        _monitor.emit(kind="serving", event="supervisor",
+                      decision=decision, **fields)

@@ -1,24 +1,66 @@
-"""paddle_tpu.serving.multi — data-parallel replica fan-out.
+"""paddle_tpu.serving.multi — self-healing data-parallel replica fan-out.
 
 A multi-chip inference host serves best as N independent replicas, not
 one sharded model: each device holds a full copy of the state
 (``jax.device_put`` — the serving analogue of data parallelism), runs
-its own dynamic batcher, and a round-robin front door spreads request
-streams across them. No collectives on the request path, so per-replica
-latency is identical to single-device serving and aggregate QPS scales
-with chip count until the host-side queue becomes the bottleneck.
+its own dynamic batcher, and the front door spreads request streams
+across them. No collectives on the request path, so per-replica latency
+is identical to single-device serving and aggregate QPS scales with
+chip count until the host-side queue becomes the bottleneck.
+
+Blind round-robin dies with its first dead replica (every Nth request
+stalls), so routing is **health-aware**:
+
+* each replica carries a :class:`~paddle_tpu.serving.breaker.
+  CircuitBreaker` fed by batch outcomes and supervision verdicts;
+  requests route only to replicas whose breaker allows them, and a
+  fleet with no healthy replica fast-rejects with the retryable
+  :class:`NoHealthyReplicaError` rather than queueing onto a corpse;
+* a :class:`~paddle_tpu.serving.supervisor.ServingSupervisor` watches
+  per-replica heartbeats, trips the breaker on a hung dispatch, moves
+  that replica's queued *and* in-flight requests to healthy peers
+  (failover — safe because ``Request`` resolution is idempotent:
+  whichever dispatch finishes first wins, the loser's resolution is
+  swallowed), probes half-open breakers with budgeted test traffic,
+  restarts replicas that stay dead, and scales the active set from the
+  live ``slo.*`` window;
+* stragglers are **hedged**: a request still unresolved after the hedge
+  delay (p99-derived by default) is re-dispatched to a second healthy
+  replica and the first result wins, with total hedges capped at
+  ``hedge_budget`` of traffic so the cure can't out-eat the disease.
 
 :func:`replicate` is the state mechanic (one Predictor view per device,
 sharing the model object, with a per-device executable cache);
-:class:`MultiDeviceEngine` is the operational wrapper (one
-``ServingEngine`` per replica + the round-robin ``submit``).
+:class:`MultiDeviceEngine` is the operational wrapper.
 """
 from __future__ import annotations
 
 import copy
+import heapq
 import threading
+import time
+import weakref
 
+import concurrent.futures
+
+from .admission import ShedError
+from .breaker import CircuitBreaker
 from .engine import ServingEngine
+from . import metrics
+
+#: live MultiDeviceEngines — /healthz walks this (weak: an un-closed
+#: engine can still be collected)
+_ACTIVE = weakref.WeakSet()
+
+#: floor on the auto hedge delay: below this, hedges fire on normal
+#: scheduling jitter and burn the budget on non-stragglers
+MIN_HEDGE_S = 0.025
+
+
+class NoHealthyReplicaError(ShedError):
+    """Every replica's breaker is open (or routing-excluded): there is
+    no capacity to take this request right now. Transient — the breaker
+    cooldown is exactly a retry-after."""
 
 
 def replicate(predictor, devices=None):
@@ -41,43 +83,390 @@ def replicate(predictor, devices=None):
     return replicas
 
 
-class MultiDeviceEngine:
-    """Round-robin fan-out over per-device :class:`ServingEngine`
-    replicas. Same client surface (``submit``/``run``/``warmup``/
-    ``stats``/context manager); engine kwargs apply per replica, so
-    ``queue_depth`` and ``max_batch`` are per-device limits."""
+class _Replica:
+    """One slot in the fleet: device + predictor + engine + breaker +
+    routing flag, plus the supervision tokens that make hang handling
+    exactly-once per dispatch."""
 
-    def __init__(self, predictor, devices=None, **engine_kwargs):
-        self.replicas = replicate(predictor, devices)
-        self.engines = [ServingEngine(p, **engine_kwargs)
-                        for p in self.replicas]
+    def __init__(self, index, device, predictor, engine, breaker,
+                 active=True):
+        self.index = index
+        self.device = device
+        self.predictor = predictor
+        self.engine = engine
+        self.breaker = breaker
+        self.active = active
+        self.handled_token = None    # last in-flight dispatch failed over
+        self.restart_token = None    # last in-flight dispatch restarted on
+        self.restarts = 0
+
+
+class _Hedger(threading.Thread):
+    """Deadline heap + daemon thread: ``schedule`` arms a hedge timer
+    per request; when it fires and the request is still unresolved, the
+    owner re-dispatches it to a second replica."""
+
+    def __init__(self, owner):
+        super().__init__(name="paddle_tpu-serving-hedger", daemon=True)
+        self._owner = weakref.ref(owner)
+        self._cond = threading.Condition()
+        self._heap = []
+        self._seq = 0
+        self._stop = False
+
+    def schedule(self, request, primary_index, delay_s):
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(self._heap,
+                           (time.monotonic() + delay_s, self._seq,
+                            request, primary_index))
+            self._cond.notify()
+
+    def stop(self):
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+
+    def run(self):
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                if not self._heap:
+                    self._cond.wait(0.1)
+                    continue
+                due = self._heap[0][0]
+                now = time.monotonic()
+                if due > now:
+                    self._cond.wait(min(due - now, 0.1))
+                    continue
+                _, _, req, primary = heapq.heappop(self._heap)
+            owner = self._owner()
+            if owner is None:
+                return
+            try:
+                owner._maybe_hedge(req, primary)
+            except Exception:   # noqa: BLE001 - hedging is best-effort;
+                pass            # the primary dispatch still owns the future
+
+
+class MultiDeviceEngine:
+    """Health-aware fan-out over per-device :class:`ServingEngine`
+    replicas. Same client surface as v1 (``submit``/``run``/``warmup``/
+    ``stats``/context manager); engine kwargs apply per replica, so
+    ``queue_depth`` and ``max_batch`` are per-device limits.
+
+    Resilience knobs (see docs/serving.md for the full matrix):
+
+    hedge_ms : straggler hedge delay. ``None`` (default) derives it
+        from the live ``slo.p99_ms`` window (floored at 25ms); a number
+        fixes it; ``0``/``False`` disables hedging.
+    hedge_budget : max fraction of submitted traffic that may be
+        hedged (default 0.05).
+    breaker_threshold / breaker_cooldown_s / half_open_probes :
+        per-replica :class:`CircuitBreaker` tuning.
+    inflight_timeout_ms : a dispatch older than this is declared hung —
+        breaker trips, batch fails over. ``None`` defaults to 4× the
+        engine ``deadline_ms`` when set, else 2000ms.
+    supervise : run the :class:`ServingSupervisor` control loop
+        (default True; tests drive ticks manually with False).
+    min_replicas / initial_active : scaling bounds — the supervisor
+        never deactivates below ``min_replicas``; ``initial_active``
+        starts the fleet smaller than the device count and lets the
+        goodput floor scale it up.
+    """
+
+    def __init__(self, predictor, devices=None, hedge_ms=None,
+                 hedge_budget=0.05, breaker_threshold=3,
+                 breaker_cooldown_s=2.0, half_open_probes=1,
+                 inflight_timeout_ms=None, supervise=True,
+                 supervisor_interval_s=0.25, min_replicas=1,
+                 initial_active=None, restart_after_s=None,
+                 **engine_kwargs):
+        self.predictor = predictor
+        self._engine_kwargs = dict(engine_kwargs)
+        self._breaker_kwargs = dict(
+            failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+            half_open_probes=half_open_probes)
+        preds = replicate(predictor, devices)
+        self._replicas = []
+        for i, p in enumerate(preds):
+            self._replicas.append(self._make_replica(i, p))
+        if initial_active is not None:
+            for r in self._replicas[int(initial_active):]:
+                r.active = False
+        self.min_replicas = max(1, int(min_replicas))
         self._rr_lock = threading.Lock()
         self._rr = 0
+        # hedging
+        if hedge_ms is None:
+            self._hedge_fixed = None
+            self._hedge_delay_s = 2 * MIN_HEDGE_S   # until p99 exists
+        elif not hedge_ms:                          # 0 / False
+            self._hedge_fixed = 0.0
+            self._hedge_delay_s = 0.0
+        else:
+            self._hedge_fixed = float(hedge_ms) / 1e3
+            self._hedge_delay_s = self._hedge_fixed
+        self.hedge_budget = float(hedge_budget)
+        self._hedge_lock = threading.Lock()
+        self._submitted = 0
+        self._hedged = 0
+        self._hedge_wins = 0
+        self._failovers = 0
+        self._hedger = None
+        if self._hedge_delay_s or self._hedge_fixed is None:
+            self._hedger = _Hedger(self)
+            self._hedger.start()
+        # supervision
+        if inflight_timeout_ms is None:
+            dl = engine_kwargs.get("deadline_ms")
+            inflight_timeout_ms = 4 * dl if dl else 2000.0
+        self.inflight_timeout_s = float(inflight_timeout_ms) / 1e3
+        self._warm_sigs = ()
+        self.supervisor = None
+        if supervise:
+            from .supervisor import ServingSupervisor
+            self.supervisor = ServingSupervisor(
+                self, interval_s=supervisor_interval_s,
+                restart_after_s=restart_after_s)
+        _ACTIVE.add(self)
+        metrics.record_active_replicas(
+            sum(1 for r in self._replicas if r.active))
 
-    def _next_engine(self):
+    def _make_replica(self, index, predictor):
+        breaker = CircuitBreaker(name=str(index), **self._breaker_kwargs)
+
+        def _outcome(ok, exc, _b=breaker):
+            if ok:
+                _b.record_success()
+            else:
+                _b.record_failure(repr(exc))
+
+        engine = ServingEngine(predictor, replica_id=index,
+                               on_outcome=_outcome, **self._engine_kwargs)
+        return _Replica(index, getattr(predictor, "device", None),
+                        predictor, engine, breaker)
+
+    # -- compat views ------------------------------------------------------
+
+    @property
+    def engines(self):
+        return [r.engine for r in self._replicas]
+
+    @property
+    def replicas(self):
+        return [r.predictor for r in self._replicas]
+
+    # -- routing -----------------------------------------------------------
+
+    def _pick_replica(self, exclude=()):
+        """Next active replica whose breaker admits traffic, round-robin
+        from the cursor. ``allow()`` on a half-open breaker consumes one
+        probe slot — it's only called on replicas actually considered.
+        Raises :class:`NoHealthyReplicaError` when nobody can take it."""
         with self._rr_lock:
-            e = self.engines[self._rr]
-            self._rr = (self._rr + 1) % len(self.engines)
-        return e
+            n = len(self._replicas)
+            order = [(self._rr + k) % n for k in range(n)]
+            self._rr = (self._rr + 1) % n
+        for idx in order:
+            r = self._replicas[idx]
+            if not r.active or idx in exclude:
+                continue
+            if r.breaker.allow():
+                return r
+        states = {r.index: r.breaker.state for r in self._replicas}
+        raise NoHealthyReplicaError(
+            f"no healthy replica (breakers: {states}); retry after "
+            f"{self._breaker_kwargs['cooldown_s'] * 1e3:.0f}ms",
+            retry_after_ms=self._breaker_kwargs["cooldown_s"] * 1e3,
+            level=3)
 
-    def submit(self, *inputs, deadline_ms=None):
-        return self._next_engine().submit(*inputs, deadline_ms=deadline_ms)
+    def submit(self, *inputs, deadline_ms=None, priority=None):
+        rep = self._pick_replica()
+        req = rep.engine.make_request(inputs, deadline_ms=deadline_ms,
+                                      priority=priority)
+        fut = rep.engine.submit_request(req)
+        with self._hedge_lock:
+            self._submitted += 1
+        delay = self._hedge_delay_s
+        if self._hedger is not None and delay and len(self._replicas) > 1:
+            self._hedger.schedule(req, rep.index, delay)
+        return fut
 
-    def run(self, *inputs, deadline_ms=None, timeout=None):
-        return self.submit(*inputs, deadline_ms=deadline_ms).result(timeout)
+    def run(self, *inputs, deadline_ms=None, timeout=None, priority=None):
+        return self.submit(*inputs, deadline_ms=deadline_ms,
+                           priority=priority).result(timeout)
+
+    # -- hedging -----------------------------------------------------------
+
+    def _maybe_hedge(self, req, primary_index):
+        """Hedge timer fired: if the request is still unresolved and the
+        budget allows, re-dispatch it to a different healthy replica and
+        let the first resolution win."""
+        if req.future.done():
+            return
+        with self._hedge_lock:
+            if self._hedged >= self.hedge_budget * self._submitted:
+                return
+            self._hedged += 1
+        try:
+            rep = self._pick_replica(exclude=(primary_index,))
+        except NoHealthyReplicaError:
+            with self._hedge_lock:
+                self._hedged -= 1   # unfired: give the budget back
+            return
+        from .batcher import Request
+        shadow = Request(req.inputs, req.n, req.signature,
+                         deadline=req.deadline, priority=req.priority)
+        metrics.record_hedge(replica=rep.index)
+
+        def _on_shadow_done(sf, _req=req, _idx=rep.index):
+            if sf.cancelled() or sf.exception() is not None:
+                return          # primary still owns the future
+            try:
+                _req.future.set_result(sf.result())
+            except concurrent.futures.InvalidStateError:
+                return          # primary won the race
+            with self._hedge_lock:
+                self._hedge_wins += 1
+            metrics.record_hedge_win(replica=_idx)
+
+        shadow.future.add_done_callback(_on_shadow_done)
+        try:
+            rep.engine.submit_request(shadow)
+        except ShedError:
+            with self._hedge_lock:
+                self._hedged -= 1   # shadow shed at admission: not a hedge
+        except RuntimeError:
+            pass                    # replica closed under us
+
+    def _refresh_hedge_delay(self, p99_ms):
+        """Supervisor tick: re-derive the auto hedge delay from the live
+        p99 (a hedge should fire only for genuine stragglers)."""
+        if self._hedge_fixed is not None:
+            return
+        if p99_ms:
+            self._hedge_delay_s = max(MIN_HEDGE_S, float(p99_ms) / 1e3)
+
+    # -- failover / restart (supervisor verdicts) --------------------------
+
+    def _failover(self, replica, reason=""):
+        """Move a tripped replica's queued and in-flight requests to
+        healthy peers. The in-flight group is *disowned* first, so even
+        if the hung dispatch eventually completes, whichever resolution
+        lands first wins and the other is swallowed — exactly once,
+        either way."""
+        moved = replica.engine.disown_inflight()
+        moved += replica.engine.steal_pending()
+        moved = [r for r in moved if not r.future.done()]
+        if not moved:
+            return 0
+        with self._hedge_lock:
+            self._failovers += 1
+        metrics.record_failover(replica.index, len(moved))
+        try:
+            target = self._pick_replica(exclude=(replica.index,))
+        except NoHealthyReplicaError as e:
+            for r in moved:
+                r.resolve_exception(e)
+            return len(moved)
+        target.engine.requeue(moved)
+        return len(moved)
+
+    def _restart(self, replica):
+        """Re-``replicate()`` state onto the replica's device, swap in a
+        fresh engine (warmed with the remembered signatures), and close
+        the old one in the background with a bounded join — its drain
+        thread may be wedged forever."""
+        old_engine = replica.engine
+        fresh_pred = replicate(self.predictor, [replica.device])[0]
+        fresh = self._make_replica(replica.index, fresh_pred)
+        # keep the ORIGINAL breaker (state + flap history): the restarted
+        # engine stays open until a probe or budgeted request closes it
+        def _outcome(ok, exc, _b=replica.breaker):
+            if ok:
+                _b.record_success()
+            else:
+                _b.record_failure(repr(exc))
+        fresh.engine.on_outcome = _outcome
+        if self._warm_sigs:
+            try:
+                fresh.engine.warmup(*self._warm_sigs)
+            except Exception:   # noqa: BLE001 - warm lazily instead
+                pass
+        fresh.engine.start()
+        replica.predictor = fresh.predictor
+        replica.engine = fresh.engine
+        replica.restarts += 1
+        replica.restart_token = None
+        metrics.record_replica_restart(replica.index)
+        threading.Thread(
+            target=lambda: old_engine.close(drain=False, timeout=1.0),
+            name="paddle_tpu-serving-reap", daemon=True).start()
+
+    # -- scaling (supervisor verdicts) -------------------------------------
+
+    def _active_count(self):
+        return sum(1 for r in self._replicas if r.active)
+
+    def _activate_one(self):
+        for r in self._replicas:
+            if not r.active:
+                r.active = True
+                metrics.record_active_replicas(self._active_count())
+                return r
+        return None
+
+    def _deactivate_one(self):
+        if self._active_count() <= self.min_replicas:
+            return None
+        for r in reversed(self._replicas):
+            if r.active:
+                r.active = False
+                # drain its queue onto the survivors
+                moved = [q for q in r.engine.steal_pending()
+                         if not q.future.done()]
+                if moved:
+                    try:
+                        self._pick_replica(
+                            exclude=(r.index,)).engine.requeue(moved)
+                    except NoHealthyReplicaError:
+                        r.engine.requeue(moved)   # undo: keep serving
+                        r.active = True
+                        return None
+                metrics.record_active_replicas(self._active_count())
+                return r
+        return None
+
+    # -- fleet lifecycle ---------------------------------------------------
 
     def warmup(self, *signatures):
         """Warm every replica (each compiles its own device-committed
-        executables). Returns total fresh executables."""
-        return sum(e.warmup(*signatures) for e in self.engines)
+        executables); the signatures are remembered so a restarted
+        replica re-warms before taking traffic. Returns total fresh
+        executables."""
+        self._warm_sigs = signatures
+        return sum(r.engine.warmup(*signatures) for r in self._replicas)
 
     def start(self):
-        for e in self.engines:
-            e.start()
+        for r in self._replicas:
+            r.engine.start()
 
     def close(self, drain=True, timeout=None):
-        for e in self.engines:
-            e.close(drain=drain, timeout=timeout)
+        if self.supervisor is not None:
+            self.supervisor.stop()
+        if self._hedger is not None:
+            self._hedger.stop()
+        _ACTIVE.discard(self)
+        for r in self._replicas:
+            # a hung replica must not hold close() hostage: bound the
+            # join (its stranded futures fail rather than strand)
+            t = timeout
+            if t is None and drain:
+                t = 10.0
+            r.engine.close(drain=drain, timeout=t)
 
     def __enter__(self):
         self.start()
@@ -86,13 +475,71 @@ class MultiDeviceEngine:
     def __exit__(self, *exc):
         self.close()
 
+    # -- observability -----------------------------------------------------
+
     def stats(self):
         """Aggregate across replicas, with the per-replica breakdown
-        under ``"replicas"``."""
-        per = [e.stats() for e in self.engines]
+        under ``"replicas"`` and the resilience tallies alongside."""
+        per = [r.engine.stats() for r in self._replicas]
         agg = {k: sum(s[k] for s in per)
                for k in per[0] if isinstance(per[0][k], (int, float))}
         agg["replicas"] = per
-        agg["devices"] = [str(getattr(p, "device", "?"))
-                          for p in self.replicas]
+        agg["devices"] = [str(r.device) for r in self._replicas]
+        with self._hedge_lock:
+            agg["hedged"] = self._hedged
+            agg["hedge_wins"] = self._hedge_wins
+            agg["failovers"] = self._failovers
+        agg["restarts"] = sum(r.restarts for r in self._replicas)
+        agg["active_replicas"] = self._active_count()
+        agg["breakers"] = {r.index: r.breaker.state
+                           for r in self._replicas}
         return agg
+
+    def health(self, now=None):
+        """The /healthz ``serving`` block: per-replica breaker state and
+        heartbeat ages, plus ``all_open`` (no replica can take traffic
+        → the endpoint answers 503)."""
+        now = time.monotonic() if now is None else now
+        reps = []
+        any_admitting = False
+        for r in self._replicas:
+            h = r.engine.heartbeat(now)
+            state = r.breaker.state
+            if r.active and state != "open":
+                any_admitting = True
+            reps.append({
+                "replica": r.index,
+                "device": str(r.device),
+                "breaker": state,
+                "active": bool(r.active),
+                "queue_depth": h["queue_depth"],
+                "inflight_age_s": None if h["inflight_age_s"] is None
+                else round(h["inflight_age_s"], 3),
+                "heartbeat_age_s": round(h["last_ok_age_s"], 3),
+                "restarts": r.restarts,
+            })
+        out = {"replicas": reps, "all_open": not any_admitting,
+               "active_replicas": self._active_count()}
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.last_decision()
+        return out
+
+
+def health():
+    """Health blocks for every live MultiDeviceEngine (what
+    ``monitor.export.health_payload`` embeds under ``serving``)."""
+    return [eng.health() for eng in list(_ACTIVE)]
+
+
+def publish_gauges():
+    """Sampler tick: republish per-replica breaker state and the active
+    count (transitions set the gauges too, but a tick keeps the
+    open→half_open cooldown promotion visible without traffic)."""
+    from .. import monitor as _monitor
+    if not _monitor.enabled():
+        return
+    for eng in list(_ACTIVE):
+        metrics.record_active_replicas(eng._active_count())
+        for r in eng._replicas:
+            _monitor.gauge(f"serving.breaker_state.{r.index}").set(
+                metrics._BREAKER_STATE_NUM.get(r.breaker.state, -1))

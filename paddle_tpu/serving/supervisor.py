@@ -1,0 +1,188 @@
+"""paddle_tpu.serving.supervisor — the closed-loop self-healing brain.
+
+`ElasticSupervisor` (resilience/elastic.py) proved the shape for
+training: a loop that watches for a failure signal, shrinks the world,
+and resumes. Serving needs the same loop with different verbs, running
+*continuously* rather than per-crash:
+
+* **hang detection** — a dispatch stuck inside a replica longer than
+  ``inflight_timeout_s`` is declared hung: the replica's breaker trips
+  (no more traffic), its queued *and* in-flight requests fail over to
+  healthy peers. The verdict is keyed on the dispatch identity, so one
+  hang produces exactly one failover, however many ticks observe it.
+* **recovery probing** — a breaker in half_open gets one budgeted probe
+  per tick (a 1-row replay of real input on a side thread, see
+  ``ServingEngine.probe``); success closes the breaker and the replica
+  rejoins the rotation.
+* **restart** — a replica still wedged ``restart_after_s`` after its
+  hang verdict gets rebuilt: state re-``replicate()``d onto the device,
+  a fresh engine warmed and swapped in, the wedged one reaped in the
+  background.
+* **scaling** — when the live ``slo.goodput`` window sags below the
+  floor and inactive replicas exist, one is activated per tick; a fleet
+  idle for ``idle_ticks_down`` consecutive ticks gives one back (never
+  below ``min_replicas``).
+
+Every verdict is recorded planner-style — a ``serving.supervisor``
+ledger event plus :func:`last_decision` — so ``/snapshot`` can answer
+"why did the fleet change shape?" the way it answers "why did the
+planner pick that mesh?".
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from . import metrics
+
+#: most recent decision across all supervisors (the /snapshot block)
+_LAST_DECISION = None
+
+
+def last_decision():
+    return _LAST_DECISION
+
+
+class ServingSupervisor:
+    """Control loop over one :class:`~paddle_tpu.serving.multi.
+    MultiDeviceEngine`. Holds its owner weakly — a dropped engine kills
+    the loop instead of the loop immortalizing the engine."""
+
+    def __init__(self, owner, interval_s=0.25, probe_timeout_s=1.0,
+                 goodput_floor=0.90, restart_after_s=None,
+                 idle_ticks_down=120, scale=True, start=True):
+        self._owner = weakref.ref(owner)
+        self.interval_s = float(interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.goodput_floor = float(goodput_floor)
+        # default: a hung replica gets 3 supervision timeouts of grace
+        # after failover before the heavyweight rebuild
+        self.restart_after_s = (float(restart_after_s)
+                                if restart_after_s is not None
+                                else 3.0 * owner.inflight_timeout_s)
+        self.idle_ticks_down = int(idle_ticks_down)
+        self.scale = bool(scale)
+        self._idle_ticks = 0
+        self._stop = threading.Event()
+        self._thread = None
+        self.decisions = []     # bounded local history (snapshot block)
+        if start:
+            self.start()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle_tpu-serving-supervisor",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout=2.0):
+        self._stop.set()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            owner = self._owner()
+            if owner is None:
+                return
+            try:
+                self.tick(owner)
+            except Exception:   # noqa: BLE001 - the loop must survive
+                pass            # any single bad tick
+
+    # -- decisions ---------------------------------------------------------
+
+    def _decide(self, decision, **fields):
+        global _LAST_DECISION
+        entry = {"decision": decision, "t": time.time(), **fields}
+        _LAST_DECISION = entry
+        self.decisions.append(entry)
+        del self.decisions[:-50]
+        metrics.record_supervisor(decision, **fields)
+
+    def last_decision(self):
+        return self.decisions[-1] if self.decisions else None
+
+    # -- one control-loop step --------------------------------------------
+
+    def tick(self, owner=None, now=None):
+        """One supervision pass; callable directly by tests (pass the
+        owner) or driven by the daemon loop."""
+        owner = owner or self._owner()
+        if owner is None:
+            return
+        now = time.monotonic() if now is None else now
+        rollup = metrics.slo_rollup(now)
+        owner._refresh_hedge_delay(rollup.get("p99_ms"))
+        busy = False
+        for replica in list(owner._replicas):
+            busy |= self._supervise_replica(owner, replica, now)
+        if self.scale:
+            self._autoscale(owner, rollup, busy)
+
+    def _supervise_replica(self, owner, replica, now):
+        hb = replica.engine.heartbeat(now)
+        age = hb["inflight_age_s"]
+        token = hb["inflight_token"]
+        busy = bool(hb["queue_depth"]) or age is not None
+
+        # hang: one verdict per dispatch (the token is the dispatch's
+        # start time — a NEW dispatch hanging gets its own verdict)
+        if age is not None and age > owner.inflight_timeout_s \
+                and token != replica.handled_token:
+            replica.handled_token = token
+            metrics.record_replica_hung(replica.index, age)
+            replica.breaker.trip("hung")
+            moved = owner._failover(replica, reason="hung")
+            self._decide("failover", replica=replica.index,
+                         inflight_age_s=round(age, 3), moved=moved)
+
+        # restart: the same dispatch still wedged well past the verdict
+        if age is not None and age > self.restart_after_s \
+                and token != replica.restart_token:
+            replica.restart_token = token
+            owner._restart(replica)
+            self._decide("restart", replica=replica.index,
+                         inflight_age_s=round(age, 3),
+                         restarts=replica.restarts)
+            return busy
+
+        # recovery: one budgeted probe per tick per half-open breaker
+        if replica.active and replica.breaker.state == "half_open":
+            ok = replica.engine.probe(timeout_s=self.probe_timeout_s)
+            if ok:
+                replica.breaker.record_success()
+                self._decide("reclose", replica=replica.index)
+            elif ok is not None:
+                replica.breaker.record_failure("probe")
+        return busy
+
+    def _autoscale(self, owner, rollup, busy):
+        goodput = rollup.get("goodput")
+        submitted = rollup.get("submitted") or 0
+        if goodput is not None and submitted >= 20 \
+                and goodput < self.goodput_floor:
+            self._idle_ticks = 0
+            rep = owner._activate_one()
+            if rep is not None:
+                self._decide("scale_up", replica=rep.index,
+                             goodput=round(goodput, 4),
+                             active=owner._active_count())
+            return
+        if busy or submitted:
+            self._idle_ticks = 0
+            return
+        self._idle_ticks += 1
+        if self._idle_ticks >= self.idle_ticks_down:
+            self._idle_ticks = 0
+            rep = owner._deactivate_one()
+            if rep is not None:
+                self._decide("scale_down", replica=rep.index,
+                             active=owner._active_count())

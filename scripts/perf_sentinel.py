@@ -68,6 +68,19 @@ METRICS = [
      "higher", 0.25),
     ("serving_p99_ms", ("serving_p99_ms", "p99_ms"),
      ("serving_p99_ms", "p99_ms"), "lower", 0.50),
+    # degraded-serving stage (bench_serving_degraded): what the fleet
+    # keeps while broken. Goodputs are floors (tight — they're ratios,
+    # not wall-clock); the hedge fraction is a ceiling (wide — a few
+    # extra hedges on a loaded box is noise, 3x the budget is a bug)
+    ("serving_degraded_goodput",
+     ("serving_degraded_goodput",), ("serving_degraded_goodput",),
+     "higher", 0.10),
+    ("serving_degraded_high_goodput",
+     ("serving_degraded_high_goodput",),
+     ("serving_degraded_high_goodput",), "higher", 0.10),
+    ("serving_degraded_hedge_frac",
+     ("serving_degraded_hedge_frac",),
+     ("serving_degraded_hedge_frac",), "lower", 1.00),
     # gradient-communication stage (bench_collective_overlap): exposed
     # wire seconds breathe with CI load (wide bands); bucket count and
     # wire bytes are deterministic functions of the model + bucket size

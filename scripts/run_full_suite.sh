@@ -40,6 +40,17 @@ bash scripts/serving_smoke.sh "$MONITOR_DIR/serving_smoke"
 srv=$?
 [ $srv -ne 0 ] && rc=$((rc == 0 ? srv : rc))
 
+# serving chaos gate: self-healing fleet under injected faults —
+# replica-hang failover (goodput >= 0.90, breaker re-closes via
+# half-open probe), hedge-win under a straggler inside the 5% budget,
+# 2x-overload priority shed (high goodput >= 0.95, every shed error
+# retryable with retry-after), zero lost futures throughout
+echo ""
+echo "-- serving chaos smoke gate --"
+bash scripts/serving_chaos_smoke.sh "$MONITOR_DIR/serving_chaos_smoke"
+svc=$?
+[ $svc -ne 0 ] && rc=$((rc == 0 ? svc : rc))
+
 # telemetry gate: scrape /metrics + /healthz mid-fit (OpenMetrics with
 # executor/prefetch/mem_* series, live watchdog state), clean teardown
 echo ""

@@ -1,0 +1,315 @@
+"""Self-healing-serving chaos gate (tier-1-safe: tiny MLP, CPU, seconds).
+
+Three scenarios against a MultiDeviceEngine fleet on 4 forced-CPU
+devices, driven by the resilience/faults.py serving fault kinds, gating
+the ISSUE 14 acceptance criteria:
+
+* **replica-hang failover** — one of 4 replicas hangs mid-load
+  (``replica_hang``): the supervisor trips its breaker, fails its
+  queued + in-flight requests over to healthy peers, and the breaker
+  re-closes via a half-open probe once the fault clears. Gates:
+  goodput >= 0.90, zero lost futures, breaker opened >= 1 and ended
+  closed.
+* **hedge-win under a straggler** — an injected ``replica_slow`` makes
+  one replica a straggler; hedged re-dispatch rescues its requests.
+  Gates: hedged >= 1, hedge_wins >= 1, hedges within the 5% budget.
+* **overload shed with priority goodput** — 2x-capacity mixed-priority
+  load against a deliberately slowed single replica: the admission
+  ladder sheds low/normal first. Gates: high-priority goodput >= 0.95,
+  every shed error transient with retry_after_ms > 0, zero lost
+  futures.
+
+Prints one JSON result line; exit code 0 iff every gate passes.
+Run via scripts/serving_chaos_smoke.sh (which forces the 4-device CPU
+topology before jax imports).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _mlp():
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    pt.seed(0)
+    return nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+def _await_state(breaker, want, timeout_s=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if breaker.state == want:
+            return True
+        time.sleep(0.05)
+    return breaker.state == want
+
+
+def scenario_hang_failover(args):
+    """1 of 4 replicas hangs mid-load; the fleet routes around it."""
+    import jax
+    from paddle_tpu import inference, serving
+    from paddle_tpu.resilience import faults
+
+    devices = jax.local_devices()[:4]
+    eng = serving.MultiDeviceEngine(
+        inference.Predictor(_mlp()), devices=devices,
+        max_batch=8, timeout_ms=2.0, queue_depth=256,
+        deadline_ms=800.0,
+        inflight_timeout_ms=200.0, breaker_cooldown_s=0.8,
+        supervisor_interval_s=0.05)
+    eng.warmup([((16,), "float32")])
+    hang = faults.inject("replica_hang", replica=1, delay=1.2, times=1)
+
+    n_clients, per_client = 6, args.requests // 6
+    ok = errors = 0
+    lock = threading.Lock()
+    unresolved = []
+
+    def client(k):
+        nonlocal ok, errors
+        rng = np.random.RandomState(k)
+        for i in range(per_client):
+            x = rng.rand(1 + (k + i) % 4, 16).astype("f4")
+            try:
+                fut = eng.submit(x)
+            except Exception as e:  # noqa: BLE001 - counted
+                with lock:
+                    errors += 1
+                continue
+            try:
+                fut.result(timeout=10)
+                with lock:
+                    ok += 1
+            except Exception:  # noqa: BLE001 - counted
+                with lock:
+                    errors += 1
+            if not fut.done():
+                unresolved.append(i)
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # fault clears at ~1.2s; cooldown 0.8s -> half_open -> probe -> closed
+    breaker1 = eng._replicas[1].breaker
+    reclosed = _await_state(breaker1, "closed", timeout_s=10.0)
+    stats = eng.stats()
+    health = eng.health()
+    eng.close()
+    faults.clear()
+
+    submitted = ok + errors
+    goodput = ok / submitted if submitted else 0.0
+    return {
+        "submitted": submitted,
+        "ok": ok,
+        "errors": errors,
+        "goodput": round(goodput, 4),
+        "hang_fired": hang.fired,
+        "failovers": stats["failovers"],
+        "restarts": stats["restarts"],
+        "breaker_opened": breaker1.open_count,
+        "breaker_final": breaker1.state,
+        "health_all_open": health["all_open"],
+        "gates": {
+            "fault_injected": hang.fired >= 1,
+            "goodput_ge_090": goodput >= 0.90,
+            "zero_lost_futures": not unresolved and submitted == ok + errors,
+            "failover_happened": stats["failovers"] >= 1,
+            "breaker_opened": breaker1.open_count >= 1,
+            "breaker_reclosed": reclosed,
+        },
+    }
+
+
+def scenario_hedge_win(args):
+    """One replica turns straggler; hedges beat it within budget."""
+    import jax
+    from paddle_tpu import inference, serving
+    from paddle_tpu.resilience import faults
+
+    devices = jax.local_devices()[:2]
+    eng = serving.MultiDeviceEngine(
+        inference.Predictor(_mlp()), devices=devices,
+        max_batch=8, timeout_ms=1.0, queue_depth=256,
+        hedge_ms=40.0, hedge_budget=0.05,
+        supervisor_interval_s=0.1)
+    eng.warmup([((16,), "float32")])
+
+    rng = np.random.RandomState(0)
+    futs = []
+    # prime the hedge budget with clean traffic
+    for _ in range(args.requests):
+        futs.append(eng.submit(rng.rand(2, 16).astype("f4")))
+    for f in futs:
+        f.result(timeout=10)
+
+    faults.inject("replica_slow", replica=0, delay=0.35, times=4,
+                  probability=1.0)
+    futs2 = []
+    for _ in range(40):
+        futs2.append(eng.submit(rng.rand(2, 16).astype("f4")))
+        time.sleep(0.004)
+    unresolved = 0
+    for f in futs2:
+        try:
+            f.result(timeout=10)
+        except Exception:  # noqa: BLE001 - tallied below
+            pass
+        if not f.done():
+            unresolved += 1
+    stats = eng.stats()
+    eng.close()
+    faults.clear()
+
+    budget_cap = int(0.05 * stats["submitted"]) + 1
+    return {
+        "submitted": stats["submitted"],
+        "hedged": stats["hedged"],
+        "hedge_wins": stats["hedge_wins"],
+        "budget_cap": budget_cap,
+        "gates": {
+            "hedged_ge_1": stats["hedged"] >= 1,
+            "hedge_win_ge_1": stats["hedge_wins"] >= 1,
+            "hedges_within_budget": stats["hedged"] <= budget_cap,
+            "zero_lost_futures": unresolved == 0,
+        },
+    }
+
+
+def scenario_overload_shed(args):
+    """2x-capacity mixed-priority load on a slowed replica: the ladder
+    sheds low classes first and keeps high-priority goodput."""
+    import jax
+    from paddle_tpu import inference, serving
+    from paddle_tpu.resilience import faults, retry
+
+    eng = serving.ServingEngine(
+        inference.Predictor(_mlp()), max_batch=8, timeout_ms=1.0,
+        queue_depth=32, deadline_ms=2000.0, slo_goodput_floor=None)
+    eng.warmup([((16,), "float32")])
+    # ~20ms per batch -> ~400 rows/s service rate; clients offer ~2x that
+    faults.inject("replica_slow", delay=0.02, times=None, probability=1.0)
+
+    counts = {p: {"attempted": 0, "ok": 0, "shed": 0, "failed": 0}
+              for p in ("high", "normal", "low")}
+    bad_shed_errors = []
+    lock = threading.Lock()
+
+    def client(k):
+        rng = np.random.RandomState(k)
+        prios = ("high", "normal", "low")
+        for i in range(args.requests):
+            p = prios[(k + i) % 3]
+            x = rng.rand(1, 16).astype("f4")
+            with lock:
+                counts[p]["attempted"] += 1
+            try:
+                fut = eng.submit(x, priority=p)
+            except serving.ShedError as e:
+                with lock:
+                    counts[p]["shed"] += 1
+                    if not (retry.is_transient(e)
+                            and getattr(e, "retry_after_ms", 0) > 0):
+                        bad_shed_errors.append(repr(e))
+                time.sleep(min(e.retry_after_s, 0.05))
+                continue
+            except Exception as e:  # noqa: BLE001 - counted
+                with lock:
+                    counts[p]["failed"] += 1
+                continue
+            def _done(f, _p=p):
+                with lock:
+                    if f.cancelled() or f.exception() is not None:
+                        counts[_p]["failed"] += 1
+                    else:
+                        counts[_p]["ok"] += 1
+            fut.add_done_callback(_done)
+            time.sleep(0.0025)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    eng.close()           # drain=True: every queued future resolves
+    faults.clear()
+    stats = eng.stats()
+
+    resolved = sum(c["ok"] + c["shed"] + c["failed"]
+                   for c in counts.values())
+    attempted = sum(c["attempted"] for c in counts.values())
+    hi = counts["high"]
+    hi_goodput = hi["ok"] / hi["attempted"] if hi["attempted"] else 0.0
+    total_shed = sum(c["shed"] for c in counts.values())
+    return {
+        "counts": counts,
+        "high_goodput": round(hi_goodput, 4),
+        "total_shed": total_shed,
+        "engine_shed": stats["shed"],
+        "engine_rejected": stats["rejected"],
+        "bad_shed_errors": bad_shed_errors[:5],
+        "gates": {
+            "overload_shed_happened": total_shed >= 1,
+            "high_goodput_ge_095": hi_goodput >= 0.95,
+            "shed_mostly_low_priority":
+                counts["low"]["shed"] >= counts["high"]["shed"],
+            "all_shed_retryable": not bad_shed_errors,
+            "zero_lost_futures": resolved == attempted,
+        },
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir",
+                    default="/tmp/paddle_tpu_serving_chaos_smoke")
+    ap.add_argument("--requests", type=int, default=120,
+                    help="per-scenario request scale")
+    args = ap.parse_args()
+
+    from paddle_tpu import monitor
+    from paddle_tpu.serving import metrics as smetrics
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    jsonl = monitor.enable(os.path.join(args.out_dir,
+                                        "serving_chaos_smoke.jsonl"))
+
+    result = {"jsonl": jsonl}
+    t0 = time.perf_counter()
+    for name, fn in (("hang_failover", scenario_hang_failover),
+                     ("hedge_win", scenario_hedge_win),
+                     ("overload_shed", scenario_overload_shed)):
+        smetrics.reset_windows()
+        result[name] = fn(args)
+    result["wall_s"] = round(time.perf_counter() - t0, 3)
+
+    gates = {}
+    for name in ("hang_failover", "hedge_win", "overload_shed"):
+        for g, v in result[name]["gates"].items():
+            gates[f"{name}.{g}"] = bool(v)
+    result["gates"] = gates
+    result["ok"] = all(gates.values())
+    monitor.emit(kind="serving_chaos_smoke",
+                 **{k: v for k, v in result.items() if k != "jsonl"})
+    monitor.disable()
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

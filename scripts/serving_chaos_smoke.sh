@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# CI gate for self-healing serving (ISSUE 14): against a 4-replica
+# forced-CPU fleet under injected faults —
+#   * replica-hang: failover keeps goodput >= 0.90, zero lost futures,
+#     the breaker opens and re-closes via a half-open probe
+#   * straggler: hedged re-dispatch wins at least once, inside the 5%
+#     hedge budget
+#   * 2x overload: the admission ladder sheds low priority first,
+#     high-priority goodput stays >= 0.95, every shed error is
+#     transient with a retry-after hint
+#
+# Usage: scripts/serving_chaos_smoke.sh [out_dir]
+# The monitor JSONL (with the serving_chaos_smoke record) lands in
+# out_dir (default /tmp/paddle_tpu_serving_chaos_smoke); the last
+# stdout line is one JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_serving_chaos_smoke}"
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+python scripts/serving_chaos_smoke.py --out-dir "$OUT_DIR"
