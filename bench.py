@@ -571,6 +571,46 @@ def bench_memory_plan(timeout_s=600):
     }
 
 
+def bench_decode(timeout_s=600):
+    """Generative-decode stage: runs scripts/decode_smoke.py in a
+    subprocess (CPU, 2 virtual devices for the scale-up phase) and
+    banks the continuous-batching numbers: sustained tokens/s under
+    continuous refill, the speedup over the drain run-to-completion
+    baseline at the same slot count, decode-batch occupancy, and the
+    prefill p50 / decode p99 step latencies. The sentinel bands the
+    wall-clock rates very wide (shared-box noise), the speedup and
+    occupancy tight — those are scheduling ratios, not clock
+    measurements, and a drop means the refill discipline regressed."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "decode_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_decode"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"decode_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    tp = r["throughput"]
+    return {
+        "decode_tokens_per_s": tp["continuous_tokens_per_s"],
+        "decode_drain_tokens_per_s": tp["drain_tokens_per_s"],
+        "decode_speedup_x": tp["speedup_x"],
+        "decode_batch_occupancy": tp["continuous_occupancy"],
+        "decode_prefill_p50_ms": tp["prefill_p50_ms"],
+        "decode_p99_ms": tp["decode_p99_ms"],
+        "decode_gates_pass": bool(r["ok"]),
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -1045,6 +1085,16 @@ def main():
                   f"ceiling_multiple="
                   f"{mpl['memory_plan_ceiling_multiple']}", flush=True)
             _RESULTS.update(mpl)
+        try:
+            dec = bench_decode()
+        except Exception as e:
+            print(f"decode bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial decode_tokens_per_s="
+                  f"{dec['decode_tokens_per_s']} "
+                  f"speedup_x={dec['decode_speedup_x']}", flush=True)
+            _RESULTS.update(dec)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

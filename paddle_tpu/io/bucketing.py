@@ -39,6 +39,40 @@ def next_bucket(n, buckets=None):
     return b
 
 
+def grow_buckets(base, factor=2.0, cap=None):
+    """A geometric-growth bucket *family* for sequence lengths: ``base``,
+    then each next bucket ``ceil(prev * factor)`` (strictly increasing
+    even for factors close to 1), stopping at the first bucket >= ``cap``.
+
+    Returns a tuple — immutable and hashable, so the family itself is a
+    stable cache key: the same ``(base, factor, cap)`` always yields the
+    same tuple, and executables keyed on a family member never collide
+    across families. This is the growth schedule the serving KV-cache
+    pool compiles against: capacity only ever moves along a closed,
+    pre-declared family, so cache growth never mints a fresh shape.
+    """
+    base = int(base)
+    if base < 1:
+        raise ValueError(f"grow_buckets: base must be >= 1, got {base}")
+    factor = float(factor)
+    if factor <= 1.0:
+        raise ValueError(
+            f"grow_buckets: factor must be > 1, got {factor}")
+    if cap is None:
+        raise ValueError("grow_buckets: cap is required")
+    cap = int(cap)
+    if cap < base:
+        raise ValueError(
+            f"grow_buckets: cap {cap} is below base {base}")
+    out = [base]
+    while out[-1] < cap:
+        nxt = int(np.ceil(out[-1] * factor))
+        if nxt <= out[-1]:       # paranoia: ceil already guarantees this
+            nxt = out[-1] + 1
+        out.append(nxt)
+    return tuple(out)
+
+
 def pad_to_bucket(array, target, axis=0, mode="repeat"):
     """Pad ``array`` along ``axis`` up to ``target`` rows. Works on numpy
     and jax arrays alike (stays in the input's array namespace, so a

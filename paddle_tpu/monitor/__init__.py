@@ -73,6 +73,16 @@ Serving series (docs/serving.md; ``paddle_tpu.serving``):
   (must stop growing after ``ServingEngine.warmup``)
 * ``serving.retries`` / ``serving.isolated`` / ``serving.poisoned`` —
   the RetryPolicy-classified failure path
+* ``serving.decode.*`` — the continuous-batching decode tier:
+  ``ticks``/``tokens``/``slot_occupancy`` (fused-step cadence and how
+  full the decode batch runs), ``prefills``/``prefill_tokens``/
+  ``prefill_ms``/``prefill_ratio`` (prompt-ingest side of the
+  prefill/decode split), ``compiles`` (decode executables minted —
+  must stop growing after ``GenerateEngine.warmup``), and
+  ``cache_bytes``/``cache_capacity``/``cache_headroom``/
+  ``cache_grows`` (the KV pool's live footprint vs the device budget)
+* ``slo.tokens_per_s`` / ``slo.decode_p99_ms`` — the rolling decode
+  window the supervisor's ``tokens_floor`` scaling reads
 * ``inference.{compile,cache_hit,aot_warmup,bucket_pad}`` — the
   underlying Predictor's executable-cache accounting
 

@@ -323,6 +323,50 @@ class BasicDecoder(Decoder):
         return (out, sample_ids), next_inputs, _unwrap(new_states), finished
 
 
+class KVCacheCell:
+    """Adapts a serving-style decode function (the
+    ``paddle_tpu.serving.generate`` model contract: ``decode_fn(state,
+    tokens[B], kv {leaf: [B, max_len, *tail]}, lengths[B]) -> (logits,
+    entry)``) into an RNN cell for :class:`BasicDecoder` +
+    :class:`GreedyEmbeddingHelper` (with an identity ``embedding_fn`` —
+    the decode_fn embeds its own token ids). Cell states are
+    ``(kv, lengths)``: the decode step attends over the cache, writes
+    the incoming token's entry at position ``lengths``, and advances.
+
+    This is the single-sequence twin of the continuous-batching engine:
+    same decode math, same cache discipline, driven by the classic
+    ``lax.while_loop`` decoding stack — the bit-parity bridge the
+    serving tests assert across (same weights in, same tokens out)."""
+
+    def __init__(self, decode_fn, state, max_len):
+        self.decode_fn = decode_fn
+        self.state = state
+        self.max_len = int(max_len)
+
+    def init_states(self, kv_chunks, lengths):
+        """Seed the cell from a prefill: pad each ``[B, L, *tail]`` KV
+        chunk out to ``[B, max_len, *tail]`` (zeros past the live
+        length are never attended — the decode mask sees ``lengths``)
+        and pair with those lengths."""
+        lengths = jnp.asarray(_unwrap(lengths), jnp.int32)
+        kv = {}
+        for name, chunk in _unwrap(kv_chunks).items():
+            pad = [(0, 0)] * chunk.ndim
+            pad[1] = (0, self.max_len - chunk.shape[1])
+            kv[name] = jnp.pad(chunk, pad)
+        return kv, lengths
+
+    def __call__(self, inputs, states):
+        tokens = jnp.asarray(_unwrap(inputs), jnp.int32).reshape(-1)
+        kv, lengths = _unwrap(states)
+        logits, entry = self.decode_fn(self.state, tokens, kv, lengths)
+        rows = jnp.arange(tokens.shape[0])
+        pos = jnp.minimum(lengths, self.max_len - 1)
+        kv = {name: buf.at[rows, pos].set(entry[name])
+              for name, buf in kv.items()}
+        return Tensor(logits), _wrap((kv, lengths + 1))
+
+
 def basic_decode(decoder, inits, max_step_num=64, output_time_major=False):
     """Drive a BasicDecoder (helper-based). Returns (outputs, sample_ids)
     as [B, T, ...] / [B, T] plus lengths."""

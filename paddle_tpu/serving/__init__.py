@@ -33,6 +33,14 @@ traffic into them.
 * :mod:`~paddle_tpu.serving.supervisor` — :class:`ServingSupervisor`:
   the closed control loop turning heartbeats + the live ``slo.*``
   window into failover / probe / restart / scale decisions
+* :mod:`~paddle_tpu.serving.kv_cache`  — :class:`KVCachePool`: the
+  paged, bucket-grown, budget-accounted K/V arena behind generative
+  decode
+* :mod:`~paddle_tpu.serving.generate`  — :class:`GenerateEngine`:
+  continuous-batching autoregressive decode (fixed slot batch, one
+  fused step per tick, prefill/decode split, zero steady-state
+  compiles) and :class:`MultiDecodeEngine`, its breaker-aware fleet
+  fan-out
 
 See docs/robustness.md ("Self-healing serving") for the failure model.
 
@@ -59,20 +67,27 @@ from . import breaker  # noqa: F401
 from . import engine  # noqa: F401
 from . import multi  # noqa: F401
 from . import supervisor  # noqa: F401
+from . import kv_cache  # noqa: F401
+from . import generate  # noqa: F401
 from .admission import (AdmissionController, QueueFullError,  # noqa: F401
                         DeadlineExpired, ShedError, PRIORITIES)
 from .batcher import DynamicBatcher, Request  # noqa: F401
 from .breaker import CircuitBreaker  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
+from .generate import (GenerateEngine, MultiDecodeEngine,  # noqa: F401
+                       DecodeRequest, replicate_decode, demo_model)
+from .kv_cache import KVCachePool  # noqa: F401
 from .multi import (MultiDeviceEngine, NoHealthyReplicaError,  # noqa: F401
                     replicate)
 from .supervisor import ServingSupervisor  # noqa: F401
 
 __all__ = [
     "batcher", "admission", "metrics", "engine", "multi", "breaker",
-    "supervisor",
+    "supervisor", "kv_cache", "generate",
     "ServingEngine", "MultiDeviceEngine", "replicate", "DynamicBatcher",
     "Request", "AdmissionController", "QueueFullError", "DeadlineExpired",
     "ShedError", "PRIORITIES", "CircuitBreaker", "NoHealthyReplicaError",
     "ServingSupervisor",
+    "GenerateEngine", "MultiDecodeEngine", "DecodeRequest", "KVCachePool",
+    "replicate_decode", "demo_model",
 ]

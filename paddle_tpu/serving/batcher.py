@@ -40,9 +40,10 @@ class Request:
     deadline. Created by ``ServingEngine.submit``."""
 
     __slots__ = ("inputs", "n", "signature", "future", "deadline",
-                 "t_enqueue", "priority")
+                 "t_enqueue", "priority", "seq_real", "seq_padded")
 
-    def __init__(self, inputs, n, signature, deadline=None, priority=1):
+    def __init__(self, inputs, n, signature, deadline=None, priority=1,
+                 seq_real=None, seq_padded=None):
         self.inputs = inputs              # tuple of host arrays
         self.n = int(n)                   # rows along the batch axis
         self.signature = signature        # per-example (shape, dtype) tuple
@@ -50,6 +51,12 @@ class Request:
         self.deadline = deadline
         self.priority = int(priority)     # admission.PRIORITIES rank
         self.t_enqueue = time.monotonic()
+        # sequence-axis bucketing (engine seq_buckets=): the real vs
+        # padded length along axis 1, recorded BEFORE the signature is
+        # computed so ragged prompts coalesce into one executable
+        # signature; scatter slices axis 1 back to seq_real
+        self.seq_real = seq_real
+        self.seq_padded = seq_padded
 
     def age(self, now=None):
         return (now if now is not None else time.monotonic()) \

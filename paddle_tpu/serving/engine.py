@@ -27,7 +27,7 @@ import time
 import numpy as np
 
 from .. import monitor as _monitor
-from ..io.bucketing import next_bucket, pad_to_bucket, split_rows
+from ..io.bucketing import next_bucket, pad_to_bucket, split_rows, unpad
 from ..resilience import faults as _faults
 from ..tensor import Tensor
 from .admission import AdmissionController, resolve_priority
@@ -84,8 +84,18 @@ class ServingEngine:
                  timeout_ms=5.0, queue_depth=256, deadline_ms=None,
                  retry_policy=None, start=True, metrics_port=None,
                  replica_id=None, on_outcome=None, shed=True,
-                 slo_goodput_floor=0.90):
+                 slo_goodput_floor=0.90, seq_buckets=None):
         self.predictor = predictor
+        # sequence-length buckets for ragged prompts: inputs with a
+        # second (sequence) axis are padded up to the next bucket
+        # BEFORE the coalescing signature is computed, so prompts of
+        # length 7/12/15 all group as one bucket-16 signature instead
+        # of fragmenting into per-length single-request batches. The
+        # model must treat pad positions as inert (causal attention or
+        # an explicit length mask — see docs/serving.md); per-request
+        # outputs are sliced back to the real length at scatter.
+        self.seq_buckets = (tuple(sorted({int(b) for b in seq_buckets}))
+                            if seq_buckets else None)
         # identity inside a MultiDeviceEngine fleet (fault targeting,
         # breaker gauges); None for a standalone engine
         self.replica_id = replica_id
@@ -174,9 +184,28 @@ class ServingEngine:
         from ..resilience.deadline import Deadline
         deadline = (Deadline.after_ms(deadline_ms)
                     if deadline_ms is not None else None)
+        seq_real = seq_padded = None
+        if self.seq_buckets:
+            # pad the sequence axis to its bucket BEFORE the signature:
+            # this is what lets ragged prompts coalesce into one
+            # executable signature (repeat-mode pad — rows stay
+            # in-distribution, causal/masked models ignore them)
+            padded, pads = [], set()
+            for a in arrays:
+                if a.ndim >= 2 and a.shape[1] > 0:
+                    seq_n = a.shape[1]
+                    target = next_bucket(seq_n, self.seq_buckets)
+                    if target != seq_n:
+                        a = pad_to_bucket(a, target, axis=1)
+                    pads.add((seq_n, target))
+                padded.append(a)
+            arrays = tuple(padded)
+            if len(pads) == 1:
+                (seq_real, seq_padded), = pads
         sig = tuple((a.shape[1:], str(a.dtype)) for a in arrays)
         return Request(arrays, n, sig, deadline=deadline,
-                       priority=resolve_priority(priority))
+                       priority=resolve_priority(priority),
+                       seq_real=seq_real, seq_padded=seq_padded)
 
     def submit_request(self, req):
         """Enqueue an already-built ``Request``; returns its future.
@@ -459,6 +488,13 @@ class ServingEngine:
         latencies, within = [], []
         for j, r in enumerate(requests):
             vals = [chunks[j] for chunks in per_out_chunks]
+            if r.seq_padded is not None and r.seq_real != r.seq_padded:
+                # bucket-padded sequence axis: slice outputs that kept
+                # the padded length back to the request's real length
+                vals = [unpad(v, r.seq_real, axis=1)
+                        if getattr(v, "ndim", 0) >= 2
+                        and v.shape[1] == r.seq_padded else v
+                        for v in vals]
             r.resolve_result(list(vals) if multi else vals[0])
             latencies.append(r.age(now) * 1e3)
             # the slo.* goodput numerator: resolved before its SLA ran

@@ -1,0 +1,1098 @@
+"""paddle_tpu.serving.generate — continuous-batching autoregressive
+decode.
+
+The serving tier below this module batches *fixed-shape* requests: one
+request, one executable call, one future. Generative traffic is a
+different animal — a request is a *sequence* that occupies capacity for
+hundreds of steps, and sequences join and leave mid-flight. Batching
+discipline decides tokens/s/chip (PAPERS.md: Gemma-on-TPU serving), and
+the naive discipline — run a batch of sequences to completion, then
+admit the next batch — wastes most of the machine: the batch runs as
+long as its *longest* member, so average occupancy is roughly
+``mean(len) / max(len)`` and every short sequence's slot idles until
+the straggler finishes.
+
+**Continuous batching** is the fix, and this engine implements it:
+
+* a fixed-width decode batch of ``slots`` sequences runs **one fused
+  decode step per tick** — every tick advances every live sequence by
+  one token in a single pre-compiled executable;
+* a finished sequence frees its slot *immediately* (a host-side
+  bookkeeping write, nothing device-side moves);
+* queued requests are admitted into freed slots **at the next tick** —
+  there is no drain-the-batch barrier, so occupancy stays near 1.0
+  under churn (the ``refill="drain"`` mode *is* the naive baseline,
+  kept in-engine so the A/B in scripts/decode_loadgen.py shares every
+  executable with the continuous path);
+* **prefill and decode are split**: prompt ingest runs as its own
+  bucketed executable (flash-attention path — prompts are the long-
+  sequence work the Pallas kernel exists for), writes its KV pages into
+  the slot's arena, and hands the last-token state to the decode loop.
+  Decode steps never pay prompt-shaped work; prefills never stall other
+  slots' decode beyond one bucket-sized call.
+
+Shape discipline is the whole game on a compiled runtime: the engine
+owns one jitted executable per (kind, bucket) key — ``decode[cap]``,
+``prefill[Lb]``, ``insert[Lb, cap]``, ``grow[old→new]`` — where every
+bucket comes from a closed :func:`io.bucketing.grow_buckets` family, so
+:meth:`GenerateEngine.warmup` can mint *all* of them and steady-state
+churn performs **zero** fresh traces (``serving.decode.compiles`` must
+stay flat; scripts/decode_smoke.py gates it).
+
+Integration, not a sidecar: requests enter through the PR 14 shed
+ladder (:class:`~paddle_tpu.serving.admission.AdmissionController` —
+priorities, deadlines, ``ShedError``), completions feed the ``slo.*``
+goodput window so the :class:`ServingSupervisor` scales replicas off
+decode traffic exactly as it does for fixed-shape traffic (plus the new
+``slo.tokens_per_s`` floor), and :class:`MultiDecodeEngine` fans decode
+out across breaker-guarded per-device replicas via the same
+``MultiDeviceEngine`` machinery (failover, probes, restart).
+
+The model contract (duck-typed; :func:`demo_model` is the reference
+implementation)::
+
+    model.state        # pytree of device arrays (device_put per replica)
+    model.vocab        # int
+    model.kv_spec()    # {leaf: (tail_shape, dtype)} per cached token
+    model.prefill_fn(state, tokens[B, L], lengths[B])
+        -> (kv {leaf: [B, L, *tail]}, last_logits[B, V])
+    model.decode_fn(state, tokens[S], kv {leaf: [S, cap, *tail]},
+                    lengths[S])
+        -> (logits[S, V], entry {leaf: [S, *tail]})
+
+``decode_fn`` attends over ``kv[:, :lengths]`` plus the incoming
+token's own K/V; the engine writes that entry at position ``lengths``
+and advances the host-side length. All slot bookkeeping (lengths,
+last tokens, liveness) lives on the host and ships as tiny arrays each
+tick — the only device-resident state is the KV arena itself, so slot
+churn never mints an executable.
+"""
+from __future__ import annotations
+
+import collections
+import concurrent.futures
+import threading
+import time
+
+import numpy as np
+
+from .. import monitor as _monitor
+from ..io.bucketing import next_bucket
+from ..resilience import faults as _faults
+from ..resilience.deadline import Deadline
+from .admission import AdmissionController, resolve_priority
+from .kv_cache import KVCachePool
+from .multi import MultiDeviceEngine
+from . import metrics
+
+
+class DecodeRequest:
+    """One sequence in flight: a prompt, a generation budget, a future
+    resolving to the generated token ids (``np.int32``, EOS included
+    when hit). Same resolution idempotence as ``batcher.Request`` so
+    failover's first-resolution-wins contract holds."""
+
+    __slots__ = ("prompt", "max_new_tokens", "eos_token", "n",
+                 "future", "deadline", "t_enqueue", "priority")
+
+    def __init__(self, prompt, max_new_tokens, eos_token=None,
+                 deadline=None, priority=1):
+        self.prompt = prompt                    # 1-D int32 host array
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token = eos_token
+        self.n = 1                              # one sequence
+        self.future = concurrent.futures.Future()
+        self.deadline = deadline
+        self.priority = int(priority)
+        self.t_enqueue = time.monotonic()
+
+    def age(self, now=None):
+        return (now if now is not None else time.monotonic()) \
+            - self.t_enqueue
+
+    def resolve_result(self, value):
+        try:
+            self.future.set_result(value)
+        except concurrent.futures.InvalidStateError:
+            pass
+
+    def resolve_exception(self, exc):
+        try:
+            self.future.set_exception(exc)
+        except concurrent.futures.InvalidStateError:
+            pass
+
+
+class _Slot:
+    """Host-side state of one decode-batch lane."""
+
+    __slots__ = ("req", "length", "tokens", "last_token")
+
+    def __init__(self):
+        self.req = None          # DecodeRequest occupying the lane
+        self.length = 0          # tokens resident in the KV arena
+        self.tokens = None       # generated so far (list of int)
+        self.last_token = 0      # next decode input
+
+
+class GenerateEngine:
+    """Continuous-batching decode over one model replica.
+
+    Parameters
+    ----------
+    model : the decode-model contract above (see :func:`demo_model`).
+    slots : decode batch width — sequences served concurrently.
+    page / factor / max_len : the KV arena's capacity schedule
+        (``grow_buckets(page, factor, max_len)``); ``max_len`` caps
+        prompt + generated tokens per sequence.
+    prompt_buckets : prefill length buckets (default: the capacity
+        family). One prefill executable per bucket; a prompt longer
+        than the largest bucket is rejected at submit.
+    queue_depth / deadline_ms / shed / slo_goodput_floor : the PR 14
+        admission-ladder knobs, identical semantics to
+        ``ServingEngine``.
+    refill : ``"continuous"`` (default — freed slots refill at the next
+        tick) or ``"drain"`` (run-to-completion waves: no admission
+        until *every* slot is free — the static-batching baseline the
+        loadgen A/Bs against; same executables, different discipline).
+    start : launch the tick thread now (False = tests drive
+        :meth:`tick` manually).
+    """
+
+    def __init__(self, model, slots=8, page=64, factor=2.0, max_len=512,
+                 prompt_buckets=None, queue_depth=256, deadline_ms=None,
+                 refill="continuous", shed=True, slo_goodput_floor=0.90,
+                 start=True, replica_id=None, on_outcome=None):
+        import jax
+        self._jax = jax
+        self.model = model
+        self.replica_id = replica_id
+        self.on_outcome = on_outcome
+        if refill not in ("continuous", "drain"):
+            raise ValueError(
+                f"refill must be 'continuous' or 'drain', got {refill!r}")
+        self.refill = refill
+        self.pool = KVCachePool(model.kv_spec(), slots, page=page,
+                                factor=factor, max_len=max_len)
+        self.slots = self.pool.slots
+        self.max_len = self.pool.max_len
+        if prompt_buckets is None:
+            self.prompt_buckets = tuple(self.pool.seq_buckets)
+        else:
+            pb = tuple(sorted({int(b) for b in prompt_buckets}))
+            if not pb or pb[-1] > self.max_len:
+                raise ValueError(
+                    f"prompt_buckets {pb} must be non-empty and within "
+                    f"max_len={self.max_len}")
+            self.prompt_buckets = pb
+        self.admission = AdmissionController(
+            max_queue_depth=queue_depth, default_deadline_ms=deadline_ms,
+            shed=shed, slo_goodput_floor=slo_goodput_floor)
+        self.admission.on_event = self._admission_event
+        self._queue = collections.deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._slots = [_Slot() for _ in range(self.slots)]
+        # (kind, *buckets) -> jitted executable; single-writer (the tick
+        # thread / warmup), so no lock — reads are atomic dict gets
+        self._exec = {}
+        # incremented INSIDE jitted bodies at trace time: any retrace —
+        # even one that reuses an existing key — moves this counter, so
+        # the zero-recompile gate catches dtype/shape drift too
+        self._trace_count = 0
+        self._stats_lock = threading.Lock()
+        self._stats = {"submitted": 0, "completed": 0, "failed": 0,
+                       "rejected": 0, "expired": 0, "shed": 0,
+                       "ticks": 0, "tokens": 0, "prefills": 0,
+                       "prefill_tokens": 0, "compiles": 0, "grows": 0}
+        self._occupancy_sum = 0.0
+        self._running = False
+        self._closed = False
+        self._draining = False
+        self._thread = None
+        self._tick_t0 = None
+        self._last_progress = time.monotonic()
+        self._last_ok_t = time.monotonic()
+        import weakref
+        from ..monitor import sampler as _sampler
+        ref = weakref.ref(self)
+
+        def _depth_series():
+            eng = ref()
+            if eng is None:
+                return None
+            return {"serving.queue_depth": eng.depth()}
+
+        self._sampler_key = _sampler.register_provider(
+            f"serving-generate-{id(self)}", _depth_series)
+        if start:
+            self.start()
+
+    # -- client surface ----------------------------------------------------
+
+    def make_request(self, prompt, max_new_tokens=32, eos_token=None,
+                     deadline_ms=None, priority=None):
+        """Validate one submit into a :class:`DecodeRequest` (not yet
+        enqueued — the fleet wrapper builds once, then routes)."""
+        arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        if arr.size < 1:
+            raise ValueError("empty prompt")
+        if arr.size > self.prompt_buckets[-1]:
+            raise ValueError(
+                f"prompt of {arr.size} tokens exceeds the largest prefill "
+                f"bucket {self.prompt_buckets[-1]} — raise max_len / "
+                f"prompt_buckets")
+        m = int(max_new_tokens)
+        if m < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, got {m}")
+        if arr.size + m > self.max_len:
+            raise ValueError(
+                f"prompt {arr.size} + max_new_tokens {m} exceeds the KV "
+                f"arena max_len={self.max_len}")
+        deadline = (Deadline.after_ms(deadline_ms)
+                    if deadline_ms is not None else None)
+        return DecodeRequest(arr, m, eos_token=eos_token,
+                             deadline=deadline,
+                             priority=resolve_priority(priority))
+
+    def submit_request(self, req):
+        """Admit + enqueue; returns the future. Raises ``ShedError`` /
+        ``QueueFullError`` from the admission ladder."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("decode engine is closed")
+            self.admission.admit(req, len(self._queue))
+            self._queue.append(req)
+            depth = len(self._queue)
+            self._cond.notify()
+        metrics.record_submit(1)
+        metrics.record_queue_depth(depth)
+        with self._stats_lock:
+            self._stats["submitted"] += 1
+        return req.future
+
+    def submit(self, prompt, max_new_tokens=32, eos_token=None,
+               deadline_ms=None, priority=None):
+        """Enqueue one sequence; the future resolves to the generated
+        token ids (``np.int32``; the first token comes from the prefill
+        itself, EOS — when given and hit — is included and terminal)."""
+        return self.submit_request(self.make_request(
+            prompt, max_new_tokens=max_new_tokens, eos_token=eos_token,
+            deadline_ms=deadline_ms, priority=priority))
+
+    def run(self, prompt, max_new_tokens=32, eos_token=None,
+            deadline_ms=None, timeout=None, priority=None):
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_token=eos_token,
+                           deadline_ms=deadline_ms,
+                           priority=priority).result(timeout)
+
+    def depth(self):
+        with self._lock:
+            return len(self._queue)
+
+    # -- executables -------------------------------------------------------
+    #
+    # Every jitted body bumps _trace_count at TRACE time (the increment
+    # is a host side effect, re-executed only when XLA retraces), so
+    # executables() exposes both the key count and the honest trace
+    # count — the smoke gate pins the latter after warmup.
+
+    def _get_decode(self, cap):
+        key = ("decode", cap)
+        fn = self._exec.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        jnp = jax.numpy
+        decode_fn = self.model.decode_fn
+        n_slots = self.slots
+
+        def step(state, buffers, tokens, lengths, active):
+            self._trace_count += 1
+            logits, entry = decode_fn(state, tokens, buffers, lengths)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            pos = jnp.minimum(lengths, cap - 1)
+            rows = jnp.arange(n_slots)
+            out = {}
+            for name, buf in buffers.items():
+                upd = buf.at[rows, pos].set(entry[name])
+                mask = active.reshape((n_slots,) + (1,) * (buf.ndim - 1))
+                out[name] = jnp.where(mask, upd, buf)
+            return nxt, out
+
+        fn = jax.jit(step)
+        self._exec[key] = fn
+        self._note_compile(f"decode[cap={cap}]")
+        return fn
+
+    def _get_prefill(self, bucket):
+        key = ("prefill", bucket)
+        fn = self._exec.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        jnp = jax.numpy
+        prefill_fn = self.model.prefill_fn
+
+        def prefill(state, tokens, lengths):
+            self._trace_count += 1
+            kv, last_logits = prefill_fn(state, tokens, lengths)
+            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            return kv, first
+
+        fn = jax.jit(prefill)
+        self._exec[key] = fn
+        self._note_compile(f"prefill[L={bucket}]")
+        return fn
+
+    def _get_insert(self, bucket, cap):
+        key = ("insert", bucket, cap)
+        fn = self._exec.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+
+        def insert(buffers, chunk, slot):
+            self._trace_count += 1
+            out = {}
+            for name, buf in buffers.items():
+                start = (slot,) + (0,) * (buf.ndim - 1)
+                out[name] = jax.lax.dynamic_update_slice(
+                    buf, chunk[name], start)
+            return out
+
+        fn = jax.jit(insert)
+        self._exec[key] = fn
+        self._note_compile(f"insert[L={bucket}, cap={cap}]")
+        return fn
+
+    def _get_grow(self, old_cap, new_cap):
+        key = ("grow", old_cap, new_cap)
+        fn = self._exec.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        jnp = jax.numpy
+        extra = new_cap - old_cap
+
+        def grow(buffers):
+            self._trace_count += 1
+            out = {}
+            for name, buf in buffers.items():
+                pad = [(0, 0)] * buf.ndim
+                pad[1] = (0, extra)
+                out[name] = jnp.pad(buf, pad)
+            return out
+
+        fn = jax.jit(grow)
+        self._exec[key] = fn
+        self._note_compile(f"grow[{old_cap}->{new_cap}]")
+        return fn
+
+    def _note_compile(self, what):
+        metrics.record_decode_compile(1, what=what)
+        with self._stats_lock:
+            self._stats["compiles"] += 1
+
+    def executables(self):
+        """(executable count, trace count) — both must stay flat after
+        :meth:`warmup` across any amount of join/leave churn."""
+        return len(self._exec), self._trace_count
+
+    def warmup(self, *_signatures):
+        """Mint and trace every executable the engine can ever need:
+        one decode step per capacity bucket, one grow per consecutive
+        bucket pair, one prefill per prompt bucket, and one insert per
+        (prompt bucket, capacity) pair that can co-occur. After this,
+        steady-state churn — including cache growth — runs entirely on
+        cached executables. Returns the number compiled. (Positional
+        signatures from the fleet wrapper are accepted and ignored —
+        a decode engine's shapes come from its bucket families.)"""
+        import jax.numpy as jnp
+        before = len(self._exec)
+        family = self.pool.seq_buckets
+        spec = self.pool._leaf_list
+        state = self.model.state
+        tokens_s = jnp.zeros((self.slots,), jnp.int32)
+        ones_s = jnp.ones((self.slots,), jnp.int32)
+        active = jnp.zeros((self.slots,), bool)
+        with _monitor.trace.span("serving.warmup",
+                                 buckets=len(family)):
+            for cap in family:
+                bufs = {name: jnp.zeros((self.slots, cap) + tail, dt)
+                        for name, tail, dt in spec}
+                nxt, out = self._get_decode(cap)(
+                    state, bufs, tokens_s, ones_s, active)
+                self._jax.block_until_ready(nxt)
+                for lb in self.prompt_buckets:
+                    if lb > cap:
+                        continue
+                    chunk = {name: jnp.zeros((1, lb) + tail, dt)
+                             for name, tail, dt in spec}
+                    self._jax.block_until_ready(self._get_insert(lb, cap)(
+                        bufs, chunk, jnp.int32(0)))
+            for old, new in zip(family, family[1:]):
+                bufs = {name: jnp.zeros((self.slots, old) + tail, dt)
+                        for name, tail, dt in spec}
+                self._jax.block_until_ready(self._get_grow(old, new)(bufs))
+            for lb in self.prompt_buckets:
+                kv, first = self._get_prefill(lb)(
+                    state, jnp.zeros((1, lb), jnp.int32),
+                    jnp.ones((1,), jnp.int32))
+                self._jax.block_until_ready(first)
+        return len(self._exec) - before
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        with self._lock:
+            if self._running or self._closed:
+                return
+            self._running = True
+            self._draining = False
+            self._thread = threading.Thread(
+                target=self._worker, name="paddle_tpu-serving-decode",
+                daemon=True)
+            self._thread.start()
+
+    def close(self, drain=True, timeout=None):
+        """Stop the tick thread. ``drain=True`` keeps ticking until the
+        queue and every slot are empty (bounded join); anything left
+        after the join fails with RuntimeError — a future is never
+        silently lost."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._running = False
+            self._draining = bool(drain)
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            if timeout is None:
+                timeout = 10.0 if drain else 5.0
+            t.join(timeout)
+        leftovers = []
+        with self._cond:
+            leftovers.extend(self._queue)
+            self._queue.clear()
+            for s, slot in enumerate(self._slots):
+                if slot.req is not None:
+                    leftovers.append(slot.req)
+                    slot.req = None
+                    self.pool.free(s)
+        for r in leftovers:
+            r.resolve_exception(RuntimeError("decode engine closed"))
+        from ..monitor import sampler as _sampler
+        _sampler.unregister_provider(self._sampler_key)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- supervision surface (the MultiDeviceEngine contract) --------------
+
+    def heartbeat(self, now=None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            t0 = self._tick_t0
+            depth = len(self._queue)
+        return {
+            "queue_depth": depth,
+            "inflight_age_s": None if t0 is None else now - t0,
+            "inflight_token": t0,
+            "last_progress_age_s": now - self._last_progress,
+            "last_ok_age_s": now - self._last_ok_t,
+        }
+
+    def probe(self, timeout_s=1.0):
+        """Half-open test traffic: run the decode executable on an
+        all-inactive batch on a side thread (the tick thread may be the
+        thing that's wedged) and report whether it finished in time."""
+        import jax.numpy as jnp
+        if ("decode", self.pool.capacity) not in self._exec:
+            return None          # never warmed / served — nothing to test
+        done = threading.Event()
+        err = []
+
+        def _go():
+            try:
+                fn = self._exec[("decode", self.pool.capacity)]
+                nxt, _ = fn(self.model.state, self.pool.buffers,
+                            jnp.zeros((self.slots,), jnp.int32),
+                            jnp.zeros((self.slots,), jnp.int32),
+                            jnp.zeros((self.slots,), bool))
+                self._jax.block_until_ready(nxt)
+            except BaseException as e:   # noqa: BLE001 - probe verdict
+                err.append(e)
+            finally:
+                done.set()
+
+        threading.Thread(target=_go, daemon=True,
+                         name="paddle_tpu-decode-probe").start()
+        ok = done.wait(timeout_s) and not err
+        if ok:
+            self._last_ok_t = time.monotonic()
+        return bool(ok)
+
+    def steal_pending(self):
+        """Failover: hand every queued request to the caller."""
+        with self._cond:
+            taken = list(self._queue)
+            self._queue.clear()
+        metrics.record_queue_depth(0)
+        return taken
+
+    def disown_inflight(self):
+        """Failover: evict every live sequence and hand its request
+        over. Partial output is discarded — greedy decode is
+        deterministic, so the adopting replica regenerates the same
+        tokens from the prompt (first resolution wins either way)."""
+        taken = []
+        with self._lock:
+            for s, slot in enumerate(self._slots):
+                if slot.req is not None:
+                    taken.append(slot.req)
+                    slot.req = None
+                    slot.tokens = None
+                    self.pool.free(s)
+        return taken
+
+    def requeue(self, requests):
+        """Failover re-dispatch: front-of-queue, no re-admission."""
+        if not requests:
+            return
+        with self._cond:
+            if self._closed:
+                for r in requests:
+                    r.resolve_exception(
+                        RuntimeError("decode engine closed"))
+                return
+            for r in reversed(requests):
+                self._queue.appendleft(r)
+            depth = len(self._queue)
+            self._cond.notify()
+        metrics.record_queue_depth(depth)
+
+    def _note_outcome(self, ok, exc=None):
+        if ok:
+            self._last_ok_t = time.monotonic()
+        cb = self.on_outcome
+        if cb is not None:
+            try:
+                cb(ok, exc)
+            except Exception:   # noqa: BLE001 - observer must not kill
+                pass            # the tick thread
+
+    def _admission_event(self, event):
+        key = {"rejected": "rejected", "expired": "expired",
+               "poisoned": "failed", "shed": "shed"}.get(event)
+        if key is not None:
+            with self._stats_lock:
+                self._stats[key] += 1
+
+    def stats(self):
+        with self._stats_lock:
+            s = dict(self._stats)
+            occ_sum = self._occupancy_sum
+        s["queue_depth"] = self.depth()
+        s["active_slots"] = self.pool.used_slots()
+        s["slots"] = self.slots
+        s["avg_occupancy"] = (occ_sum / s["ticks"]) if s["ticks"] else 0.0
+        s["executables"] = len(self._exec)
+        s["traces"] = self._trace_count
+        s.update({f"pool_{k}": v for k, v in self.pool.stats().items()
+                  if isinstance(v, (int, float))})
+        return s
+
+    # -- the tick loop -----------------------------------------------------
+
+    def _worker(self):
+        while True:
+            did_work = self.tick()
+            if did_work:
+                continue
+            with self._cond:
+                if not self._running:
+                    if self._draining and (
+                            self._queue or self.pool.used_slots()):
+                        continue    # drain: keep ticking until empty
+                    return
+                if not self._queue and self.pool.used_slots() == 0:
+                    self._cond.wait(0.05)
+
+    def tick(self):
+        """One engine step: admit into free slots (per the refill
+        discipline), then advance every live sequence one token.
+        Returns whether any work happened. Tests call this directly
+        (``start=False``); the daemon loop drives it otherwise."""
+        t0 = time.monotonic()
+        with self._lock:
+            self._tick_t0 = t0
+        try:
+            admitted = self._admit()
+            stepped = self._decode_once()
+        finally:
+            with self._lock:
+                self._tick_t0 = None
+                self._last_progress = time.monotonic()
+        return bool(admitted or stepped)
+
+    # -- admission into slots ----------------------------------------------
+
+    def _pop_next_locked(self, now):
+        """Highest-priority (then FIFO) non-expired request, sweeping
+        expired ones out as they surface. Caller holds the lock;
+        expired requests are returned for resolution outside it."""
+        expired = []
+        while self._queue:
+            best_i, best_p = 0, self._queue[0].priority
+            for i, r in enumerate(self._queue):
+                if r.priority < best_p:
+                    best_i, best_p = i, r.priority
+            r = self._queue[best_i]
+            del self._queue[best_i]
+            if self.admission.is_expired(r, now):
+                expired.append(r)
+                continue
+            return r, expired
+        return None, expired
+
+    def _admit(self):
+        if self.refill == "drain" and self.pool.used_slots() != 0:
+            return 0            # run-to-completion baseline: wait out
+        admitted = 0            # the whole wave
+        while self.pool.free_slots() > 0:
+            now = time.monotonic()
+            with self._cond:
+                req, expired = self._pop_next_locked(now)
+                depth = len(self._queue)
+            for r in expired:
+                self.admission.expire(r)
+            metrics.record_queue_depth(depth)
+            if req is None:
+                break
+            try:
+                self._prefill_into_slot(req)
+                admitted += 1
+            except BaseException as e:   # noqa: BLE001 - to the future
+                self._note_outcome(False, e)
+                with self._stats_lock:
+                    self._stats["failed"] += 1
+                req.resolve_exception(e)
+        return admitted
+
+    def _ensure_capacity(self, needed_len):
+        target = self.pool.capacity_for(needed_len)
+        while self.pool.capacity < target:
+            old = self.pool.capacity
+            new = next_bucket(old + 1, self.pool.seq_buckets)
+            fn = self._get_grow(old, new)
+            self.pool.grow_to(new, lambda bufs, _o, _n: fn(bufs))
+            with self._stats_lock:
+                self._stats["grows"] += 1
+
+    def _prefill_into_slot(self, req):
+        """Prompt ingest: run the bucketed prefill executable, write the
+        KV pages into a freed slot's arena rows, seat the sequence. The
+        first generated token falls out of the prefill itself."""
+        import jax.numpy as jnp
+        p = int(req.prompt.size)
+        bucket = next_bucket(p, self.prompt_buckets)
+        # the arena must hold the prompt pages, the first decode write
+        # (position p), and the full insert bucket
+        self._ensure_capacity(max(p + 1, bucket))
+        s = self.pool.alloc()
+        if s is None:
+            raise RuntimeError("no free slot after free_slots() > 0")
+        try:
+            if _faults.enabled():
+                _faults.maybe_serving_fault(self.replica_id)
+            t0 = time.monotonic()
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :p] = req.prompt
+            kv, first = self._get_prefill(bucket)(
+                self.model.state, jnp.asarray(tokens),
+                jnp.asarray([p], jnp.int32))
+            first = int(first[0])
+            self.pool.buffers = self._get_insert(bucket,
+                                                 self.pool.capacity)(
+                self.pool.buffers, kv, jnp.int32(s))
+            ms = (time.monotonic() - t0) * 1e3
+            metrics.record_prefill(p, ms, bucket)
+            with self._stats_lock:
+                self._stats["prefills"] += 1
+                self._stats["prefill_tokens"] += p
+        except BaseException:
+            self.pool.free(s)
+            raise
+        self._note_outcome(True)
+        done = (req.eos_token is not None and first == req.eos_token) \
+            or req.max_new_tokens == 1
+        if done:
+            self.pool.free(s)
+            self._complete(req, [first])
+            return
+        slot = self._slots[s]
+        with self._lock:
+            slot.req = req
+            slot.length = p
+            slot.tokens = [first]
+            slot.last_token = first
+
+    # -- the fused decode step ---------------------------------------------
+
+    def _decode_once(self):
+        import jax.numpy as jnp
+        with self._lock:
+            assigned = [(s, slot.req) for s, slot in enumerate(self._slots)
+                        if slot.req is not None]
+            if not assigned:
+                return False
+            tokens = np.zeros((self.slots,), np.int32)
+            lengths = np.zeros((self.slots,), np.int32)
+            active = np.zeros((self.slots,), bool)
+            max_needed = 0
+            for s, _req in assigned:
+                slot = self._slots[s]
+                tokens[s] = slot.last_token
+                lengths[s] = slot.length
+                active[s] = True
+                max_needed = max(max_needed, slot.length + 1)
+        self._ensure_capacity(max_needed)
+        try:
+            if _faults.enabled():
+                _faults.maybe_serving_fault(self.replica_id)
+            t0 = time.monotonic()
+            fn = self._get_decode(self.pool.capacity)
+            nxt, new_bufs = fn(self.model.state, self.pool.buffers,
+                               jnp.asarray(tokens), jnp.asarray(lengths),
+                               jnp.asarray(active))
+            nxt = np.asarray(nxt)
+            step_ms = (time.monotonic() - t0) * 1e3
+        except BaseException as e:   # noqa: BLE001 - fail the wave
+            self._note_outcome(False, e)
+            self._fail_active(assigned, e)
+            return True
+        self._note_outcome(True)
+        self.pool.buffers = new_bufs
+        finished = []
+        with self._lock:
+            n_active = 0
+            for s, req in assigned:
+                slot = self._slots[s]
+                if slot.req is not req:
+                    continue        # disowned / failed over mid-step
+                n_active += 1
+                tok = int(nxt[s])
+                slot.length += 1
+                slot.tokens.append(tok)
+                slot.last_token = tok
+                if (req.eos_token is not None and tok == req.eos_token) \
+                        or len(slot.tokens) >= req.max_new_tokens:
+                    finished.append((req, slot.tokens))
+                    slot.req = None
+                    slot.tokens = None
+                    self.pool.free(s)
+            occupancy = n_active / self.slots
+        with self._stats_lock:
+            self._stats["ticks"] += 1
+            self._stats["tokens"] += n_active
+            self._occupancy_sum += occupancy
+        metrics.record_decode_tick(n_active, self.slots, n_active, step_ms)
+        for req, toks in finished:
+            self._complete(req, toks)
+        return True
+
+    def _fail_active(self, assigned, exc):
+        with self._lock:
+            failed = []
+            for s, req in assigned:
+                slot = self._slots[s]
+                if slot.req is not req:
+                    continue
+                failed.append(req)
+                slot.req = None
+                slot.tokens = None
+                self.pool.free(s)
+        with self._stats_lock:
+            self._stats["failed"] += len(failed)
+        for r in failed:
+            r.resolve_exception(exc)
+
+    def _complete(self, req, tokens):
+        now = time.monotonic()
+        latency_ms = req.age(now) * 1e3
+        within = req.deadline is None or not req.deadline.expired(now)
+        req.resolve_result(np.asarray(tokens, np.int32))
+        metrics.record_completed(1, [latency_ms], within_sla=[within])
+        with self._stats_lock:
+            self._stats["completed"] += 1
+
+
+# ---------------------------------------------------------------------------
+# fleet fan-out
+
+
+def replicate_decode(model, devices=None):
+    """One model view per device: the state pytree is ``device_put``
+    onto each device; hyperparameters and the pure prefill/decode
+    functions are shared (the decode analogue of ``multi.replicate``)."""
+    import copy
+    import jax
+    devices = list(devices) if devices is not None else jax.local_devices()
+    if not devices:
+        raise ValueError("replicate_decode: no devices")
+    out = []
+    for d in devices:
+        m = copy.copy(model)
+        m.state = jax.device_put(model.state, d)
+        m.device = d
+        out.append(m)
+    return out
+
+
+class MultiDecodeEngine(MultiDeviceEngine):
+    """Breaker-aware decode fan-out: one :class:`GenerateEngine` per
+    device replica, behind the same supervision spine as fixed-shape
+    serving — per-replica circuit breakers, hang failover (evicted
+    sequences regenerate deterministically on the adopting replica),
+    half-open probes, restart, and supervisor scaling (goodput floor
+    plus the new ``tokens_floor``).
+
+    Hedging defaults OFF for decode (``hedge_ms=0``): a decode request
+    occupies a slot for its whole lifetime, so a hedge doubles slot
+    pressure for the duration rather than shaving a straggler's tail —
+    exactly the wrong trade under load. Pass ``hedge_ms`` explicitly to
+    re-enable it for latency-critical, lightly-loaded fleets."""
+
+    def __init__(self, model, devices=None, hedge_ms=0, **kwargs):
+        super().__init__(model, devices=devices, hedge_ms=hedge_ms,
+                         **kwargs)
+
+    def _replicate(self, model, devices):
+        return replicate_decode(model, devices)
+
+    def _new_engine(self, model, index, on_outcome):
+        return GenerateEngine(model, replica_id=index,
+                              on_outcome=on_outcome,
+                              **self._engine_kwargs)
+
+    def submit(self, prompt, max_new_tokens=32, eos_token=None,
+               deadline_ms=None, priority=None):
+        rep = self._pick_replica()
+        req = rep.engine.make_request(prompt,
+                                      max_new_tokens=max_new_tokens,
+                                      eos_token=eos_token,
+                                      deadline_ms=deadline_ms,
+                                      priority=priority)
+        fut = rep.engine.submit_request(req)
+        with self._hedge_lock:
+            self._submitted += 1
+        delay = self._hedge_delay_s
+        if self._hedger is not None and delay and len(self._replicas) > 1:
+            self._hedger.schedule(req, rep.index, delay)
+        return fut
+
+    def run(self, prompt, max_new_tokens=32, eos_token=None,
+            deadline_ms=None, timeout=None, priority=None):
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_token=eos_token,
+                           deadline_ms=deadline_ms,
+                           priority=priority).result(timeout)
+
+    def _maybe_hedge(self, req, primary_index):
+        """Decode hedge: re-prefill the same prompt on a second replica
+        (greedy decode is deterministic, so both produce the same
+        tokens; first resolution wins)."""
+        if req.future.done():
+            return
+        with self._hedge_lock:
+            if self._hedged >= self.hedge_budget * self._submitted:
+                return
+            self._hedged += 1
+        try:
+            rep = self._pick_replica(exclude=(primary_index,))
+        except Exception:
+            with self._hedge_lock:
+                self._hedged -= 1
+            return
+        shadow = DecodeRequest(req.prompt, req.max_new_tokens,
+                               eos_token=req.eos_token,
+                               deadline=req.deadline,
+                               priority=req.priority)
+        metrics.record_hedge(replica=rep.index)
+
+        def _on_shadow_done(sf, _req=req, _idx=rep.index):
+            if sf.cancelled() or sf.exception() is not None:
+                return
+            try:
+                _req.future.set_result(sf.result())
+            except concurrent.futures.InvalidStateError:
+                return
+            with self._hedge_lock:
+                self._hedge_wins += 1
+            metrics.record_hedge_win(replica=_idx)
+
+        shadow.future.add_done_callback(_on_shadow_done)
+        try:
+            rep.engine.submit_request(shadow)
+        except Exception:
+            with self._hedge_lock:
+                self._hedged -= 1
+
+
+# ---------------------------------------------------------------------------
+# the reference decode model
+
+
+class DemoLM:
+    """A small causal-LM implementation of the decode-model contract:
+    tied-embedding transformer (RMSNorm, per-layer attention + MLP),
+    prefill through the flash-attention op (sdpa fallback off-TPU),
+    decode as a single-token attention over the KV arena. Fixed random
+    weights — it generates structured gibberish deterministically,
+    which is exactly what throughput and parity tests need."""
+
+    def __init__(self, vocab=64, dim=32, heads=2, layers=2, max_len=512,
+                 seed=0):
+        import jax
+        import jax.numpy as jnp
+        if dim % heads:
+            raise ValueError(f"dim {dim} not divisible by heads {heads}")
+        self.vocab = int(vocab)
+        self.dim = int(dim)
+        self.heads = int(heads)
+        self.head_dim = self.dim // self.heads
+        self.layers = int(layers)
+        self.max_len = int(max_len)
+        keys = jax.random.split(jax.random.PRNGKey(seed),
+                                2 + 6 * self.layers)
+        scale = 1.0 / np.sqrt(self.dim)
+        state = {"embed": jax.random.normal(
+            keys[0], (self.vocab, self.dim), jnp.float32) * scale}
+        # sinusoidal positions: deterministic, length-extensible, and
+        # identical between prefill and decode by construction
+        pos = np.arange(self.max_len)[:, None]
+        div = np.exp(np.arange(0, self.dim, 2)
+                     * (-np.log(10000.0) / self.dim))
+        table = np.zeros((self.max_len, self.dim), np.float32)
+        table[:, 0::2] = np.sin(pos * div)
+        table[:, 1::2] = np.cos(pos * div)
+        state["pos"] = jnp.asarray(table)
+        for layer in range(self.layers):
+            k = keys[2 + 6 * layer: 8 + 6 * layer]
+            state[f"wq{layer}"] = jax.random.normal(
+                k[0], (self.dim, self.dim), jnp.float32) * scale
+            state[f"wk{layer}"] = jax.random.normal(
+                k[1], (self.dim, self.dim), jnp.float32) * scale
+            state[f"wv{layer}"] = jax.random.normal(
+                k[2], (self.dim, self.dim), jnp.float32) * scale
+            state[f"wo{layer}"] = jax.random.normal(
+                k[3], (self.dim, self.dim), jnp.float32) * scale
+            state[f"w1{layer}"] = jax.random.normal(
+                k[4], (self.dim, 2 * self.dim), jnp.float32) * scale
+            state[f"w2{layer}"] = jax.random.normal(
+                k[5], (2 * self.dim, self.dim), jnp.float32) * scale
+        self.state = state
+        self.device = None
+
+    def kv_spec(self):
+        tail = (self.heads, self.head_dim)
+        spec = {}
+        for layer in range(self.layers):
+            spec[f"k{layer}"] = (tail, "float32")
+            spec[f"v{layer}"] = (tail, "float32")
+        return spec
+
+    @staticmethod
+    def _norm(x):
+        import jax.numpy as jnp
+        return x * jnp.reciprocal(
+            jnp.sqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+                     + 1e-6))
+
+    def prefill_fn(self, state, tokens, lengths):
+        """Full-prompt forward: (B, L) -> KV chunks + last-token logits.
+        Causal attention makes end-padding harmless — every real
+        position only sees real positions."""
+        import jax.numpy as jnp
+        from ..ops.pallas.flash_attention import flash_attention
+        b, seq = tokens.shape
+        h, hd = self.heads, self.head_dim
+        x = state["embed"][tokens] + state["pos"][:seq][None]
+        kv = {}
+        for layer in range(self.layers):
+            hidden = self._norm(x)
+            q = (hidden @ state[f"wq{layer}"]).reshape(b, seq, h, hd)
+            k = (hidden @ state[f"wk{layer}"]).reshape(b, seq, h, hd)
+            v = (hidden @ state[f"wv{layer}"]).reshape(b, seq, h, hd)
+            kv[f"k{layer}"] = k
+            kv[f"v{layer}"] = v
+            out = flash_attention(jnp.transpose(q, (0, 2, 1, 3)),
+                                  jnp.transpose(k, (0, 2, 1, 3)),
+                                  jnp.transpose(v, (0, 2, 1, 3)),
+                                  causal=True)
+            out = getattr(out, "data", out)     # dispatch may wrap Tensor
+            out = jnp.transpose(out, (0, 2, 1, 3)).reshape(b, seq,
+                                                           self.dim)
+            x = x + out @ state[f"wo{layer}"]
+            hidden = self._norm(x)
+            x = x + jnp.maximum(
+                hidden @ state[f"w1{layer}"], 0.0) @ state[f"w2{layer}"]
+        logits = self._norm(x) @ state["embed"].T
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        return kv, last
+
+    def decode_fn(self, state, tokens, kv, lengths):
+        """One token per slot against the KV arena: attend over the
+        resident history (masked by live length) plus the incoming
+        token's own K/V — the same math as prefill position
+        ``lengths`` — and emit that token's cache entry."""
+        import jax.numpy as jnp
+        s = tokens.shape[0]
+        h, hd = self.heads, self.head_dim
+        cap = next(iter(kv.values())).shape[1]
+        inv = 1.0 / np.sqrt(hd)
+        x = state["embed"][tokens] + state["pos"][lengths]
+        entry = {}
+        hist_mask = (jnp.arange(cap)[None, None, :]
+                     < lengths[:, None, None])
+        for layer in range(self.layers):
+            hidden = self._norm(x)
+            q = (hidden @ state[f"wq{layer}"]).reshape(s, h, hd)
+            k_new = (hidden @ state[f"wk{layer}"]).reshape(s, h, hd)
+            v_new = (hidden @ state[f"wv{layer}"]).reshape(s, h, hd)
+            entry[f"k{layer}"] = k_new
+            entry[f"v{layer}"] = v_new
+            scores_h = jnp.einsum("shd,schd->shc", q,
+                                  kv[f"k{layer}"]) * inv
+            scores_h = jnp.where(hist_mask, scores_h, -1e9)
+            score_s = jnp.sum(q * k_new, axis=-1,
+                              keepdims=True) * inv
+            scores = jnp.concatenate([scores_h, score_s], axis=-1)
+            probs = jnp.exp(scores - jnp.max(scores, axis=-1,
+                                             keepdims=True))
+            probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+            out = jnp.einsum("shc,schd->shd", probs[..., :cap],
+                             kv[f"v{layer}"]) \
+                + probs[..., cap:] * v_new
+            x = x + out.reshape(s, self.dim) @ state[f"wo{layer}"]
+            hidden = self._norm(x)
+            x = x + jnp.maximum(
+                hidden @ state[f"w1{layer}"], 0.0) @ state[f"w2{layer}"]
+        logits = self._norm(x) @ state["embed"].T
+        return logits, entry
+
+
+def demo_model(vocab=64, dim=32, heads=2, layers=2, max_len=512, seed=0):
+    """The reference decode model for docs, tests, the loadgen, and the
+    smoke/bench stages."""
+    return DemoLM(vocab=vocab, dim=dim, heads=heads, layers=layers,
+                  max_len=max_len, seed=seed)

@@ -1,0 +1,242 @@
+"""paddle_tpu.serving.kv_cache — the paged KV-cache pool behind
+continuous-batching decode.
+
+Autoregressive serving lives or dies on its KV-cache discipline
+(PAPERS.md: Gemma-on-TPU serving): every active sequence needs its
+attention history resident on device, histories grow one token per
+step, and sequences of wildly different lengths share the same decode
+executable. Three constraints shape the pool:
+
+* **Fixed slot count.** The decode batch is ``slots`` wide, always.
+  A sequence occupies one slot from prefill handoff to EOS; freeing a
+  slot is a host-side bookkeeping write, so a finished sequence's slot
+  is refillable at the very next tick — no drain-the-batch barrier.
+* **Bucketed capacity, never ragged.** Per-slot K/V storage is one
+  arena per spec leaf, shaped ``[slots, capacity, *tail]``.
+  ``capacity`` only ever moves along a closed
+  :func:`~paddle_tpu.io.bucketing.grow_buckets` family (the *page
+  schedule*): when any sequence outgrows the current capacity the whole
+  arena steps to the next bucket via a pre-compiled copy. Every shape
+  the pool can ever take is declared up front, so :meth:`warmup` can
+  AOT-compile all of them and steady-state growth performs **zero**
+  fresh compiles.
+* **Budgeted, not discovered.** ``bytes()`` is exact arithmetic over
+  the spec (``slots × capacity × Σ leaf bytes/token``), published as
+  ``serving.decode.cache_bytes`` with headroom against the PR 12
+  memory model's device budget (``monitor.memory.device_hbm_limit``) —
+  the pool tells you its peak *before* you hit it, the same pre-flight
+  discipline as ``memory_plan``.
+
+The pool owns buffers and slot bookkeeping; the decode engine
+(``serving/generate.py``) owns the jitted prefill/decode/insert
+executables that read and write them.
+"""
+from __future__ import annotations
+
+import math
+import threading
+
+import numpy as np
+
+from ..io.bucketing import grow_buckets, next_bucket
+from . import metrics
+
+
+def _leaves(spec):
+    """Normalize a kv spec — a dict of leaf name -> (tail_shape, dtype)
+    — into a sorted list of (name, tail_shape, np.dtype)."""
+    out = []
+    for name in sorted(spec):
+        tail, dtype = spec[name]
+        out.append((name, tuple(int(d) for d in tail), np.dtype(dtype)))
+    return out
+
+
+def bytes_per_token(spec):
+    """Exact per-token KV footprint of one sequence: the sum over spec
+    leaves of ``prod(tail) * dtype.itemsize``."""
+    return sum(int(np.prod(tail, dtype=np.int64)) * dt.itemsize
+               for _, tail, dt in _leaves(spec))
+
+
+class KVCachePool:
+    """Fixed-slot paged K/V arena with geometric capacity growth.
+
+    Parameters
+    ----------
+    spec : dict of leaf name -> (tail_shape, dtype) — the per-token KV
+        layout (e.g. ``{"k0": ((H, D), "float32"), "v0": ...}`` per
+        layer). The decode model declares it (``model.kv_spec()``).
+    slots : decode batch width — concurrent sequences served.
+    page : smallest capacity bucket (tokens). Capacity starts here.
+    factor / max_len : the geometric page schedule —
+        ``grow_buckets(page, factor, max_len)``. ``max_len`` is the
+        hard ceiling on prompt + generated tokens per sequence.
+    """
+
+    def __init__(self, spec, slots, page=128, factor=2.0, max_len=1024):
+        import jax.numpy as jnp
+        self.spec = dict(spec)
+        self.slots = int(slots)
+        if self.slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.seq_buckets = grow_buckets(page, factor, max_len)
+        self.max_len = int(self.seq_buckets[-1])
+        self.capacity = int(self.seq_buckets[0])
+        self._leaf_list = _leaves(self.spec)
+        self.buffers = {
+            name: jnp.zeros((self.slots, self.capacity) + tail, dtype=dt)
+            for name, tail, dt in self._leaf_list}
+        self._lock = threading.Lock()
+        self._free = list(range(self.slots))[::-1]   # pop() -> slot 0 first
+        self._grows = 0
+        self._publish()
+
+    # -- slot bookkeeping --------------------------------------------------
+
+    def alloc(self):
+        """Claim a free slot index, or None when the batch is full."""
+        with self._lock:
+            return self._free.pop() if self._free else None
+
+    def free(self, slot):
+        """Return a slot to the pool. The stale K/V rows are left in
+        place — every reader masks by live length, so a freed slot's
+        garbage is never attended to, and the next prefill overwrites
+        it."""
+        with self._lock:
+            if slot in self._free:
+                raise ValueError(f"slot {slot} double-freed")
+            self._free.append(int(slot))
+
+    def free_slots(self):
+        with self._lock:
+            return len(self._free)
+
+    def used_slots(self):
+        with self._lock:
+            return self.slots - len(self._free)
+
+    # -- capacity schedule -------------------------------------------------
+
+    def capacity_for(self, needed_len):
+        """The family bucket a sequence of ``needed_len`` tokens needs
+        (raises when it exceeds ``max_len`` — admission should have
+        rejected it)."""
+        needed = int(needed_len)
+        if needed > self.max_len:
+            raise ValueError(
+                f"sequence of {needed} tokens exceeds the pool's "
+                f"max_len={self.max_len} (family {self.seq_buckets})")
+        return next_bucket(needed, self.seq_buckets)
+
+    def needs_growth(self, needed_len):
+        return self.capacity_for(needed_len) > self.capacity
+
+    def grow_to(self, new_capacity, grow_fn):
+        """Step the arena to ``new_capacity`` (a family member) using
+        ``grow_fn(buffers, old_cap, new_cap) -> buffers`` — supplied by
+        the engine so the copy rides a pre-compiled executable. Pages
+        are only ever added; the pool never shrinks mid-flight (slots
+        churn constantly; a shrink would need a stop-the-world over
+        every live sequence)."""
+        new_capacity = int(new_capacity)
+        if new_capacity not in self.seq_buckets:
+            raise ValueError(
+                f"capacity {new_capacity} is not in the bucket family "
+                f"{self.seq_buckets}")
+        if new_capacity <= self.capacity:
+            return
+        self.buffers = grow_fn(self.buffers, self.capacity, new_capacity)
+        self.capacity = new_capacity
+        self._grows += 1
+        metrics.record_cache_grow(new_capacity)
+        self._publish()
+
+    # -- budget ------------------------------------------------------------
+
+    def bytes(self, capacity=None):
+        """Exact arena footprint at ``capacity`` (default: current):
+        ``slots × capacity × bytes_per_token(spec)``."""
+        cap = self.capacity if capacity is None else int(capacity)
+        return self.slots * cap * bytes_per_token(self.spec)
+
+    def max_bytes(self):
+        """The worst-case footprint — every slot at ``max_len``. This is
+        the number to check against the HBM budget pre-flight."""
+        return self.bytes(self.max_len)
+
+    def allocated_bytes(self):
+        """What the live buffers actually occupy (must equal
+        :meth:`bytes` — the smoke gate's budget-honesty check)."""
+        return sum(int(b.nbytes) for b in self.buffers.values())
+
+    def headroom(self, limit_bytes=None):
+        """``(limit - max_bytes, limit)`` against the device budget from
+        the PR 12 memory model (``monitor.memory.device_hbm_limit``;
+        override with ``limit_bytes``). ``(None, None)`` when no budget
+        is known (CPU) — the pool never invents a verdict."""
+        if limit_bytes is None:
+            try:
+                from ..monitor.memory import device_hbm_limit
+                limit_bytes = device_hbm_limit()
+            except Exception:
+                limit_bytes = None
+        if limit_bytes is None:
+            return None, None
+        return int(limit_bytes) - self.max_bytes(), int(limit_bytes)
+
+    def _publish(self):
+        headroom, limit = self.headroom()
+        metrics.record_cache(self.bytes(), self.capacity,
+                             headroom_bytes=headroom, limit_bytes=limit)
+
+    def stats(self):
+        return {
+            "slots": self.slots,
+            "used_slots": self.used_slots(),
+            "capacity": self.capacity,
+            "max_len": self.max_len,
+            "seq_buckets": list(self.seq_buckets),
+            "cache_bytes": self.bytes(),
+            "cache_max_bytes": self.max_bytes(),
+            "grows": self._grows,
+        }
+
+
+def fits_budget(spec, slots, max_len, limit_bytes=None,
+                reserve_frac=0.0):
+    """Pre-flight: would a pool of ``slots × max_len`` fit under the
+    device budget with ``reserve_frac`` held back for weights and
+    activations? Returns (fits: bool | None, needed_bytes, limit).
+    None means no budget is known — same contract as the planner's
+    feasibility column."""
+    needed = int(slots) * int(max_len) * bytes_per_token(spec)
+    if limit_bytes is None:
+        try:
+            from ..monitor.memory import device_hbm_limit
+            limit_bytes = device_hbm_limit()
+        except Exception:
+            limit_bytes = None
+    if limit_bytes is None:
+        return None, needed, None
+    usable = int(limit_bytes) * (1.0 - float(reserve_frac))
+    return needed <= usable, needed, int(limit_bytes)
+
+
+def plan_slots(spec, max_len, limit_bytes=None, reserve_frac=0.5,
+               max_slots=256):
+    """Inverse budget: the largest slot count whose worst-case pool
+    fits in ``(1 - reserve_frac)`` of the budget. None when no budget
+    is known."""
+    if limit_bytes is None:
+        try:
+            from ..monitor.memory import device_hbm_limit
+            limit_bytes = device_hbm_limit()
+        except Exception:
+            limit_bytes = None
+    if limit_bytes is None:
+        return None
+    per_slot = int(max_len) * bytes_per_token(spec)
+    usable = int(limit_bytes) * (1.0 - float(reserve_frac))
+    return max(0, min(int(max_slots), int(math.floor(usable / per_slot))))

@@ -54,6 +54,31 @@ SLO rollups (published by the telemetry sampler via
 :func:`qps_now` each tick, which sweeps stale window entries instead
 of waiting for a next completion that never comes.
 
+Generative-decode series (the continuous-batching engine):
+
+* ``serving.decode.ticks`` / ``serving.decode.tokens`` — fused decode
+  steps executed and tokens they produced
+* ``serving.decode.slot_occupancy`` — gauge + histogram: active slots ÷
+  total slots per tick (continuous batching's whole point is holding
+  this near 1.0 under churn)
+* ``serving.decode.prefill_tokens`` / ``serving.decode.prefill_ms`` —
+  prompt tokens ingested and per-prefill latency histogram
+* ``serving.decode.step_ms`` — per-tick decode latency histogram
+* ``serving.decode.prefill_ratio`` — gauge: prefill time ÷ (prefill +
+  decode) time over the rolling window (how much of the engine is
+  spent ingesting prompts vs. emitting tokens)
+* ``serving.decode.compiles`` — executables minted by the decode path
+  (prefill buckets + decode step + cache grows; zero growth after
+  warmup is a smoke gate)
+* ``serving.decode.cache_bytes`` / ``serving.decode.cache_capacity`` /
+  ``serving.decode.cache_headroom`` — KV-pool footprint, its current
+  length bucket, and worst-case headroom vs the PR 12 memory model's
+  device budget
+* ``serving.decode.cache_grows`` — capacity steps along the bucket
+  family
+* ``slo.tokens_per_s`` / ``slo.decode_p99_ms`` — rolling decode SLO
+  window (:data:`TOKENS_WINDOW_S`) the supervisor scales replicas off
+
 Span sites (``monitor.trace``): ``serving.enqueue``,
 ``serving.batch_assemble``, ``serving.execute``, ``serving.scatter``,
 ``serving.warmup`` — the Perfetto view of queue→batch→MXU.
@@ -216,9 +241,12 @@ def slo_rollup(now=None):
 
 def publish_rollups(now=None):
     """One sampler tick's worth of derived series: the decaying
-    ``serving.qps`` gauge plus the ``slo.*`` rollup."""
+    ``serving.qps`` gauge plus the ``slo.*`` rollup (decode window
+    included when decode traffic exists)."""
     qps_now(now)
-    return slo_rollup(now)
+    out = slo_rollup(now)
+    out["decode"] = decode_rollup(now)
+    return out
 
 
 def reset_windows():
@@ -228,6 +256,10 @@ def reset_windows():
     with _slo_lock:
         _slo_submits.clear()
         _slo_done.clear()
+    with _decode_lock:
+        _tokens_window.clear()
+        _decode_steps.clear()
+        _prefill_steps.clear()
 
 
 def record_compiles(n=1):
@@ -344,3 +376,128 @@ def record_supervisor(decision, **fields):
         _monitor.counter("serving.supervisor_decisions").inc()
         _monitor.emit(kind="serving", event="supervisor",
                       decision=decision, **fields)
+
+
+# -- generative decode series -----------------------------------------------
+
+#: rolling window for the slo.tokens_per_s / slo.decode_p99_ms gauges —
+#: shorter than SLO_WINDOW_S because token throughput is the supervisor's
+#: fast control signal (a 60s window would lag a traffic step by a minute)
+TOKENS_WINDOW_S = 15.0
+
+_decode_lock = threading.Lock()
+_tokens_window = collections.deque()   # (t_monotonic, n_tokens)
+_decode_steps = collections.deque()    # (t, step_ms)
+_prefill_steps = collections.deque()   # (t, prefill_ms)
+
+
+def record_decode_tick(active_slots, total_slots, n_tokens, step_ms):
+    """One fused decode step: ``n_tokens`` emitted across
+    ``active_slots`` live sequences in ``step_ms``."""
+    occupancy = (float(active_slots) / float(total_slots)
+                 if total_slots else 0.0)
+    now = time.monotonic()
+    with _decode_lock:
+        _tokens_window.append((now, int(n_tokens)))
+        _decode_steps.append((now, float(step_ms)))
+        _sweep(_tokens_window, now, TOKENS_WINDOW_S)
+        _sweep(_decode_steps, now, TOKENS_WINDOW_S)
+    if not _monitor.enabled():
+        return
+    _monitor.counter("serving.decode.ticks").inc()
+    _monitor.counter("serving.decode.tokens").inc(int(n_tokens))
+    _monitor.gauge("serving.decode.slot_occupancy").set(round(occupancy, 4))
+    _monitor.histogram("serving.decode.occupancy_hist").observe(occupancy)
+    _monitor.histogram("serving.decode.step_ms").observe(float(step_ms))
+
+
+def record_prefill(n_tokens, prefill_ms, bucket):
+    """One prefill executable run: a ``bucket``-length prompt ingest."""
+    now = time.monotonic()
+    with _decode_lock:
+        _prefill_steps.append((now, float(prefill_ms)))
+        _sweep(_prefill_steps, now, TOKENS_WINDOW_S)
+    if not _monitor.enabled():
+        return
+    _monitor.counter("serving.decode.prefills").inc()
+    _monitor.counter("serving.decode.prefill_tokens").inc(int(n_tokens))
+    _monitor.histogram("serving.decode.prefill_ms").observe(float(prefill_ms))
+    _monitor.emit(kind="serving", event="prefill", tokens=int(n_tokens),
+                  bucket=int(bucket), ms=round(float(prefill_ms), 3))
+
+
+def record_decode_compile(n=1, what=""):
+    """An executable minted by the decode path. Counted both in the
+    decode-local series (the zero-growth-after-warmup smoke gate) and
+    the engine-wide ``serving.compiles``."""
+    if _monitor.enabled():
+        _monitor.counter("serving.decode.compiles").inc(int(n))
+        _monitor.counter("serving.compiles").inc(int(n))
+        if what:
+            _monitor.emit(kind="serving", event="decode_compile", what=what)
+
+
+def record_cache(cache_bytes, capacity, headroom_bytes=None,
+                 limit_bytes=None):
+    if not _monitor.enabled():
+        return
+    _monitor.gauge("serving.decode.cache_bytes").set(int(cache_bytes))
+    _monitor.gauge("serving.decode.cache_capacity").set(int(capacity))
+    if headroom_bytes is not None:
+        _monitor.gauge("serving.decode.cache_headroom").set(
+            int(headroom_bytes))
+    if limit_bytes is not None:
+        _monitor.gauge("serving.decode.cache_limit").set(int(limit_bytes))
+
+
+def record_cache_grow(new_capacity):
+    if _monitor.enabled():
+        _monitor.counter("serving.decode.cache_grows").inc()
+        _monitor.emit(kind="serving", event="cache_grow",
+                      capacity=int(new_capacity))
+
+
+def tokens_window(now=None):
+    """Cheap control-loop read: (tokens_per_s | None, decode_p99_ms |
+    None) over the last :data:`TOKENS_WINDOW_S` seconds. None means no
+    decode traffic in the window — the supervisor must not treat an
+    idle engine as a throughput breach. Unlike the slo.* window this
+    fills whether or not the monitor is enabled (the engine always
+    appends; only the gauges need the monitor)."""
+    now = time.monotonic() if now is None else now
+    with _decode_lock:
+        _sweep(_tokens_window, now, TOKENS_WINDOW_S)
+        _sweep(_decode_steps, now, TOKENS_WINDOW_S)
+        if not _tokens_window:
+            return None, None
+        total = sum(k for _, k in _tokens_window)
+        elapsed = max(now - _tokens_window[0][0], 0.25)
+        steps = sorted(ms for _, ms in _decode_steps)
+    return total / elapsed, _percentile(steps, 0.99)
+
+
+def decode_rollup(now=None):
+    """Publish the decode SLO window: ``slo.tokens_per_s``,
+    ``slo.decode_p99_ms``, and the rolling prefill/decode time ratio.
+    Returns the dict (gauges only when the monitor is enabled)."""
+    now = time.monotonic() if now is None else now
+    tps, p99 = tokens_window(now)
+    with _decode_lock:
+        _sweep(_prefill_steps, now, TOKENS_WINDOW_S)
+        pf = sorted(ms for _, ms in _prefill_steps)
+        prefill_ms = sum(pf)
+        decode_ms = sum(ms for _, ms in _decode_steps)
+    busy = prefill_ms + decode_ms
+    ratio = (prefill_ms / busy) if busy > 0 else None
+    out = {"tokens_per_s": tps, "decode_p99_ms": p99,
+           "prefill_p50_ms": _percentile(pf, 0.50),
+           "prefill_ratio": ratio}
+    if _monitor.enabled():
+        if tps is not None:
+            _monitor.gauge("slo.tokens_per_s").set(round(tps, 3))
+        if p99 is not None:
+            _monitor.gauge("slo.decode_p99_ms").set(round(p99, 3))
+        if ratio is not None:
+            _monitor.gauge("serving.decode.prefill_ratio").set(
+                round(ratio, 4))
+    return out

@@ -182,14 +182,14 @@ class MultiDeviceEngine:
                  inflight_timeout_ms=None, supervise=True,
                  supervisor_interval_s=0.25, min_replicas=1,
                  initial_active=None, restart_after_s=None,
-                 **engine_kwargs):
+                 tokens_floor=None, **engine_kwargs):
         self.predictor = predictor
         self._engine_kwargs = dict(engine_kwargs)
         self._breaker_kwargs = dict(
             failure_threshold=breaker_threshold,
             cooldown_s=breaker_cooldown_s,
             half_open_probes=half_open_probes)
-        preds = replicate(predictor, devices)
+        preds = self._replicate(predictor, devices)
         self._replicas = []
         for i, p in enumerate(preds):
             self._replicas.append(self._make_replica(i, p))
@@ -230,10 +230,24 @@ class MultiDeviceEngine:
             from .supervisor import ServingSupervisor
             self.supervisor = ServingSupervisor(
                 self, interval_s=supervisor_interval_s,
-                restart_after_s=restart_after_s)
+                restart_after_s=restart_after_s,
+                tokens_floor=tokens_floor)
         _ACTIVE.add(self)
         metrics.record_active_replicas(
             sum(1 for r in self._replicas if r.active))
+
+    # -- replica construction hooks (overridden by the decode fleet) -------
+
+    def _replicate(self, predictor, devices):
+        """State mechanic: one predictor view per device. The decode
+        fleet (``generate.MultiDecodeEngine``) overrides this with
+        ``replicate_decode`` — same fan-out spine, different payload."""
+        return replicate(predictor, devices)
+
+    def _new_engine(self, predictor, index, on_outcome):
+        """Per-replica engine factory — the other decode-fleet seam."""
+        return ServingEngine(predictor, replica_id=index,
+                             on_outcome=on_outcome, **self._engine_kwargs)
 
     def _make_replica(self, index, predictor):
         breaker = CircuitBreaker(name=str(index), **self._breaker_kwargs)
@@ -244,8 +258,7 @@ class MultiDeviceEngine:
             else:
                 _b.record_failure(repr(exc))
 
-        engine = ServingEngine(predictor, replica_id=index,
-                               on_outcome=_outcome, **self._engine_kwargs)
+        engine = self._new_engine(predictor, index, _outcome)
         return _Replica(index, getattr(predictor, "device", None),
                         predictor, engine, breaker)
 
@@ -319,7 +332,8 @@ class MultiDeviceEngine:
             return
         from .batcher import Request
         shadow = Request(req.inputs, req.n, req.signature,
-                         deadline=req.deadline, priority=req.priority)
+                         deadline=req.deadline, priority=req.priority,
+                         seq_real=req.seq_real, seq_padded=req.seq_padded)
         metrics.record_hedge(replica=rep.index)
 
         def _on_shadow_done(sf, _req=req, _idx=rep.index):
@@ -381,7 +395,7 @@ class MultiDeviceEngine:
         the old one in the background with a bounded join — its drain
         thread may be wedged forever."""
         old_engine = replica.engine
-        fresh_pred = replicate(self.predictor, [replica.device])[0]
+        fresh_pred = self._replicate(self.predictor, [replica.device])[0]
         fresh = self._make_replica(replica.index, fresh_pred)
         # keep the ORIGINAL breaker (state + flap history): the restarted
         # engine stays open until a probe or budgeted request closes it

@@ -19,9 +19,10 @@ and resumes. Serving needs the same loop with different verbs, running
   a fresh engine warmed and swapped in, the wedged one reaped in the
   background.
 * **scaling** — when the live ``slo.goodput`` window sags below the
-  floor and inactive replicas exist, one is activated per tick; a fleet
-  idle for ``idle_ticks_down`` consecutive ticks gives one back (never
-  below ``min_replicas``).
+  floor — or, for decode fleets, when the rolling ``slo.tokens_per_s``
+  window drops under ``tokens_floor`` — and inactive replicas exist,
+  one is activated per tick; a fleet idle for ``idle_ticks_down``
+  consecutive ticks gives one back (never below ``min_replicas``).
 
 Every verdict is recorded planner-style — a ``serving.supervisor``
 ledger event plus :func:`last_decision` — so ``/snapshot`` can answer
@@ -51,11 +52,16 @@ class ServingSupervisor:
 
     def __init__(self, owner, interval_s=0.25, probe_timeout_s=1.0,
                  goodput_floor=0.90, restart_after_s=None,
-                 idle_ticks_down=120, scale=True, start=True):
+                 idle_ticks_down=120, scale=True, start=True,
+                 tokens_floor=None):
         self._owner = weakref.ref(owner)
         self.interval_s = float(interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
         self.goodput_floor = float(goodput_floor)
+        # decode SLO floor: scale up while the rolling slo.tokens_per_s
+        # window sits below this (None = goodput-only scaling)
+        self.tokens_floor = (float(tokens_floor)
+                             if tokens_floor is not None else None)
         # default: a hung replica gets 3 supervision timeouts of grace
         # after failover before the heavyweight rebuild
         self.restart_after_s = (float(restart_after_s)
@@ -120,12 +126,13 @@ class ServingSupervisor:
             return
         now = time.monotonic() if now is None else now
         rollup = metrics.slo_rollup(now)
+        decode = metrics.decode_rollup(now)
         owner._refresh_hedge_delay(rollup.get("p99_ms"))
         busy = False
         for replica in list(owner._replicas):
             busy |= self._supervise_replica(owner, replica, now)
         if self.scale:
-            self._autoscale(owner, rollup, busy)
+            self._autoscale(owner, rollup, busy, decode)
 
     def _supervise_replica(self, owner, replica, now):
         hb = replica.engine.heartbeat(now)
@@ -164,7 +171,7 @@ class ServingSupervisor:
                 replica.breaker.record_failure("probe")
         return busy
 
-    def _autoscale(self, owner, rollup, busy):
+    def _autoscale(self, owner, rollup, busy, decode=None):
         goodput = rollup.get("goodput")
         submitted = rollup.get("submitted") or 0
         if goodput is not None and submitted >= 20 \
@@ -174,6 +181,20 @@ class ServingSupervisor:
             if rep is not None:
                 self._decide("scale_up", replica=rep.index,
                              goodput=round(goodput, 4),
+                             active=owner._active_count())
+            return
+        # decode SLO: rolling token throughput below the floor means the
+        # fleet is slot-starved — add a replica. An idle engine reads as
+        # None (no decode traffic in the window), never as a breach.
+        tps = decode.get("tokens_per_s") if decode else None
+        if self.tokens_floor is not None and tps is not None \
+                and tps < self.tokens_floor:
+            self._idle_ticks = 0
+            rep = owner._activate_one()
+            if rep is not None:
+                self._decide("scale_up", replica=rep.index,
+                             tokens_per_s=round(tps, 3),
+                             tokens_floor=self.tokens_floor,
                              active=owner._active_count())
             return
         if busy or submitted:

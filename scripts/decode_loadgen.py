@@ -1,0 +1,172 @@
+"""Closed-loop generative-decode load generator (CPU-safe, seconds).
+
+Offers a Poisson arrival stream of ragged generation requests — prompt
+lengths drawn across the prefill buckets, output lengths skewed the way
+real decode traffic is (mostly short answers, a long tail of long ones)
+— against a warmed :class:`~paddle_tpu.serving.generate.GenerateEngine`
+and measures sustained token throughput from first submit to last
+completion.
+
+The A/B that matters is ``--mode both``: the SAME engine class, model,
+slot count, and executables run twice, once with ``refill="continuous"``
+(finished sequences free their slot immediately; queued requests join
+the running batch at the next tick) and once with ``refill="drain"``
+(the classic run-to-completion static batcher: the batch only refills
+once EVERY sequence in it has finished, so the whole batch waits on its
+longest member). The tokens/s ratio between the two is the continuous-
+batching win — the tail-length skew is exactly what makes drain bleed
+slot-time.
+
+Prints one JSON result line::
+
+    {"continuous": {...}, "drain": {...}, "speedup_x": 2.7, ...}
+
+Usage::
+
+    python scripts/decode_loadgen.py --requests 64 --slots 8
+    python scripts/decode_loadgen.py --mode continuous --rate 200
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+# short answers dominate; the long tail is what run-to-completion
+# batching stalls a whole batch on
+SHORT_NEW = (4, 8)       # 85% of requests
+LONG_NEW = (64, 80)      # 15% of requests
+LONG_FRAC = 0.15
+
+
+def make_workload(n, prompt_buckets, max_len, seed=0):
+    """(prompt tokens, max_new_tokens, inter-arrival gap s) per request.
+    Prompt lengths are ragged across the bucket family; output lengths
+    are bimodal-skewed; gaps are exponential (Poisson process)."""
+    rng = np.random.RandomState(seed)
+    reqs = []
+    for _ in range(n):
+        if rng.rand() < LONG_FRAC:
+            new = int(rng.randint(LONG_NEW[0], LONG_NEW[1] + 1))
+        else:
+            new = int(rng.randint(SHORT_NEW[0], SHORT_NEW[1] + 1))
+        hi = min(int(prompt_buckets[-1]), max_len - new)
+        plen = int(rng.randint(1, hi + 1))
+        prompt = rng.randint(1, 31, size=plen).tolist()
+        reqs.append((prompt, new))
+    return reqs
+
+
+def run_load(model, mode, workload, slots, max_len, prompt_buckets,
+             rate=None, seed=0):
+    """Drive one engine in ``mode`` over the workload; return the
+    measurement dict. ``rate`` is the Poisson arrival rate in req/s
+    (None = offered all at once — pure capacity measurement)."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import metrics
+
+    metrics.reset_windows()
+    eng = serving.GenerateEngine(
+        model, slots=slots, page=32, factor=2.0, max_len=max_len,
+        prompt_buckets=prompt_buckets, queue_depth=len(workload) + 8,
+        refill=mode, shed=False, start=True)
+    eng.warmup()
+    n_exec, n_trace = eng.executables()
+
+    rng = np.random.RandomState(seed + 1)
+    futs = []
+    t0 = time.perf_counter()
+    for prompt, new in workload:
+        if rate:
+            time.sleep(float(rng.exponential(1.0 / rate)))
+        futs.append(eng.submit(prompt, max_new_tokens=new,
+                               eos_token=None))
+    outs = [f.result(timeout=120) for f in futs]
+    wall_s = time.perf_counter() - t0
+
+    rollup = metrics.decode_rollup()
+    stats = eng.stats()
+    n_exec2, n_trace2 = eng.executables()
+    eng.close()
+
+    tokens = int(sum(len(o) for o in outs))
+    return {
+        "mode": mode,
+        "requests": len(workload),
+        "tokens": tokens,
+        "wall_s": round(wall_s, 3),
+        "tokens_per_s": round(tokens / wall_s, 1),
+        "batch_occupancy": round(stats["avg_occupancy"], 4),
+        "ticks": stats["ticks"],
+        "prefill_p50_ms": (round(rollup["prefill_p50_ms"], 3)
+                           if rollup["prefill_p50_ms"] is not None
+                           else None),
+        "decode_p99_ms": (round(rollup["decode_p99_ms"], 3)
+                          if rollup["decode_p99_ms"] is not None
+                          else None),
+        "prefill_ratio": (round(rollup["prefill_ratio"], 4)
+                          if rollup["prefill_ratio"] is not None
+                          else None),
+        "executables": n_exec2,
+        "post_warmup_compiles": (n_exec2 - n_exec) + (n_trace2 - n_trace),
+        "pool_bytes": stats["pool_cache_bytes"],
+        "grows": stats["grows"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=96)
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="Poisson arrival rate req/s (0 = all at once)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--mode", choices=["both", "continuous", "drain"],
+                    default="both")
+    ap.add_argument("--out-dir", default=None,
+                    help="enable the monitor JSONL sink here")
+    args = ap.parse_args()
+
+    from paddle_tpu import monitor, serving
+
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+        monitor.enable(os.path.join(args.out_dir, "decode_loadgen.jsonl"))
+
+    # dim 256 keeps the fused decode step expensive enough that the
+    # slot-efficiency ratio (not host overhead) dominates the A/B
+    model = serving.demo_model(vocab=64, dim=256, heads=4, layers=2,
+                               max_len=args.max_len, seed=1)
+    prompt_buckets = (4, 16)
+    workload = make_workload(args.requests, prompt_buckets,
+                             args.max_len, seed=args.seed)
+
+    result = {"requests": args.requests, "slots": args.slots,
+              "rate": args.rate or None}
+    modes = ["continuous", "drain"] if args.mode == "both" else [args.mode]
+    for mode in modes:
+        result[mode] = run_load(model, mode, workload, args.slots,
+                                args.max_len, prompt_buckets,
+                                rate=args.rate or None, seed=args.seed)
+    if "continuous" in result and "drain" in result:
+        result["speedup_x"] = round(
+            result["continuous"]["tokens_per_s"]
+            / max(result["drain"]["tokens_per_s"], 1e-9), 2)
+
+    if args.out_dir:
+        monitor.emit(kind="decode_loadgen",
+                     **{k: v for k, v in result.items()})
+        monitor.disable()
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,233 @@
+"""Generative-decode smoke gate (tier-1-safe: CPU, tiny models, ~1 min).
+
+Four phases, each mapping to an ISSUE acceptance criterion for the
+continuous-batching decode engine:
+
+* **churn** — ragged prompts and output lengths, EOS early-exits, and a
+  capacity grow, through one warmed :class:`GenerateEngine`: every
+  future resolves (zero lost under slot join/leave) and the executable
+  cache + trace count stay EXACTLY flat after warmup — slot churn and
+  cache growth never recompile.
+* **budget** — under a virtual HBM limit
+  (``PADDLE_TPU_HBM_LIMIT_BYTES``), the KV pool's live device bytes
+  must equal its own closed-form prediction
+  (``bytes_per_token x slots x capacity``), sit inside the limit with
+  the headroom the pool reports, and ``fits_budget`` must reject a
+  limit smaller than the arena.
+* **throughput** — the scripts/decode_loadgen.py A/B: continuous
+  refill must sustain >= 2x the tokens/s of the ``refill="drain"``
+  run-to-completion baseline at the same slot count, with zero
+  post-warmup compiles in BOTH modes.
+* **scale_up** — a 2-replica :class:`MultiDecodeEngine` (1 active)
+  under a ``tokens_floor`` the live decode window cannot meet: one
+  supervisor tick must activate the second replica and log a
+  ``scale_up`` decision carrying the observed ``tokens_per_s``.
+
+Prints one JSON result line; exit 0 iff every gate passes.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def phase_churn(serving):
+    """Ragged churn through one engine: zero lost futures, zero
+    post-warmup compiles, exact pool byte accounting."""
+    model = serving.demo_model(vocab=32, dim=16, heads=2, layers=2,
+                               max_len=64, seed=1)
+    eng = serving.GenerateEngine(model, slots=4, page=16, factor=2.0,
+                                 max_len=64, prompt_buckets=(4, 8, 16),
+                                 queue_depth=128, shed=False, start=True)
+    eng.warmup()
+    n_exec, n_trace = eng.executables()
+
+    rng = np.random.RandomState(0)
+    futs = []
+    for i in range(40):
+        plen = int(rng.randint(1, 17))
+        new = int(rng.randint(1, 40))
+        # seed-1 DemoLM emits 12/26 often: eos on half the requests
+        # makes sequences finish early at unpredictable ticks (churn)
+        eos = 12 if i % 2 else None
+        futs.append(eng.submit(rng.randint(1, 31, size=plen).tolist(),
+                               max_new_tokens=new, eos_token=eos))
+    outs = [f.result(timeout=60) for f in futs]
+    n_exec2, n_trace2 = eng.executables()
+    stats = eng.stats()
+    pool_exact = eng.pool.allocated_bytes() == eng.pool.bytes()
+    eng.close()
+
+    lost = sum(1 for o in outs if o is None or len(o) == 0)
+    return {
+        "requests": len(futs),
+        "completed": stats["completed"],
+        "lost": lost,
+        "executables_warmup": n_exec,
+        "executables_final": n_exec2,
+        "traces_warmup": n_trace,
+        "traces_final": n_trace2,
+        "grows": stats["grows"],
+        "pool_bytes_exact": bool(pool_exact),
+        "ok": (lost == 0 and stats["completed"] == len(futs)
+               and n_exec2 == n_exec and n_trace2 == n_trace
+               and pool_exact),
+    }
+
+
+def phase_budget(serving, kv_cache):
+    """KV-pool byte accounting vs a virtual HBM budget."""
+    model = serving.demo_model(vocab=32, dim=16, heads=2, layers=2,
+                               max_len=64, seed=1)
+    spec = model.kv_spec()
+    limit = 8 * 1024 * 1024                      # 8 MiB virtual budget
+    os.environ["PADDLE_TPU_HBM_LIMIT_BYTES"] = str(limit)
+    try:
+        pool = kv_cache.KVCachePool(spec, slots=4, page=16, factor=2.0,
+                                    max_len=64)
+        predicted = (kv_cache.bytes_per_token(spec) * pool.slots
+                     * pool.capacity)
+        allocated = pool.allocated_bytes()
+        headroom, lim = pool.headroom()
+        max_predicted = (kv_cache.bytes_per_token(spec) * pool.slots
+                         * pool.seq_buckets[-1])
+        fits, needed, _ = kv_cache.fits_budget(spec, 4, 64,
+                                               limit_bytes=limit)
+        too_small, _, _ = kv_cache.fits_budget(
+            spec, 4, 64, limit_bytes=max_predicted - 1)
+        planned = kv_cache.plan_slots(spec, 64, limit_bytes=limit,
+                                      reserve_frac=0.5)
+    finally:
+        del os.environ["PADDLE_TPU_HBM_LIMIT_BYTES"]
+    return {
+        "limit_bytes": limit,
+        "predicted_bytes": int(predicted),
+        "allocated_bytes": int(allocated),
+        "max_bytes": int(pool.max_bytes()),
+        "headroom_bytes": int(headroom) if headroom is not None else None,
+        "planned_slots": planned,
+        "ok": (allocated == predicted == pool.bytes()
+               and lim == limit
+               # headroom is vs the grown-to-max arena, not the current
+               # capacity: growth never shrinks, so budget for the worst
+               and headroom == limit - pool.max_bytes()
+               and headroom >= 0
+               and pool.max_bytes() == max_predicted == needed
+               and fits and not too_small
+               and planned >= 4),
+    }
+
+
+def phase_throughput(serving, requests, slots):
+    """The loadgen A/B: continuous vs drain on the same executables."""
+    from decode_loadgen import make_workload, run_load
+    model = serving.demo_model(vocab=64, dim=256, heads=4, layers=2,
+                               max_len=96, seed=1)
+    buckets = (4, 16)
+    wl = make_workload(requests, buckets, 96, seed=0)
+    cont = run_load(model, "continuous", wl, slots, 96, buckets)
+    drain = run_load(model, "drain", wl, slots, 96, buckets)
+    speedup = cont["tokens_per_s"] / max(drain["tokens_per_s"], 1e-9)
+    return {
+        "continuous_tokens_per_s": cont["tokens_per_s"],
+        "drain_tokens_per_s": drain["tokens_per_s"],
+        "speedup_x": round(speedup, 2),
+        "continuous_occupancy": cont["batch_occupancy"],
+        "drain_occupancy": drain["batch_occupancy"],
+        "prefill_p50_ms": cont["prefill_p50_ms"],
+        "decode_p99_ms": cont["decode_p99_ms"],
+        "post_warmup_compiles": (cont["post_warmup_compiles"]
+                                 + drain["post_warmup_compiles"]),
+        "ok": (speedup >= 2.0
+               and cont["post_warmup_compiles"] == 0
+               and drain["post_warmup_compiles"] == 0),
+    }
+
+
+def phase_scale_up(serving, metrics):
+    """Decode-SLO autoscale: live tokens/s below tokens_floor must
+    activate the second replica within one supervisor tick."""
+    import jax
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+    if len(jax.devices()) < 2:
+        return {"ok": False, "error": "needs >=2 devices (XLA_FLAGS)"}
+
+    metrics.reset_windows()
+    model = serving.demo_model(vocab=32, dim=16, heads=2, layers=2,
+                               max_len=64, seed=1)
+    fleet = serving.MultiDecodeEngine(
+        model, hedge_ms=0, supervise=False, initial_active=1,
+        slots=4, page=16, factor=2.0, max_len=64,
+        prompt_buckets=(4, 8, 16), shed=False)
+    # goodput_floor=0 disables the fixed-shape goodput branch so the
+    # decision below is attributable to the decode window alone
+    sup = ServingSupervisor(fleet, start=False, goodput_floor=0.0,
+                            tokens_floor=10_000_000.0)
+    try:
+        fleet.warmup()
+        active_before = fleet._active_count()
+        futs = [fleet.submit([1, 2, 3], max_new_tokens=8)
+                for _ in range(6)]
+        for f in futs:
+            f.result(timeout=30)
+        sup.tick(fleet)
+        decision = sup.last_decision()
+        active_after = fleet._active_count()
+    finally:
+        sup.stop()
+        fleet.close()
+    return {
+        "active_before": active_before,
+        "active_after": active_after,
+        "decision": ({k: v for k, v in decision.items() if k != "t"}
+                     if decision else None),
+        "ok": (active_before == 1 and active_after == 2
+               and decision is not None
+               and decision["decision"] == "scale_up"
+               and "tokens_per_s" in decision),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="/tmp/paddle_tpu_decode_smoke")
+    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--slots", type=int, default=8)
+    args = ap.parse_args()
+
+    from paddle_tpu import monitor, serving
+    from paddle_tpu.serving import kv_cache, metrics
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    jsonl = monitor.enable(os.path.join(args.out_dir,
+                                        "decode_smoke.jsonl"))
+
+    t0 = time.perf_counter()
+    result = {
+        "churn": phase_churn(serving),
+        "budget": phase_budget(serving, kv_cache),
+        "throughput": phase_throughput(serving, args.requests,
+                                       args.slots),
+        "scale_up": phase_scale_up(serving, metrics),
+    }
+    result["wall_s"] = round(time.perf_counter() - t0, 1)
+    result["jsonl"] = jsonl
+    result["ok"] = all(result[k]["ok"] for k in
+                       ("churn", "budget", "throughput", "scale_up"))
+    monitor.emit(kind="decode_smoke",
+                 **{k: v for k, v in result.items() if k != "jsonl"})
+    monitor.disable()
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

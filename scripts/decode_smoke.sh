@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI gate for continuous-batching generative decode: slot churn with
+# zero lost futures and zero post-warmup compiles, KV-pool bytes equal
+# to the closed-form budget prediction under a virtual HBM limit,
+# continuous refill >= 2x the run-to-completion drain baseline's
+# tokens/s at the same slot count, and a tokens_floor supervisor
+# scale-up driven by the live decode SLO window. Tier-1-safe: tiny
+# models, CPU (2 virtual devices for the scale-up phase), ~1 min.
+#
+# Usage: scripts/decode_smoke.sh [out_dir]
+# The monitor JSONL (with the decode_smoke record) lands in out_dir
+# (default /tmp/paddle_tpu_decode_smoke); the last stdout line is one
+# JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_decode_smoke}"
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python scripts/decode_smoke.py --out-dir "$OUT_DIR"

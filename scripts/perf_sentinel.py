@@ -167,6 +167,24 @@ METRICS = [
     ("memory_plan_step_s_remat",
      ("memory_plan_step_s_remat",), ("memory_plan_step_s_remat",),
      "lower", 1.00),
+    # generative-decode stage (bench_decode / decode_smoke): tokens/s
+    # and step latencies are shared-box wall-clock (very wide bands);
+    # the continuous-vs-drain speedup and the decode-batch occupancy
+    # are scheduling ratios — tight bands, a drop means the refill
+    # discipline or slot accounting regressed, not the weather
+    ("decode_tokens_per_s",
+     ("decode_tokens_per_s",), ("decode_tokens_per_s",),
+     "higher", 1.00),
+    ("decode_speedup_x",
+     ("decode_speedup_x",), ("decode_speedup_x",), "higher", 0.20),
+    ("decode_batch_occupancy",
+     ("decode_batch_occupancy",), ("decode_batch_occupancy",),
+     "higher", 0.10),
+    ("decode_prefill_p50_ms",
+     ("decode_prefill_p50_ms",), ("decode_prefill_p50_ms",),
+     "lower", 1.00),
+    ("decode_p99_ms",
+     ("decode_p99_ms",), ("decode_p99_ms",), "lower", 1.00),
 ]
 
 

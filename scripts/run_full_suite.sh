@@ -119,6 +119,18 @@ bash scripts/mem_smoke.sh "$MONITOR_DIR/mem_smoke"
 mem=$?
 [ $mem -ne 0 ] && rc=$((rc == 0 ? mem : rc))
 
+# decode gate: continuous-batching generative decode — slot churn with
+# zero lost futures and zero post-warmup compiles, KV-pool bytes equal
+# to the closed-form budget prediction under a virtual HBM limit,
+# continuous refill >= 2x the drain run-to-completion baseline's
+# tokens/s, and a tokens_floor supervisor scale-up off the live decode
+# SLO window
+echo ""
+echo "-- decode smoke gate --"
+bash scripts/decode_smoke.sh "$MONITOR_DIR/decode_smoke"
+dcd=$?
+[ $dcd -ne 0 ] && rc=$((rc == 0 ? dcd : rc))
+
 # memory-plan gate: under a virtual HBM budget, a model 4x past the
 # no-remat ceiling trains under the auto-picked policy (predicted peak
 # under the limit pre-flight), offload spans ride their own track with
