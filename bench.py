@@ -607,6 +607,10 @@ def bench_decode(timeout_s=600):
         "decode_batch_occupancy": tp["continuous_occupancy"],
         "decode_prefill_p50_ms": tp["prefill_p50_ms"],
         "decode_p99_ms": tp["decode_p99_ms"],
+        "decode_ttft_p50_ms": tp.get("ttft_p50_ms"),
+        "decode_ttft_p99_ms": tp.get("ttft_p99_ms"),
+        "decode_tpot_p50_ms": tp.get("tpot_p50_ms"),
+        "decode_tpot_p99_ms": tp.get("tpot_p99_ms"),
         "decode_gates_pass": bool(r["ok"]),
     }
 

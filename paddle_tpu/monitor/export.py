@@ -207,6 +207,20 @@ def snapshot_payload():
             serving_block = {"fleets": blocks, "last_decision": decision}
     except Exception:
         serving_block = None
+    # slow-request exemplars: the N worst completed waterfalls by ttft
+    # and tpot, so "which request blew the SLO and where did its time
+    # go?" is answerable from one scrape (lazy: only if the serving
+    # spine ever ran)
+    slow_requests = None
+    try:
+        import sys
+        _rq = sys.modules.get("paddle_tpu.serving.reqtrace")
+        if _rq is not None:
+            ex = _rq.exemplars()
+            if ex["worst_ttft"] or ex["worst_tpot"]:
+                slow_requests = ex
+    except Exception:
+        slow_requests = None
     return {
         "ts": time.time(),
         "pid": os.getpid(),
@@ -218,6 +232,7 @@ def snapshot_payload():
         "memory": memory_block,
         "planner": planner_block,
         "serving": serving_block,
+        "slow_requests": slow_requests,
         "counters": _mon.snapshot(),
     }
 

@@ -58,7 +58,8 @@ import time
 __all__ = [
     "enable", "disable", "enabled", "clear", "span", "complete",
     "instant", "counter", "traced", "events", "export_chrome_trace",
-    "flight_record", "last_flight",
+    "flight_record", "last_flight", "flow_start", "flow_step",
+    "flow_end", "lane_complete", "lane_instant", "lanes",
 ]
 
 DEFAULT_BUFFER = 65536
@@ -74,6 +75,14 @@ _wall0 = 0.0                # wall clock at enable (for correlation)
 _flight_lock = threading.Lock()
 _flight_dumps = 0
 _last_flight = None         # newest flight-recorder dir (/snapshot shows it)
+
+# synthetic tracks ("lanes") that belong to a resource rather than a
+# thread — KV slots, pools. Their tids sit in a range no pthread ident
+# (a pointer-sized value) occupies, so each lane renders as its own
+# named row in Perfetto.
+_LANE_BASE = 1 << 20
+_lanes = {}                 # lane name -> synthetic tid
+_lane_lock = threading.Lock()
 
 
 def last_flight():
@@ -120,6 +129,8 @@ def clear():
     global _flight_dumps, _last_flight
     _events.clear()
     _thread_names.clear()
+    with _lane_lock:
+        _lanes.clear()
     _flight_dumps = 0
     _last_flight = None
 
@@ -238,6 +249,71 @@ def counter(name, values=None, ts=None, **kw):
                     vals))
 
 
+def _flow(kind, name, fid, args):
+    if not _active:
+        return
+    tid = threading.get_ident()
+    if tid not in _thread_names:
+        _note_thread(tid)
+    _events.append((kind, name, tid, _CLOCK(), int(fid), args or None))
+
+
+def flow_start(name, fid, **args):
+    """Open a flow (Perfetto arrow chain) with numeric id ``fid``. Flow
+    events anchor to the innermost OPEN span on the calling thread, so
+    emit them inside a ``span()`` — that is the slice the arrow leaves
+    from."""
+    _flow("FS", name, fid, args)
+
+
+def flow_step(name, fid, **args):
+    """Continue flow ``fid`` on the current thread (arrow lands on the
+    enclosing slice, then leaves it again)."""
+    _flow("FT", name, fid, args)
+
+
+def flow_end(name, fid, **args):
+    """Terminate flow ``fid`` at the enclosing slice."""
+    _flow("FF", name, fid, args)
+
+
+def _lane_tid(lane):
+    with _lane_lock:
+        tid = _lanes.get(lane)
+        if tid is None:
+            tid = _LANE_BASE + len(_lanes)
+            _lanes[lane] = tid
+            _thread_names[tid] = lane
+        return tid
+
+
+def lanes():
+    """Registered lane names -> synthetic track ids."""
+    with _lane_lock:
+        return dict(_lanes)
+
+
+def lane_complete(lane, name, t0, t1=None, **args):
+    """Record a pre-timed interval on a named resource lane (a KV slot's
+    occupied-by-request interval, a prefill admission) rather than on
+    the calling thread's track. ``t0``/``t1`` are perf_counter stamps —
+    the same clock ``span()`` uses, so lanes and thread tracks line up
+    in one timeline."""
+    if not _active:
+        return
+    t1 = _CLOCK() if t1 is None else t1
+    _events.append(("X", name, _lane_tid(lane), t0, t1 - t0,
+                    args or None))
+
+
+def lane_instant(lane, name, ts=None, **args):
+    """A zero-duration marker on a resource lane (pool growth pads)."""
+    if not _active:
+        return
+    _events.append(("I", name, _lane_tid(lane),
+                    _CLOCK() if ts is None else ts, args or None))
+
+
 def traced(name=None):
     """Decorator form: ``@trace.traced`` or ``@trace.traced("label")``.
     Disabled mode adds one flag check per call."""
@@ -308,6 +384,15 @@ def export_chrome_trace(path=None, last=None):
             _, name, tid, t, args = ev
             rec = {"ph": "C", "pid": pid, "tid": tid, "name": name,
                    "ts": _us(t), "cat": "counter"}
+        elif kind in ("FS", "FT", "FF"):
+            _, name, tid, t, fid, args = ev
+            rec = {"ph": {"FS": "s", "FT": "t", "FF": "f"}[kind],
+                   "pid": pid, "tid": tid, "name": name,
+                   "ts": _us(t), "id": fid, "cat": "flow"}
+            if kind == "FF":
+                # bind to the enclosing slice even if no event starts
+                # exactly at the arrow head
+                rec["bp"] = "e"
         else:
             _, name, tid, t, args = ev
             rec = {"ph": "i", "pid": pid, "tid": tid, "name": name,
@@ -434,6 +519,22 @@ def flight_record(reason, step=None, directory=None, extra=None):
                 with open(os.path.join(d, "memory_report.json"), "w",
                           encoding="utf-8") as fh:
                     json.dump(mrep, fh, default=str, indent=1)
+        except Exception:
+            pass
+
+        # the slow-request exemplar ring next to the op/memory ledgers:
+        # the N worst ttft/tpot waterfalls with full stage breakdowns —
+        # "why was serving slow" evidence for a serving-side postmortem.
+        # Lazy via sys.modules so telemetry never imports serving.
+        try:
+            import sys as _sys
+            _rq = _sys.modules.get("paddle_tpu.serving.reqtrace")
+            if _rq is not None:
+                ex = _rq.exemplars()
+                if ex.get("worst_ttft") or ex.get("worst_tpot"):
+                    with open(os.path.join(d, "slow_requests.json"), "w",
+                              encoding="utf-8") as fh:
+                        json.dump(ex, fh, default=str, indent=1)
         except Exception:
             pass
 

@@ -41,6 +41,11 @@ traffic into them.
   fused step per tick, prefill/decode split, zero steady-state
   compiles) and :class:`MultiDecodeEngine`, its breaker-aware fleet
   fan-out
+* :mod:`~paddle_tpu.serving.reqtrace`  — request-scoped tracing: one
+  ``serving.request`` record per logical request with the blame-
+  assigned stage waterfall (queue/assemble/execute/prefill/decode/
+  hedge/…), ``ttft_ms``/``tpot_ms``, hop lineage across hedges and
+  failovers, and the slow-request exemplar rings
 
 See docs/robustness.md ("Self-healing serving") for the failure model.
 
@@ -68,6 +73,7 @@ from . import engine  # noqa: F401
 from . import multi  # noqa: F401
 from . import supervisor  # noqa: F401
 from . import kv_cache  # noqa: F401
+from . import reqtrace  # noqa: F401
 from . import generate  # noqa: F401
 from .admission import (AdmissionController, QueueFullError,  # noqa: F401
                         DeadlineExpired, ShedError, PRIORITIES)
@@ -79,11 +85,12 @@ from .generate import (GenerateEngine, MultiDecodeEngine,  # noqa: F401
 from .kv_cache import KVCachePool  # noqa: F401
 from .multi import (MultiDeviceEngine, NoHealthyReplicaError,  # noqa: F401
                     replicate)
+from .reqtrace import RequestTrace  # noqa: F401
 from .supervisor import ServingSupervisor  # noqa: F401
 
 __all__ = [
     "batcher", "admission", "metrics", "engine", "multi", "breaker",
-    "supervisor", "kv_cache", "generate",
+    "supervisor", "kv_cache", "generate", "reqtrace", "RequestTrace",
     "ServingEngine", "MultiDeviceEngine", "replicate", "DynamicBatcher",
     "Request", "AdmissionController", "QueueFullError", "DeadlineExpired",
     "ShedError", "PRIORITIES", "CircuitBreaker", "NoHealthyReplicaError",

@@ -183,9 +183,15 @@ class AdmissionController:
         ladder (priority shed → reject-with-retry-after) before the
         hard capacity check; otherwise stamps the default deadline on
         an undeadlined request."""
+        tr = getattr(request, "trace", None)
         if depth >= self.max_queue_depth:
             metrics.record_reject()
             self._note("rejected")
+            if tr is not None:
+                # the request trace outlives this synchronous reject: a
+                # caller that retries hands the same context back via
+                # submit(trace=), keeping one record per logical request
+                tr.shed(level=3, retry_after_ms=self._retry_after(3))
             raise QueueFullError(
                 f"serving queue full ({depth}/{self.max_queue_depth} "
                 f"requests waiting)",
@@ -198,6 +204,8 @@ class AdmissionController:
                 ra = self._retry_after(level)
                 metrics.record_shed(prio, level, ra)
                 self._note("shed")
+                if tr is not None:
+                    tr.shed(level=level, retry_after_ms=ra)
                 raise ShedError(
                     f"request shed at ladder level {level} "
                     f"(priority={prio}, queue {depth}/"

@@ -40,10 +40,11 @@ class Request:
     deadline. Created by ``ServingEngine.submit``."""
 
     __slots__ = ("inputs", "n", "signature", "future", "deadline",
-                 "t_enqueue", "priority", "seq_real", "seq_padded")
+                 "t_enqueue", "priority", "seq_real", "seq_padded",
+                 "trace")
 
     def __init__(self, inputs, n, signature, deadline=None, priority=1,
-                 seq_real=None, seq_padded=None):
+                 seq_real=None, seq_padded=None, trace=None):
         self.inputs = inputs              # tuple of host arrays
         self.n = int(n)                   # rows along the batch axis
         self.signature = signature        # per-example (shape, dtype) tuple
@@ -57,6 +58,9 @@ class Request:
         # signature; scatter slices axis 1 back to seq_real
         self.seq_real = seq_real
         self.seq_padded = seq_padded
+        # reqtrace.Attempt riding the request through thread handoffs
+        # (None = monitor disabled; every site checks exactly this)
+        self.trace = trace
 
     def age(self, now=None):
         return (now if now is not None else time.monotonic()) \
@@ -64,17 +68,29 @@ class Request:
 
     # concurrent.futures raises InvalidStateError on a cancelled future;
     # a caller cancelling mid-flight must not crash the drain thread.
+    # The winner of the set_* race — and ONLY the winner — finalizes the
+    # request trace: a hedge shadow, a failed-over duplicate, and the
+    # primary share one context, so exactly one terminal
+    # ``serving.request`` record exists per logical request.
     def resolve_result(self, value):
         try:
             self.future.set_result(value)
         except concurrent.futures.InvalidStateError:
-            pass
+            return
+        if self.trace is not None:
+            self.trace.finalize("ok")
 
     def resolve_exception(self, exc):
         try:
             self.future.set_exception(exc)
         except concurrent.futures.InvalidStateError:
-            pass
+            return
+        if self.trace is not None:
+            from .admission import DeadlineExpired, ShedError
+            outcome = ("expired" if isinstance(exc, DeadlineExpired)
+                       else "shed" if isinstance(exc, ShedError)
+                       else "error")
+            self.trace.finalize(outcome, error=repr(exc))
 
 
 class DynamicBatcher:

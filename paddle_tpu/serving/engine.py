@@ -33,6 +33,7 @@ from ..tensor import Tensor
 from .admission import AdmissionController, resolve_priority
 from .batcher import DynamicBatcher, Request
 from . import metrics
+from . import reqtrace
 
 # host-side feed canonicalization, matching Executor's (and jax's
 # x64-disabled) convention so a float64 submit and the float32 warmup
@@ -158,12 +159,16 @@ class ServingEngine:
 
     # -- client surface ---------------------------------------------------
 
-    def make_request(self, inputs, deadline_ms=None, priority=None):
+    def make_request(self, inputs, deadline_ms=None, priority=None,
+                     trace=None):
         """Validate + canonicalize one submit's inputs into a
         ``Request`` (not yet enqueued — ``MultiDeviceEngine`` builds
         the request once, then picks which replica's
         :meth:`submit_request` gets it). Raises ``ValueError`` on
-        malformed inputs."""
+        malformed inputs. ``trace=`` carries an existing
+        ``reqtrace.RequestTrace`` across a shed-then-retry resubmit so
+        the retry stays the SAME logical request (one terminal record,
+        backoff blamed as ``shed_retry_ms``)."""
         if not inputs:
             raise ValueError("submit() needs at least one input array")
         arrays = tuple(_as_host_array(x) for x in inputs)
@@ -203,9 +208,13 @@ class ServingEngine:
             if len(pads) == 1:
                 (seq_real, seq_padded), = pads
         sig = tuple((a.shape[1:], str(a.dtype)) for a in arrays)
+        prio = resolve_priority(priority)
         return Request(arrays, n, sig, deadline=deadline,
-                       priority=resolve_priority(priority),
-                       seq_real=seq_real, seq_padded=seq_padded)
+                       priority=prio,
+                       seq_real=seq_real, seq_padded=seq_padded,
+                       trace=reqtrace.attach(trace, kind="serve",
+                                             priority=prio,
+                                             replica=self.replica_id))
 
     def submit_request(self, req):
         """Enqueue an already-built ``Request``; returns its future.
@@ -214,11 +223,15 @@ class ServingEngine:
             self._probe_template = tuple(a[:1].copy() for a in req.inputs)
         with _monitor.trace.span("serving.enqueue", rows=req.n):
             fut = self._batcher.submit(req)
+            if req.trace is not None:
+                req.trace.hop("enqueue", replica=self.replica_id)
+                reqtrace.flow_mark(req.trace)
         with self._stats_lock:
             self._stats["submitted"] += 1
         return fut
 
-    def submit(self, *inputs, deadline_ms=None, priority=None):
+    def submit(self, *inputs, deadline_ms=None, priority=None,
+               trace=None):
         """Enqueue one request (each input shaped ``(n, ...)``, all with
         the same leading ``n <= max_batch``); returns a
         ``concurrent.futures.Future`` resolving to what
@@ -226,9 +239,12 @@ class ServingEngine:
         'high'/'normal'/'low' (default 'normal') — under overload the
         admission ladder sheds low classes first. Raises ``ShedError``
         / ``QueueFullError`` under overload, ``ValueError`` on
-        malformed inputs."""
+        malformed inputs. A caller retrying after a shed passes the
+        shed request's ``trace`` back so the retry is attributed to the
+        same logical request."""
         return self.submit_request(self.make_request(
-            inputs, deadline_ms=deadline_ms, priority=priority))
+            inputs, deadline_ms=deadline_ms, priority=priority,
+            trace=trace))
 
     def run(self, *inputs, deadline_ms=None, timeout=None, priority=None):
         """Blocking submit: enqueue, wait, return the outputs (or raise
@@ -341,6 +357,13 @@ class ServingEngine:
 
     def requeue(self, requests):
         """Failover: accept already-admitted requests at queue front."""
+        for r in requests:
+            tr = getattr(r, "trace", None)
+            if tr is not None:
+                # back to queue wait on the adopting replica; the
+                # failover hop itself is recorded by the fleet owner
+                tr.to("queue")
+                tr.hop("requeue", replica=self.replica_id)
         self._batcher.requeue(requests)
 
     def _note_outcome(self, ok, exc=None):
@@ -379,6 +402,8 @@ class ServingEngine:
             self._stats["batches"] += 1
         with _monitor.trace.span("serving.batch_assemble",
                                  requests=len(requests)):
+            # queue time ends here: the drain thread owns the group now
+            reqtrace.transition(requests, "assemble", flow=True)
             arrays, real_n, bucket = self._assemble(requests)
         metrics.record_batch(real_n, bucket, len(requests))
         with self._stats_lock:
@@ -435,6 +460,7 @@ class ServingEngine:
         attempt = 0
         while True:
             try:
+                reqtrace.transition(requests, "execute")
                 out = self._run_batch(arrays)
                 self._note_outcome(True)
                 return out
@@ -447,6 +473,7 @@ class ServingEngine:
                         self._stats["retries"] += 1
                     with _monitor.trace.span("serving.retry_backoff",
                                              attempt=attempt + 1):
+                        reqtrace.transition(requests, "retry_backoff")
                         time.sleep(policy.delay(attempt))
                     attempt += 1
                     continue
@@ -460,6 +487,7 @@ class ServingEngine:
         padded, so no fresh shapes are minted) and resolve its future.
         Raises to the caller (admission.isolate) if this request is the
         poison."""
+        reqtrace.transition([request], "execute")
         arrays, _real, _bucket = self._assemble([request])
         outs, multi = self._run_batch(arrays)
         self._scatter([request], (outs, multi))
@@ -468,6 +496,7 @@ class ServingEngine:
         """Slice each request's rows back out, device→host once for the
         whole batch, resolve futures, record latency."""
         outs, multi = outs_multi
+        reqtrace.transition(requests, "scatter", flow=True)
         import jax
         host = [np.asarray(jax.device_get(o)) for o in outs]
         bucket = None

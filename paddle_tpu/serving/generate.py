@@ -84,6 +84,7 @@ from .admission import AdmissionController, resolve_priority
 from .kv_cache import KVCachePool
 from .multi import MultiDeviceEngine
 from . import metrics
+from . import reqtrace
 
 
 class DecodeRequest:
@@ -93,10 +94,10 @@ class DecodeRequest:
     failover's first-resolution-wins contract holds."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_token", "n",
-                 "future", "deadline", "t_enqueue", "priority")
+                 "future", "deadline", "t_enqueue", "priority", "trace")
 
     def __init__(self, prompt, max_new_tokens, eos_token=None,
-                 deadline=None, priority=1):
+                 deadline=None, priority=1, trace=None):
         self.prompt = prompt                    # 1-D int32 host array
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token = eos_token
@@ -105,6 +106,10 @@ class DecodeRequest:
         self.deadline = deadline
         self.priority = int(priority)
         self.t_enqueue = time.monotonic()
+        # reqtrace.Attempt (None = monitor disabled); the winner of the
+        # set_* race below — and only the winner — finalizes it, so a
+        # hedge shadow and its primary emit one record between them
+        self.trace = trace
 
     def age(self, now=None):
         return (now if now is not None else time.monotonic()) \
@@ -114,25 +119,35 @@ class DecodeRequest:
         try:
             self.future.set_result(value)
         except concurrent.futures.InvalidStateError:
-            pass
+            return
+        if self.trace is not None:
+            self.trace.finalize("ok")
 
     def resolve_exception(self, exc):
         try:
             self.future.set_exception(exc)
         except concurrent.futures.InvalidStateError:
-            pass
+            return
+        if self.trace is not None:
+            from .admission import DeadlineExpired, ShedError
+            outcome = ("expired" if isinstance(exc, DeadlineExpired)
+                       else "shed" if isinstance(exc, ShedError)
+                       else "error")
+            self.trace.finalize(outcome, error=repr(exc))
 
 
 class _Slot:
     """Host-side state of one decode-batch lane."""
 
-    __slots__ = ("req", "length", "tokens", "last_token")
+    __slots__ = ("req", "length", "tokens", "last_token", "t_seat")
 
     def __init__(self):
         self.req = None          # DecodeRequest occupying the lane
         self.length = 0          # tokens resident in the KV arena
         self.tokens = None       # generated so far (list of int)
         self.last_token = 0      # next decode input
+        self.t_seat = 0.0        # perf_counter stamp at seating (the
+        #                          slot lane's occupancy-interval start)
 
 
 class GenerateEngine:
@@ -193,6 +208,10 @@ class GenerateEngine:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._slots = [_Slot() for _ in range(self.slots)]
+        # Chrome-export resource-lane prefix: one lane per KV slot
+        # ("kv.slot3", or "kv1.slot3" inside a fleet)
+        self._lane = ("kv" if replica_id is None
+                      else f"kv{replica_id}")
         # (kind, *buckets) -> jitted executable; single-writer (the tick
         # thread / warmup), so no lock — reads are atomic dict gets
         self._exec = {}
@@ -231,9 +250,11 @@ class GenerateEngine:
     # -- client surface ----------------------------------------------------
 
     def make_request(self, prompt, max_new_tokens=32, eos_token=None,
-                     deadline_ms=None, priority=None):
+                     deadline_ms=None, priority=None, trace=None):
         """Validate one submit into a :class:`DecodeRequest` (not yet
-        enqueued — the fleet wrapper builds once, then routes)."""
+        enqueued — the fleet wrapper builds once, then routes). Pass a
+        shed request's ``RequestTrace`` as ``trace=`` when re-submitting
+        so the retry folds into the same ``serving.request`` record."""
         arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if arr.size < 1:
             raise ValueError("empty prompt")
@@ -251,9 +272,12 @@ class GenerateEngine:
                 f"arena max_len={self.max_len}")
         deadline = (Deadline.after_ms(deadline_ms)
                     if deadline_ms is not None else None)
+        prio = resolve_priority(priority)
         return DecodeRequest(arr, m, eos_token=eos_token,
-                             deadline=deadline,
-                             priority=resolve_priority(priority))
+                             deadline=deadline, priority=prio,
+                             trace=reqtrace.attach(
+                                 trace, kind="decode", priority=prio,
+                                 replica=self.replica_id))
 
     def submit_request(self, req):
         """Admit + enqueue; returns the future. Raises ``ShedError`` /
@@ -267,18 +291,23 @@ class GenerateEngine:
             self._cond.notify()
         metrics.record_submit(1)
         metrics.record_queue_depth(depth)
+        if req.trace is not None:
+            req.trace.hop("enqueue", replica=self.replica_id)
+            if _monitor.trace.enabled():
+                with _monitor.trace.span("serving.enqueue", depth=depth):
+                    reqtrace.flow_mark(req.trace)
         with self._stats_lock:
             self._stats["submitted"] += 1
         return req.future
 
     def submit(self, prompt, max_new_tokens=32, eos_token=None,
-               deadline_ms=None, priority=None):
+               deadline_ms=None, priority=None, trace=None):
         """Enqueue one sequence; the future resolves to the generated
         token ids (``np.int32``; the first token comes from the prefill
         itself, EOS — when given and hit — is included and terminal)."""
         return self.submit_request(self.make_request(
             prompt, max_new_tokens=max_new_tokens, eos_token=eos_token,
-            deadline_ms=deadline_ms, priority=priority))
+            deadline_ms=deadline_ms, priority=priority, trace=trace))
 
     def run(self, prompt, max_new_tokens=32, eos_token=None,
             deadline_ms=None, timeout=None, priority=None):
@@ -553,19 +582,34 @@ class GenerateEngine:
         deterministic, so the adopting replica regenerates the same
         tokens from the prompt (first resolution wins either way)."""
         taken = []
+        evicted = []
         with self._lock:
             for s, slot in enumerate(self._slots):
                 if slot.req is not None:
                     taken.append(slot.req)
+                    evicted.append((s, slot.t_seat))
                     slot.req = None
                     slot.tokens = None
                     self.pool.free(s)
+        trc = _monitor.trace
+        if trc.enabled() and evicted:
+            now_pc = time.perf_counter()
+            for s, t_seat in evicted:
+                trc.lane_complete(f"{self._lane}.slot{s}", "req evicted",
+                                  t_seat, now_pc)
         return taken
 
     def requeue(self, requests):
         """Failover re-dispatch: front-of-queue, no re-admission."""
         if not requests:
             return
+        for r in requests:
+            tr = getattr(r, "trace", None)
+            if tr is not None:
+                # the attempt re-enters queue wait on this replica; the
+                # failover hop itself is recorded by the fleet owner
+                tr.to("queue")
+                tr.hop("requeue", replica=self.replica_id)
         with self._cond:
             if self._closed:
                 for r in requests:
@@ -695,6 +739,11 @@ class GenerateEngine:
             self.pool.grow_to(new, lambda bufs, _o, _n: fn(bufs))
             with self._stats_lock:
                 self._stats["grows"] += 1
+            # growth pad marker on the arena's shared lane — lines up
+            # with the per-slot occupancy intervals in the Chrome export
+            _monitor.trace.lane_instant(f"{self._lane}.pool",
+                                        f"grow {old}->{new}",
+                                        old_cap=old, new_cap=new)
 
     def _prefill_into_slot(self, req):
         """Prompt ingest: run the bucketed prefill executable, write the
@@ -703,12 +752,16 @@ class GenerateEngine:
         import jax.numpy as jnp
         p = int(req.prompt.size)
         bucket = next_bucket(p, self.prompt_buckets)
+        tr = req.trace
+        if tr is not None:
+            tr.to("prefill")
         # the arena must hold the prompt pages, the first decode write
         # (position p), and the full insert bucket
         self._ensure_capacity(max(p + 1, bucket))
         s = self.pool.alloc()
         if s is None:
             raise RuntimeError("no free slot after free_slots() > 0")
+        pc_seat = time.perf_counter()
         try:
             if _faults.enabled():
                 _faults.maybe_serving_fault(self.replica_id)
@@ -731,10 +784,25 @@ class GenerateEngine:
             self.pool.free(s)
             raise
         self._note_outcome(True)
+        # the TTFT moment: the prefill's last-token logits ARE the first
+        # generated token (a failover re-prefill re-stamps it — honest)
+        if tr is not None:
+            tr.first_token()
+        trc = _monitor.trace
+        if trc.enabled():
+            rid = tr.ctx.rid if tr is not None else None
+            trc.lane_complete(f"{self._lane}.slot{s}", "prefill",
+                              pc_seat, pc_seat + ms / 1e3,
+                              rid=rid, tokens=p, bucket=bucket)
         done = (req.eos_token is not None and first == req.eos_token) \
             or req.max_new_tokens == 1
         if done:
             self.pool.free(s)
+            if trc.enabled():
+                trc.lane_complete(
+                    f"{self._lane}.slot{s}",
+                    f"req {rid}" if rid else "req", pc_seat,
+                    rid=rid, tokens=1)
             self._complete(req, [first])
             return
         slot = self._slots[s]
@@ -743,6 +811,7 @@ class GenerateEngine:
             slot.length = p
             slot.tokens = [first]
             slot.last_token = first
+            slot.t_seat = pc_seat
 
     # -- the fused decode step ---------------------------------------------
 
@@ -794,7 +863,7 @@ class GenerateEngine:
                 slot.last_token = tok
                 if (req.eos_token is not None and tok == req.eos_token) \
                         or len(slot.tokens) >= req.max_new_tokens:
-                    finished.append((req, slot.tokens))
+                    finished.append((s, req, slot.tokens, slot.t_seat))
                     slot.req = None
                     slot.tokens = None
                     self.pool.free(s)
@@ -804,7 +873,19 @@ class GenerateEngine:
             self._stats["tokens"] += n_active
             self._occupancy_sum += occupancy
         metrics.record_decode_tick(n_active, self.slots, n_active, step_ms)
-        for req, toks in finished:
+        trc = _monitor.trace
+        if trc.enabled() and finished:
+            # slot occupancy intervals close when the slot frees — one
+            # per finished sequence, on that slot's resource lane
+            now_pc = time.perf_counter()
+            for s, req, toks, t_seat in finished:
+                rid = (req.trace.ctx.rid if req.trace is not None
+                       else None)
+                trc.lane_complete(f"{self._lane}.slot{s}",
+                                  f"req {rid}" if rid else "req",
+                                  t_seat, now_pc,
+                                  rid=rid, tokens=len(toks))
+        for _s, req, toks, _t in finished:
             self._complete(req, toks)
         return True
 
@@ -815,23 +896,36 @@ class GenerateEngine:
                 slot = self._slots[s]
                 if slot.req is not req:
                     continue
-                failed.append(req)
+                failed.append((s, req, slot.t_seat))
                 slot.req = None
                 slot.tokens = None
                 self.pool.free(s)
         with self._stats_lock:
             self._stats["failed"] += len(failed)
-        for r in failed:
+        trc = _monitor.trace
+        if trc.enabled() and failed:
+            now_pc = time.perf_counter()
+            for s, _r, t_seat in failed:
+                trc.lane_complete(f"{self._lane}.slot{s}", "req failed",
+                                  t_seat, now_pc)
+        for _s, r, _t in failed:
             r.resolve_exception(exc)
 
     def _complete(self, req, tokens):
         now = time.monotonic()
         latency_ms = req.age(now) * 1e3
         within = req.deadline is None or not req.deadline.expired(now)
-        req.resolve_result(np.asarray(tokens, np.int32))
+        if req.trace is not None:
+            # token count must land before resolve_result finalizes the
+            # record — tpot derives from it
+            req.trace.note_tokens(len(tokens))
+        # account BEFORE resolving: the waiter wakes the instant
+        # set_result lands, and a stats() read right after result()
+        # must already see this completion
         metrics.record_completed(1, [latency_ms], within_sla=[within])
         with self._stats_lock:
             self._stats["completed"] += 1
+        req.resolve_result(np.asarray(tokens, np.int32))
 
 
 # ---------------------------------------------------------------------------
@@ -883,13 +977,13 @@ class MultiDecodeEngine(MultiDeviceEngine):
                               **self._engine_kwargs)
 
     def submit(self, prompt, max_new_tokens=32, eos_token=None,
-               deadline_ms=None, priority=None):
+               deadline_ms=None, priority=None, trace=None):
         rep = self._pick_replica()
         req = rep.engine.make_request(prompt,
                                       max_new_tokens=max_new_tokens,
                                       eos_token=eos_token,
                                       deadline_ms=deadline_ms,
-                                      priority=priority)
+                                      priority=priority, trace=trace)
         fut = rep.engine.submit_request(req)
         with self._hedge_lock:
             self._submitted += 1
@@ -921,10 +1015,19 @@ class MultiDecodeEngine(MultiDeviceEngine):
             with self._hedge_lock:
                 self._hedged -= 1
             return
+        ptr = req.trace
         shadow = DecodeRequest(req.prompt, req.max_new_tokens,
                                eos_token=req.eos_token,
                                deadline=req.deadline,
-                               priority=req.priority)
+                               priority=req.priority,
+                               # the shadow rides the SAME context as a
+                               # hedge attempt: whichever resolution wins
+                               # the shared done-latch emits the record
+                               trace=(None if ptr is None else
+                                      ptr.ctx.attempt("hedge",
+                                                      rep.index)))
+        if ptr is not None:
+            ptr.hop("hedge", replica=rep.index)
         metrics.record_hedge(replica=rep.index)
 
         def _on_shadow_done(sf, _req=req, _idx=rep.index):

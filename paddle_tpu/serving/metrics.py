@@ -47,8 +47,19 @@ SLO rollups (published by the telemetry sampler via
 
 * ``slo.goodput``  — completions within deadline ÷ submissions
 * ``slo.p50_ms`` / ``slo.p99_ms`` — service-latency percentiles
+* ``slo.ttft_p50_ms`` / ``slo.ttft_p99_ms`` — time-to-first-token
+  percentiles (fed per-request by the reqtrace terminal records; for
+  fixed-shape requests ttft == service latency)
+* ``slo.tpot_p50_ms`` / ``slo.tpot_p99_ms`` — time-per-output-token
+  percentiles (multi-token decode requests only)
 * ``slo.window_submitted`` / ``slo.window_within_sla`` — the raw
   window tallies behind the ratio
+
+Request-scoped records (``serving.reqtrace``): each completed request
+emits exactly one ``serving.request`` JSONL record with a stage-blamed
+latency breakdown; ``serving.ttft_ms`` / ``serving.tpot_ms`` histograms
+(and every serving latency histogram) use :data:`LATENCY_BUCKETS_MS` —
+log-spaced decode-scale bounds from 1 µs to 10 s.
 
 ``serving.qps`` decays to 0 when traffic stops: the sampler calls
 :func:`qps_now` each tick, which sweeps stale window entries instead
@@ -97,12 +108,21 @@ QPS_WINDOW_S = 10.0
 #: rolling window for the slo.* goodput / latency-percentile gauges
 SLO_WINDOW_S = 60.0
 
+#: decode-scale latency bounds for every serving histogram: log-spaced
+#: (x~2.15 per step) from 1 µs to 10 s, so a p99 on single-token decode
+#: ticks (sub-ms) and a p99 on long-prompt prefills (hundreds of ms)
+#: both resolve instead of collapsing into one default bucket
+LATENCY_BUCKETS_MS = tuple(round(10.0 ** (e / 3.0), 6)
+                           for e in range(-9, 13))
+
 _qps_lock = threading.Lock()
 _qps_window = collections.deque()   # (t_monotonic, n_completed)
 
 _slo_lock = threading.Lock()
 _slo_submits = collections.deque()  # t_monotonic per submitted request
 _slo_done = collections.deque()     # (t, latency_ms|None, within_sla)
+_slo_ttft = collections.deque()     # (t, ttft_ms) per completed request
+_slo_tpot = collections.deque()     # (t, tpot_ms) per multi-token req
 
 
 def record_submit(n_rows):
@@ -156,7 +176,8 @@ def record_completed(n_requests, latencies_ms, within_sla=None):
     play, every completion counts as within)."""
     if not _monitor.enabled():
         return
-    h = _monitor.histogram("serving.latency_ms")
+    h = _monitor.histogram("serving.latency_ms",
+                           buckets=LATENCY_BUCKETS_MS)
     for ms in latencies_ms:
         h.observe(float(ms))
     now = time.monotonic()
@@ -168,6 +189,32 @@ def record_completed(n_requests, latencies_ms, within_sla=None):
             ok = True if within_sla is None else bool(within_sla[i])
             _slo_done.append((now, float(ms), ok))
         _sweep(_slo_done, now, SLO_WINDOW_S)
+
+
+def record_request_slo(ttft_ms=None, tpot_ms=None):
+    """One completed request's generative SLO sample, fed by the
+    reqtrace terminal record: time-to-first-token and (multi-token
+    requests only) time-per-output-token, rolled into the live windows
+    behind ``slo.ttft_*`` / ``slo.tpot_*`` and histogrammed on the
+    decode-scale bounds."""
+    if not _monitor.enabled():
+        return
+    now = time.monotonic()
+    with _slo_lock:
+        if ttft_ms is not None:
+            _slo_ttft.append((now, float(ttft_ms)))
+            _sweep(_slo_ttft, now, SLO_WINDOW_S)
+        if tpot_ms is not None:
+            _slo_tpot.append((now, float(tpot_ms)))
+            _sweep(_slo_tpot, now, SLO_WINDOW_S)
+    if ttft_ms is not None:
+        _monitor.histogram("serving.ttft_ms",
+                           buckets=LATENCY_BUCKETS_MS).observe(
+            float(ttft_ms))
+    if tpot_ms is not None:
+        _monitor.histogram("serving.tpot_ms",
+                           buckets=LATENCY_BUCKETS_MS).observe(
+            float(tpot_ms))
 
 
 def _sweep(dq, now, horizon, key=lambda item: item[0]):
@@ -221,17 +268,26 @@ def slo_rollup(now=None):
     with _slo_lock:
         _sweep(_slo_submits, now, SLO_WINDOW_S, key=lambda t: t)
         _sweep(_slo_done, now, SLO_WINDOW_S)
+        _sweep(_slo_ttft, now, SLO_WINDOW_S)
+        _sweep(_slo_tpot, now, SLO_WINDOW_S)
         submitted = len(_slo_submits)
         done = list(_slo_done)
+        ttfts = sorted(v for _, v in _slo_ttft)
+        tpots = sorted(v for _, v in _slo_tpot)
     ok = sum(1 for _, _, w in done if w)
     lats = sorted(ms for _, ms, _ in done if ms is not None)
     out = {"window_s": SLO_WINDOW_S, "submitted": submitted,
            "completed": len(lats), "within_sla": ok,
            "goodput": (ok / submitted) if submitted else None,
            "p50_ms": _percentile(lats, 0.50),
-           "p99_ms": _percentile(lats, 0.99)}
+           "p99_ms": _percentile(lats, 0.99),
+           "ttft_p50_ms": _percentile(ttfts, 0.50),
+           "ttft_p99_ms": _percentile(ttfts, 0.99),
+           "tpot_p50_ms": _percentile(tpots, 0.50),
+           "tpot_p99_ms": _percentile(tpots, 0.99)}
     if _monitor.enabled():
-        for key in ("goodput", "p50_ms", "p99_ms"):
+        for key in ("goodput", "p50_ms", "p99_ms", "ttft_p50_ms",
+                    "ttft_p99_ms", "tpot_p50_ms", "tpot_p99_ms"):
             if out[key] is not None:
                 _monitor.gauge(f"slo.{key}").set(out[key])
         _monitor.gauge("slo.window_submitted").set(submitted)
@@ -256,6 +312,8 @@ def reset_windows():
     with _slo_lock:
         _slo_submits.clear()
         _slo_done.clear()
+        _slo_ttft.clear()
+        _slo_tpot.clear()
     with _decode_lock:
         _tokens_window.clear()
         _decode_steps.clear()
@@ -408,7 +466,8 @@ def record_decode_tick(active_slots, total_slots, n_tokens, step_ms):
     _monitor.counter("serving.decode.tokens").inc(int(n_tokens))
     _monitor.gauge("serving.decode.slot_occupancy").set(round(occupancy, 4))
     _monitor.histogram("serving.decode.occupancy_hist").observe(occupancy)
-    _monitor.histogram("serving.decode.step_ms").observe(float(step_ms))
+    _monitor.histogram("serving.decode.step_ms",
+                       buckets=LATENCY_BUCKETS_MS).observe(float(step_ms))
 
 
 def record_prefill(n_tokens, prefill_ms, bucket):
@@ -421,7 +480,9 @@ def record_prefill(n_tokens, prefill_ms, bucket):
         return
     _monitor.counter("serving.decode.prefills").inc()
     _monitor.counter("serving.decode.prefill_tokens").inc(int(n_tokens))
-    _monitor.histogram("serving.decode.prefill_ms").observe(float(prefill_ms))
+    _monitor.histogram("serving.decode.prefill_ms",
+                       buckets=LATENCY_BUCKETS_MS).observe(
+        float(prefill_ms))
     _monitor.emit(kind="serving", event="prefill", tokens=int(n_tokens),
                   bucket=int(bucket), ms=round(float(prefill_ms), 3))
 

@@ -296,10 +296,11 @@ class MultiDeviceEngine:
             retry_after_ms=self._breaker_kwargs["cooldown_s"] * 1e3,
             level=3)
 
-    def submit(self, *inputs, deadline_ms=None, priority=None):
+    def submit(self, *inputs, deadline_ms=None, priority=None,
+               trace=None):
         rep = self._pick_replica()
         req = rep.engine.make_request(inputs, deadline_ms=deadline_ms,
-                                      priority=priority)
+                                      priority=priority, trace=trace)
         fut = rep.engine.submit_request(req)
         with self._hedge_lock:
             self._submitted += 1
@@ -331,9 +332,17 @@ class MultiDeviceEngine:
                 self._hedged -= 1   # unfired: give the budget back
             return
         from .batcher import Request
+        ptr = req.trace
         shadow = Request(req.inputs, req.n, req.signature,
                          deadline=req.deadline, priority=req.priority,
-                         seq_real=req.seq_real, seq_padded=req.seq_padded)
+                         seq_real=req.seq_real, seq_padded=req.seq_padded,
+                         # the shadow rides the SAME trace context as a
+                         # hedge attempt: whichever resolution wins the
+                         # shared done-latch emits the one record
+                         trace=(None if ptr is None else
+                                ptr.ctx.attempt("hedge", rep.index)))
+        if ptr is not None:
+            ptr.hop("hedge", replica=rep.index)
         metrics.record_hedge(replica=rep.index)
 
         def _on_shadow_done(sf, _req=req, _idx=rep.index):
@@ -380,6 +389,10 @@ class MultiDeviceEngine:
         with self._hedge_lock:
             self._failovers += 1
         metrics.record_failover(replica.index, len(moved))
+        for r in moved:
+            tr = getattr(r, "trace", None)
+            if tr is not None:
+                tr.hop("failover", replica=replica.index, reason=reason)
         try:
             target = self._pick_replica(exclude=(replica.index,))
         except NoHealthyReplicaError as e:

@@ -174,6 +174,12 @@ class ServingSupervisor:
     def _autoscale(self, owner, rollup, busy, decode=None):
         goodput = rollup.get("goodput")
         submitted = rollup.get("submitted") or 0
+        # request-SLO context rides on every scale verdict: "goodput
+        # 0.84 at ttft_p99 310ms" is actionable where the bare ratio
+        # is not (reqtrace feeds these windows)
+        slo_ctx = {k: round(rollup[k], 3)
+                   for k in ("ttft_p99_ms", "tpot_p99_ms")
+                   if rollup.get(k) is not None}
         if goodput is not None and submitted >= 20 \
                 and goodput < self.goodput_floor:
             self._idle_ticks = 0
@@ -181,7 +187,7 @@ class ServingSupervisor:
             if rep is not None:
                 self._decide("scale_up", replica=rep.index,
                              goodput=round(goodput, 4),
-                             active=owner._active_count())
+                             active=owner._active_count(), **slo_ctx)
             return
         # decode SLO: rolling token throughput below the floor means the
         # fleet is slot-starved — add a replica. An idle engine reads as
@@ -195,7 +201,7 @@ class ServingSupervisor:
                 self._decide("scale_up", replica=rep.index,
                              tokens_per_s=round(tps, 3),
                              tokens_floor=self.tokens_floor,
-                             active=owner._active_count())
+                             active=owner._active_count(), **slo_ctx)
             return
         if busy or submitted:
             self._idle_ticks = 0

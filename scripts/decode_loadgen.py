@@ -63,11 +63,21 @@ def make_workload(n, prompt_buckets, max_len, seed=0):
     return reqs
 
 
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
 def run_load(model, mode, workload, slots, max_len, prompt_buckets,
-             rate=None, seed=0):
+             rate=None, seed=0, record_path=None):
     """Drive one engine in ``mode`` over the workload; return the
     measurement dict. ``rate`` is the Poisson arrival rate in req/s
-    (None = offered all at once — pure capacity measurement)."""
+    (None = offered all at once — pure capacity measurement). With the
+    monitor enabled, every request's ``serving.request`` record (ttft,
+    tpot, stage waterfall, hops) is collected; ``record_path`` appends
+    them as one-JSONL-per-request artifact."""
     from paddle_tpu import serving
     from paddle_tpu.serving import metrics
 
@@ -80,14 +90,15 @@ def run_load(model, mode, workload, slots, max_len, prompt_buckets,
     n_exec, n_trace = eng.executables()
 
     rng = np.random.RandomState(seed + 1)
-    futs = []
+    reqs = []
     t0 = time.perf_counter()
     for prompt, new in workload:
         if rate:
             time.sleep(float(rng.exponential(1.0 / rate)))
-        futs.append(eng.submit(prompt, max_new_tokens=new,
-                               eos_token=None))
-    outs = [f.result(timeout=120) for f in futs]
+        r = eng.make_request(prompt, max_new_tokens=new, eos_token=None)
+        eng.submit_request(r)
+        reqs.append(r)
+    outs = [r.future.result(timeout=120) for r in reqs]
     wall_s = time.perf_counter() - t0
 
     rollup = metrics.decode_rollup()
@@ -95,8 +106,34 @@ def run_load(model, mode, workload, slots, max_len, prompt_buckets,
     n_exec2, n_trace2 = eng.executables()
     eng.close()
 
+    # per-request attribution (monitor-enabled runs only: trace is None
+    # otherwise and the loadgen degrades to the throughput headline)
+    records = [r.trace.ctx.record() for r in reqs
+               if r.trace is not None and r.trace.ctx.record() is not None]
+    slo = {}
+    if records:
+        if record_path:
+            with open(record_path, "a") as fh:
+                for rec in records:
+                    fh.write(json.dumps({"mode": mode, **rec}) + "\n")
+        ttfts = sorted(r["ttft_ms"] for r in records
+                       if r.get("ttft_ms") is not None)
+        tpots = sorted(r["tpot_ms"] for r in records
+                       if r.get("tpot_ms") is not None)
+        queues = sorted(r.get("queue_ms", 0.0) for r in records)
+        rnd = lambda v: round(v, 3) if v is not None else None  # noqa: E731
+        slo = {
+            "records": len(records),
+            "ttft_p50_ms": rnd(_pct(ttfts, 0.50)),
+            "ttft_p99_ms": rnd(_pct(ttfts, 0.99)),
+            "tpot_p50_ms": rnd(_pct(tpots, 0.50)),
+            "tpot_p99_ms": rnd(_pct(tpots, 0.99)),
+            "queue_p99_ms": rnd(_pct(queues, 0.99)),
+        }
+
     tokens = int(sum(len(o) for o in outs))
     return {
+        **slo,
         "mode": mode,
         "requests": len(workload),
         "tokens": tokens,
@@ -136,9 +173,16 @@ def main():
 
     from paddle_tpu import monitor, serving
 
+    record_path = None
     if args.out_dir:
         os.makedirs(args.out_dir, exist_ok=True)
         monitor.enable(os.path.join(args.out_dir, "decode_loadgen.jsonl"))
+        record_path = os.path.join(args.out_dir,
+                                   "decode_loadgen_requests.jsonl")
+    else:
+        # in-memory monitor (no sink): per-request traces still mint, so
+        # the TTFT/TPOT table works without an artifact directory
+        monitor.enable()
 
     # dim 256 keeps the fused decode step expensive enough that the
     # slot-efficiency ratio (not host overhead) dominates the A/B
@@ -154,16 +198,29 @@ def main():
     for mode in modes:
         result[mode] = run_load(model, mode, workload, args.slots,
                                 args.max_len, prompt_buckets,
-                                rate=args.rate or None, seed=args.seed)
+                                rate=args.rate or None, seed=args.seed,
+                                record_path=record_path)
     if "continuous" in result and "drain" in result:
         result["speedup_x"] = round(
             result["continuous"]["tokens_per_s"]
             / max(result["drain"]["tokens_per_s"], 1e-9), 2)
 
+    # the SLO table rides next to the tokens/s headline (stderr, so the
+    # stdout contract stays one JSON line)
+    for mode in modes:
+        r = result[mode]
+        if r.get("ttft_p50_ms") is None:
+            continue
+        print(f"[{mode:>10}] {r['tokens_per_s']:>8} tok/s | "
+              f"ttft p50/p99 {r['ttft_p50_ms']}/{r['ttft_p99_ms']} ms | "
+              f"tpot p50/p99 {r['tpot_p50_ms']}/{r['tpot_p99_ms']} ms | "
+              f"queue p99 {r['queue_p99_ms']} ms "
+              f"({r['records']} records)", file=sys.stderr)
+
     if args.out_dir:
         monitor.emit(kind="decode_loadgen",
                      **{k: v for k, v in result.items()})
-        monitor.disable()
+    monitor.disable()
     print(json.dumps(result))
     return 0
 

@@ -144,6 +144,12 @@ def phase_throughput(serving, requests, slots):
         "drain_occupancy": drain["batch_occupancy"],
         "prefill_p50_ms": cont["prefill_p50_ms"],
         "decode_p99_ms": cont["decode_p99_ms"],
+        # per-request SLO attribution from the serving.request records
+        # (reqtrace) minted during the continuous run
+        "ttft_p50_ms": cont.get("ttft_p50_ms"),
+        "ttft_p99_ms": cont.get("ttft_p99_ms"),
+        "tpot_p50_ms": cont.get("tpot_p50_ms"),
+        "tpot_p99_ms": cont.get("tpot_p99_ms"),
         "post_warmup_compiles": (cont["post_warmup_compiles"]
                                  + drain["post_warmup_compiles"]),
         "ok": (speedup >= 2.0

@@ -185,6 +185,15 @@ METRICS = [
      "lower", 1.00),
     ("decode_p99_ms",
      ("decode_p99_ms",), ("decode_p99_ms",), "lower", 1.00),
+    # per-request SLO attribution (reqtrace serving.request records):
+    # TTFT/TPOT are end-to-end wall-clock under shared-box load — wide
+    # bands; they exist to catch order-of-magnitude attribution bugs
+    # (e.g. first-token stamped at submit instead of prefill exit), not
+    # scheduler noise
+    ("decode_ttft_p99_ms",
+     ("decode_ttft_p99_ms",), ("decode_ttft_p99_ms",), "lower", 1.00),
+    ("decode_tpot_p99_ms",
+     ("decode_tpot_p99_ms",), ("decode_tpot_p99_ms",), "lower", 1.00),
 ]
 
 

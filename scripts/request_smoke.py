@@ -1,0 +1,319 @@
+"""Request-tracing smoke gate (tier-1-safe: CPU, tiny models, seconds).
+
+Four phases, each mapping to an ISSUE 16 acceptance criterion for the
+request-scoped tracing / SLO-attribution layer:
+
+* **disabled** — with the monitor off, ``submit()`` mints no trace
+  (``req.trace is None``), no ``serving.request`` record is ever
+  produced, and per-request overhead stays at one flag check.
+* **attribution** — a 2-replica :class:`MultiDecodeEngine` under
+  injected faults (a ``replica_slow`` straggler that triggers hedges, a
+  ``replica_hang`` that triggers supervisor failover) plus a
+  shed-then-retry on a depth-4 queue: **100% of logical requests —
+  hedged, failed-over, and shed-then-retried included — emit exactly
+  one ``serving.request`` record**, every record's stage breakdown sums
+  to the measured e2e latency within ``RECON_TOL`` (5%), and the hop
+  lineage carries the hedge / failover / shed evidence.
+* **gauges** — after decode traffic, ``slo.ttft_p99_ms`` /
+  ``slo.tpot_p99_ms`` are live gauges on the /metrics OpenMetrics
+  payload and the ``serving.ttft_ms`` / ``serving.tpot_ms`` histograms
+  use the decode-scale (sub-ms .. 10s log-spaced) bucket bounds.
+* **timeline** — with the span tracer armed, a 4-slot
+  :class:`GenerateEngine` run exports a Chrome trace whose per-slot KV
+  lanes each carry >= 1 occupied-by-request interval, with matching
+  flow ``s``/``f`` events linking the request's cross-thread spans.
+
+Prints one JSON result line; exit 0 iff every gate passes.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def _model(serving):
+    return serving.demo_model(vocab=32, dim=16, heads=2, layers=2,
+                              max_len=64, seed=1)
+
+
+def phase_disabled(serving, reqtrace):
+    """Monitor off: no trace objects, no records, no lane/flow events."""
+    reqtrace.reset()
+    eng = serving.GenerateEngine(_model(serving), slots=2, page=16,
+                                 factor=2.0, max_len=64,
+                                 prompt_buckets=(4, 8), shed=False,
+                                 start=False)
+    req = eng.make_request([1, 2, 3], max_new_tokens=4)
+    trace_none = req.trace is None
+    eng.submit_request(req)
+    while not req.future.done():
+        eng.tick()
+    tokens = len(req.future.result())
+    eng.close()
+    return {
+        "trace_is_none": bool(trace_none),
+        "tokens": tokens,
+        "records": len(reqtrace.recent()),
+        "ok": trace_none and tokens == 4 and not reqtrace.recent(),
+    }
+
+
+def phase_attribution(serving, reqtrace, requests):
+    """Faulted fleet: exactly one reconciling record per logical
+    request, with hedge / failover / shed-retry lineage evidence."""
+    import jax
+    from paddle_tpu.resilience import faults
+    if len(jax.devices()) < 2:
+        return {"ok": False, "error": "needs >=2 devices (XLA_FLAGS)"}
+
+    reqtrace.reset()
+    model = _model(serving)
+    fleet = serving.MultiDecodeEngine(
+        model, hedge_ms=40.0, hedge_budget=0.5,
+        # inflight_age is the CURRENT TICK's duration, and an honest CPU
+        # tick (up to `slots` prefills + the fused decode step) runs
+        # hundreds of ms — the hung verdict must sit above that but well
+        # below the 3s injected hang
+        inflight_timeout_ms=1200.0,
+        # long cooldown: once the hung replica is tripped it stays out
+        # for the rest of the phase and the fleet drains on the healthy
+        # peer (re-probing a still-hung replica would just re-trip)
+        breaker_cooldown_s=5.0,
+        supervisor_interval_s=0.05,
+        # min_replicas=2: warmup takes long enough that the idle
+        # supervisor would otherwise scale the fleet down to one
+        # replica before traffic arrives
+        min_replicas=2,
+        slots=4, page=16, factor=2.0, max_len=64,
+        prompt_buckets=(4, 8, 16), queue_depth=256, shed=False)
+    fleet.warmup()
+    # a straggler on replica 0 (hedge food — slow enough to outlive the
+    # 40ms hedge delay, nowhere near the hang verdict) and one hung
+    # dispatch on replica 1 (supervisor failover food)
+    slow = faults.inject("replica_slow", replica=0, delay=0.06, times=2)
+    hang = faults.inject("replica_hang", replica=1, delay=3.0, times=1)
+
+    rng = np.random.RandomState(0)
+    futs = []
+    try:
+        for _ in range(requests):
+            plen = int(rng.randint(1, 17))
+            futs.append(fleet.submit(
+                rng.randint(1, 31, size=plen).tolist(),
+                max_new_tokens=int(rng.randint(2, 12))))
+            time.sleep(0.005)
+        lost = 0
+        for f in futs:
+            try:
+                f.result(timeout=30)
+            except Exception:   # noqa: BLE001 - counted as lost goodput
+                lost += 1
+        stats = fleet.stats()
+    finally:
+        fleet.close()
+        faults.clear()
+
+    fleet_recs = reqtrace.recent()
+
+    # shed-then-retry continuity: a depth-4 queue with no drain thread
+    # sheds a low-priority submit at ladder level 1; the caller
+    # resubmits with the SAME trace and the backoff lands in
+    # shed_retry_ms of the one terminal record
+    eng = serving.GenerateEngine(model, slots=2, page=16, factor=2.0,
+                                 max_len=64, prompt_buckets=(4, 8),
+                                 queue_depth=4, shed=True, start=False)
+    held = [eng.submit([1, 2, 3], max_new_tokens=2) for _ in range(2)]
+    shed_req = eng.make_request([1, 2, 3, 4], max_new_tokens=3,
+                                priority="low")
+    shed_raised = False
+    try:
+        eng.submit_request(shed_req)
+    except serving.ShedError:
+        shed_raised = True
+    time.sleep(0.02)                    # the retry backoff being blamed
+    retry = eng.make_request([1, 2, 3, 4], max_new_tokens=3,
+                             priority="high", trace=shed_req.trace)
+    eng.submit_request(retry)
+    deadline = time.monotonic() + 30
+    while (not retry.future.done() or not all(h.done() for h in held)) \
+            and time.monotonic() < deadline:
+        eng.tick()
+    retry_tokens = len(retry.future.result(timeout=5))
+    eng.close()
+    shed_rec = retry.trace.ctx.record() if retry.trace is not None else None
+
+    from paddle_tpu.serving.reqtrace import RECON_TOL
+    all_recs = reqtrace.recent()
+    by_rid = {}
+    for r in all_recs:
+        by_rid[r["rid"]] = by_rid.get(r["rid"], 0) + 1
+    dupes = sum(1 for c in by_rid.values() if c != 1)
+    recon_fail = sum(1 for r in all_recs
+                     if abs(r["recon"] - 1.0) > RECON_TOL)
+    hedge_hops = sum(1 for r in fleet_recs
+                     if any(h["hop"] == "hedge" for h in r["hops"]))
+    failover_hops = sum(1 for r in fleet_recs
+                        if any(h["hop"] == "failover" for h in r["hops"]))
+    return {
+        "requests": requests,
+        "lost": lost,
+        "fleet_records": len(fleet_recs),
+        "duplicate_records": dupes,
+        "recon_failures": recon_fail,
+        "hedged": stats["hedged"],
+        "hedge_hop_records": hedge_hops,
+        "failover_hop_records": failover_hops,
+        "slow_fired": slow.fired,
+        "hang_fired": hang.fired,
+        "shed_raised": bool(shed_raised),
+        "shed_record": ({k: shed_rec[k] for k in
+                         ("outcome", "origin", "attempts", "sheds",
+                          "shed_retry_ms", "recon")}
+                        if shed_rec else None),
+        "ok": (lost == 0
+               and len(fleet_recs) == requests
+               and dupes == 0
+               and recon_fail == 0
+               and stats["hedged"] >= 1 and hedge_hops >= 1
+               and hang.fired >= 1 and failover_hops >= 1
+               and shed_raised
+               and shed_rec is not None
+               and shed_rec["outcome"] == "ok"
+               and shed_rec["origin"] == "retry"
+               and shed_rec["sheds"] >= 1
+               and shed_rec.get("shed_retry_ms", 0) > 0
+               and retry_tokens == 3),
+    }
+
+
+def phase_gauges(serving, reqtrace):
+    """slo.ttft/tpot gauges live on /metrics; decode-scale histogram
+    bucket bounds on the request-latency series."""
+    from paddle_tpu.monitor import export
+    from paddle_tpu.serving import metrics
+
+    metrics.reset_windows()
+    reqtrace.reset()
+    eng = serving.GenerateEngine(_model(serving), slots=2, page=16,
+                                 factor=2.0, max_len=64,
+                                 prompt_buckets=(4, 8), shed=False,
+                                 start=True)
+    futs = [eng.submit([1, 2, 3], max_new_tokens=6) for _ in range(6)]
+    for f in futs:
+        f.result(timeout=30)
+    eng.close()
+    roll = metrics.slo_rollup()
+    text = export.render_openmetrics()
+    b = metrics.LATENCY_BUCKETS_MS
+    buckets_ok = (b[0] <= 0.01 and b[-1] >= 10_000.0
+                  and all(x < y for x, y in zip(b, b[1:])))
+    return {
+        "ttft_p99_ms": roll.get("ttft_p99_ms"),
+        "tpot_p99_ms": roll.get("tpot_p99_ms"),
+        "gauges_on_metrics": ("slo_ttft_p99_ms" in text
+                              and "slo_tpot_p99_ms" in text),
+        "histograms_on_metrics": ("serving_ttft_ms" in text
+                                  and "serving_tpot_ms" in text),
+        "bucket_lo_ms": b[0],
+        "bucket_hi_ms": b[-1],
+        "ok": (roll.get("ttft_p99_ms") is not None
+               and roll.get("tpot_p99_ms") is not None
+               and "slo_ttft_p99_ms" in text
+               and "slo_tpot_p99_ms" in text
+               and "serving_ttft_ms" in text
+               and buckets_ok),
+    }
+
+
+def phase_timeline(serving, reqtrace, out_dir):
+    """Per-slot decode timeline in the Chrome export: every slot lane
+    shows >= 1 occupancy interval; flow s/f events share an id."""
+    from paddle_tpu import monitor
+    monitor.trace.enable()
+    monitor.trace.clear()
+    reqtrace.reset()
+    slots = 4
+    eng = serving.GenerateEngine(_model(serving), slots=slots, page=16,
+                                 factor=2.0, max_len=64,
+                                 prompt_buckets=(4, 8), shed=False,
+                                 start=True)
+    futs = [eng.submit([1 + i, 2, 3], max_new_tokens=8)
+            for i in range(3 * slots)]
+    for f in futs:
+        f.result(timeout=30)
+    eng.close()
+    path = os.path.join(out_dir, "request_timeline.json")
+    monitor.trace.export_chrome_trace(path)
+    lanes = monitor.trace.lanes()
+    monitor.trace.disable()
+    monitor.trace.clear()
+
+    evs = json.load(open(path))["traceEvents"]
+    lane_tids = {tid for name, tid in lanes.items() if ".slot" in name}
+    occupied = {}
+    for e in evs:
+        if e.get("ph") == "X" and e.get("tid") in lane_tids \
+                and str(e.get("name", "")).startswith("req"):
+            occupied[e["tid"]] = occupied.get(e["tid"], 0) + 1
+    starts = {e["id"] for e in evs if e.get("ph") == "s"}
+    ends = {e["id"] for e in evs if e.get("ph") == "f"}
+    lane_names = {e.get("args", {}).get("name") for e in evs
+                  if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    return {
+        "slot_lanes": len(lane_tids),
+        "lanes_with_occupancy": len(occupied),
+        "flow_starts": len(starts),
+        "flow_ends": len(ends),
+        "linked_flows": len(starts & ends),
+        "lane_tracks_named": sum(1 for n in lane_names
+                                 if n and ".slot" in n),
+        "ok": (len(lane_tids) == slots
+               and len(occupied) == slots
+               and min(occupied.values(), default=0) >= 1
+               and len(starts & ends) >= 1
+               and sum(1 for n in lane_names if n and ".slot" in n)
+               == slots),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="/tmp/paddle_tpu_request_smoke")
+    ap.add_argument("--requests", type=int, default=24)
+    args = ap.parse_args()
+
+    from paddle_tpu import monitor, serving
+    from paddle_tpu.serving import reqtrace
+
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    t0 = time.perf_counter()
+    # the disabled phase must run BEFORE the monitor arms
+    result = {"disabled": phase_disabled(serving, reqtrace)}
+    jsonl = monitor.enable(os.path.join(args.out_dir,
+                                        "request_smoke.jsonl"))
+    result["attribution"] = phase_attribution(serving, reqtrace,
+                                              args.requests)
+    result["gauges"] = phase_gauges(serving, reqtrace)
+    result["timeline"] = phase_timeline(serving, reqtrace, args.out_dir)
+    result["wall_s"] = round(time.perf_counter() - t0, 1)
+    result["jsonl"] = jsonl
+    result["ok"] = all(result[k]["ok"] for k in
+                       ("disabled", "attribution", "gauges", "timeline"))
+    monitor.emit(kind="request_smoke",
+                 **{k: v for k, v in result.items() if k != "jsonl"})
+    monitor.disable()
+    print(json.dumps(result))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# CI gate for request-scoped tracing / SLO attribution: with injected
+# straggler + hung-replica faults, 100% of requests (hedged,
+# failed-over, and shed-then-retried included) emit exactly one
+# serving.request record whose stage breakdown reconciles with the
+# measured e2e latency within 5%; slo.ttft_p99_ms / slo.tpot_p99_ms are
+# live on /metrics; the Chrome export shows >= 1 occupancy interval on
+# every KV slot lane with linked flow arrows; disabled mode adds zero
+# records. Tier-1-safe: tiny models, CPU (2 virtual devices), seconds.
+#
+# Usage: scripts/request_smoke.sh [out_dir]
+# The monitor JSONL (with the request_smoke record) lands in out_dir
+# (default /tmp/paddle_tpu_request_smoke); the last stdout line is one
+# JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_request_smoke}"
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python scripts/request_smoke.py --out-dir "$OUT_DIR"

@@ -142,6 +142,18 @@ bash scripts/remat_smoke.sh "$MONITOR_DIR/remat_smoke"
 rmt=$?
 [ $rmt -ne 0 ] && rc=$((rc == 0 ? rmt : rc))
 
+# request-tracing gate: under injected straggler + hung-replica faults,
+# every request (hedged, failed-over, shed-then-retried included) emits
+# exactly one serving.request record whose stage waterfall reconciles
+# with the measured e2e within 5%; slo.ttft/tpot p99 gauges live on
+# /metrics; per-KV-slot occupancy lanes + linked flow arrows in the
+# Chrome export; disabled mode records nothing
+echo ""
+echo "-- request smoke gate --"
+bash scripts/request_smoke.sh "$MONITOR_DIR/request_smoke"
+rqs=$?
+[ $rqs -ne 0 ] && rc=$((rc == 0 ? rqs : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

@@ -1,0 +1,297 @@
+"""Request-scoped tracing (reqtrace): the per-request stage waterfall,
+exactly-once terminal records across hedges/retries, TTFT/TPOT
+semantics, failover hop lineage, the per-slot decode timeline export,
+exemplar rings, and the slo.ttft/tpot rollup. All CPU, all fast."""
+import json
+import time
+
+import pytest
+
+from paddle_tpu import monitor, serving
+from paddle_tpu.serving import metrics as smetrics
+from paddle_tpu.serving import reqtrace
+from paddle_tpu.serving.generate import GenerateEngine
+from paddle_tpu.serving.reqtrace import RECON_TOL
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    monitor.disable(flush_counters=False)
+    monitor.trace.disable()
+    monitor.trace.clear()
+    reqtrace.reset()
+    yield
+    monitor.disable(flush_counters=False)
+    monitor.trace.disable()
+    monitor.trace.clear()
+    reqtrace.reset()
+
+
+@pytest.fixture
+def mon():
+    monitor.enable()        # in-memory: no sink, records still mint
+    smetrics.reset_windows()
+    yield
+    monitor.disable(flush_counters=False)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return serving.demo_model(vocab=32, dim=16, heads=2, layers=2,
+                              max_len=64, seed=1)
+
+
+def _drive(eng, reqs, max_ticks=400):
+    for _ in range(max_ticks):
+        eng.tick()
+        if all(r.future.done() for r in reqs):
+            return
+    raise AssertionError("engine did not drain")
+
+
+# ---------------------------------------------------------------------------
+# disabled mode: the one-flag-check contract
+
+
+def test_disabled_mints_no_trace(model):
+    assert reqtrace.new_trace() is None
+    assert reqtrace.attach(None, kind="decode") is None
+    eng = GenerateEngine(model, slots=1, page=16, factor=2.0, max_len=64,
+                         prompt_buckets=(4,), start=False, shed=False)
+    req = eng.make_request([1, 2], max_new_tokens=3, eos_token=None)
+    assert req.trace is None
+    eng.submit_request(req)
+    _drive(eng, [req])
+    eng.close()
+    assert len(req.future.result(timeout=5)) == 3
+    assert reqtrace.recent() == []
+
+
+# ---------------------------------------------------------------------------
+# the stage machine: blame-derived attribution reconciles by construction
+
+
+def test_stage_sum_reconciles_exactly(mon):
+    att = reqtrace.attach(None, kind="decode", priority=1)
+    time.sleep(0.01)
+    att.to("prefill")
+    time.sleep(0.01)
+    att.first_token()
+    time.sleep(0.01)
+    att.note_tokens(5)
+    rec = att.finalize("ok")
+    assert rec["outcome"] == "ok" and rec["origin"] == "submit"
+    assert rec["recon"] == pytest.approx(1.0, abs=1e-3)
+    assert rec["stage_sum_ms"] == pytest.approx(rec["e2e_ms"], rel=1e-3)
+    for stage in ("queue_ms", "prefill_ms", "decode_ms"):
+        assert rec[stage] > 0
+    # ttft is the prefill exit, not the submit or the completion
+    assert 0 < rec["ttft_ms"] < rec["e2e_ms"]
+    assert rec["tpot_ms"] == pytest.approx(
+        (rec["e2e_ms"] - rec["ttft_ms"]) / 4, rel=1e-2)
+
+
+def test_serve_kind_ttft_is_e2e(mon):
+    att = reqtrace.attach(None, kind="serve")
+    time.sleep(0.005)
+    rec = att.finalize("ok")
+    assert rec["reqkind"] == "serve"
+    assert rec["ttft_ms"] == rec["e2e_ms"]
+    assert rec["tpot_ms"] is None
+
+
+def test_failed_outcome_has_no_slo_fields(mon):
+    att = reqtrace.attach(None, kind="decode")
+    rec = att.finalize("error", error="boom")
+    assert rec["outcome"] == "error" and rec["error"] == "boom"
+    assert rec["ttft_ms"] is None and rec["tpot_ms"] is None
+    # even a request that died in queue reconciles
+    assert rec["recon"] == pytest.approx(1.0, abs=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# exactly once: the done-latch across attempts
+
+
+def test_double_finalize_is_swallowed(mon):
+    att = reqtrace.attach(None, kind="decode")
+    first = att.finalize("ok")
+    assert first is not None
+    assert att.finalize("error", error="late loser") is None
+    assert att.ctx.record() is first
+    assert len(reqtrace.recent()) == 1
+
+
+def test_hedge_shadow_shares_context_one_record(mon):
+    primary = reqtrace.attach(None, kind="decode")
+    ctx = primary.ctx
+    time.sleep(0.01)
+    shadow = ctx.attempt("hedge", replica=1)
+    ctx.hop("hedge", replica=1)
+    shadow.first_token()
+    shadow.note_tokens(3)
+    rec = shadow.finalize("ok")          # the shadow wins the race
+    assert primary.finalize("ok") is None
+    assert len(reqtrace.recent()) == 1
+    assert rec["origin"] == "hedge" and rec["attempts"] == 2
+    # the submit->dispatch gap is blamed on the hedge stage
+    assert rec["hedge_ms"] >= 9.0
+    assert any(h["hop"] == "hedge" for h in rec["hops"])
+    # a post-finalize transition on the loser can't corrupt the record
+    primary.to("prefill")
+    assert ctx.record() is rec
+
+
+def test_shed_retry_continuity(mon):
+    att = reqtrace.attach(None, kind="decode", priority=2)
+    att.shed(level=1, retry_after_ms=5.0)
+    time.sleep(0.01)                      # caller backoff before resubmit
+    retry = reqtrace.attach(att, kind="decode")   # resubmit w/ same trace
+    assert retry.ctx is att.ctx
+    retry.first_token()
+    retry.note_tokens(2)
+    rec = retry.finalize("ok")
+    assert rec["origin"] == "retry"
+    assert rec["attempts"] == 2 and rec["sheds"] == 1
+    assert rec["shed_retry_ms"] >= 9.0    # the backoff gap is blamed
+    assert any(h["hop"] == "shed" and h["level"] == 1
+               for h in rec["hops"])
+    assert rec["recon"] == pytest.approx(1.0, abs=RECON_TOL)
+
+
+# ---------------------------------------------------------------------------
+# engine integration: real records off a real decode engine
+
+
+def test_engine_decode_record_waterfall(model, mon):
+    eng = GenerateEngine(model, slots=2, page=16, factor=2.0, max_len=64,
+                         prompt_buckets=(4, 8), start=False, shed=False)
+    req = eng.make_request([1, 2, 3], max_new_tokens=6, eos_token=None)
+    assert req.trace is not None
+    eng.submit_request(req)
+    _drive(eng, [req])
+    eng.close()
+    assert len(req.future.result(timeout=5)) == 6
+    rec = req.trace.ctx.record()
+    assert rec is not None
+    assert rec["reqkind"] == "decode" and rec["outcome"] == "ok"
+    assert rec["tokens"] == 6
+    assert rec["ttft_ms"] is not None and rec["tpot_ms"] is not None
+    assert rec["prefill_ms"] > 0 and rec["decode_ms"] > 0
+    assert abs(rec["recon"] - 1.0) <= RECON_TOL
+    assert rec["hops"][0]["hop"] == "enqueue"
+
+
+def test_engine_churn_exactly_one_record_each(model, mon):
+    eng = GenerateEngine(model, slots=2, page=16, factor=2.0, max_len=64,
+                         prompt_buckets=(4, 8), start=False, shed=False)
+    reqs = []
+    for i in range(16):
+        r = eng.make_request([1 + i % 7, 2, 3][: 1 + i % 3],
+                             max_new_tokens=2 + i % 5, eos_token=None)
+        eng.submit_request(r)
+        reqs.append(r)
+    _drive(eng, reqs)
+    eng.close()
+    recs = [r.trace.ctx.record() for r in reqs]
+    assert all(rec is not None for rec in recs)
+    rids = [rec["rid"] for rec in recs]
+    assert len(set(rids)) == 16
+    emitted = [rec["rid"] for rec in reqtrace.recent()]
+    assert sorted(emitted) == sorted(rids)      # no lost, no duplicate
+    assert all(rec["outcome"] == "ok" for rec in recs)
+    assert all(abs(rec["recon"] - 1.0) <= RECON_TOL for rec in recs)
+
+
+def test_engine_requeue_failover_lineage(model, mon):
+    """A failed-over request re-enters at queue front with a requeue hop
+    and its stage machine back in queue; ttft re-stamps on re-prefill."""
+    eng = GenerateEngine(model, slots=1, page=16, factor=2.0, max_len=64,
+                         prompt_buckets=(4,), start=False, shed=False)
+    req = eng.make_request([3, 1], max_new_tokens=3, eos_token=None)
+    req.trace.to("prefill")               # pretend a first dispatch began
+    eng.requeue([req])                    # supervisor failover path
+    _drive(eng, [req])
+    eng.close()
+    rec = req.trace.ctx.record()
+    assert rec["outcome"] == "ok"
+    assert any(h["hop"] == "requeue" for h in rec["hops"])
+    assert rec["ttft_ms"] is not None
+
+
+# ---------------------------------------------------------------------------
+# per-slot decode timeline + flow arrows in the Chrome export
+
+
+def test_slot_lanes_and_flow_arrows(model, mon, tmp_path):
+    monitor.trace.enable()
+    eng = GenerateEngine(model, slots=2, page=16, factor=2.0, max_len=64,
+                         prompt_buckets=(4, 8), start=False, shed=False)
+    reqs = []
+    for i in range(4):
+        r = eng.make_request([1 + i, 2], max_new_tokens=3, eos_token=None)
+        eng.submit_request(r)
+        reqs.append(r)
+    _drive(eng, reqs)
+    eng.close()
+
+    lanes = monitor.trace.lanes()
+    assert any(name.startswith("kv.slot") for name in lanes)
+    path = str(tmp_path / "trace.json")
+    monitor.trace.export_chrome_trace(path)
+    evs = json.load(open(path))["traceEvents"]
+
+    lane_tids = {lanes[n] for n in lanes if n.startswith("kv.slot")}
+    occupancy = [e for e in evs if e.get("ph") == "X"
+                 and e.get("tid") in lane_tids]
+    assert len(occupancy) >= 4            # >=1 interval per request
+    assert {e["tid"] for e in occupancy} == lane_tids   # every slot lane
+    named = [e for e in evs if e.get("ph") == "M"
+             and e.get("name") == "thread_name"
+             and e.get("tid") in lane_tids]
+    assert len(named) == len(lane_tids)
+
+    starts = {e["id"] for e in evs if e.get("ph") == "s"}
+    ends = {e["id"] for e in evs if e.get("ph") == "f"}
+    assert starts & ends                  # at least one linked arrow
+    assert all(e.get("bp") == "e" for e in evs if e.get("ph") == "f")
+
+
+# ---------------------------------------------------------------------------
+# exemplar rings, rollup gauges, bucket family
+
+
+def test_exemplar_rings_bounded_and_sorted(mon, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_REQ_EXEMPLARS", "3")
+    for ttft in (5.0, 50.0, 20.0, 80.0, 1.0, 35.0):
+        reqtrace._remember({"rid": f"r{ttft}", "ttft_ms": ttft,
+                            "tpot_ms": ttft / 10.0})
+    ex = reqtrace.exemplars()
+    assert ex["cap"] == 3
+    assert [r["ttft_ms"] for r in ex["worst_ttft"]] == [80.0, 50.0, 35.0]
+    assert [r["tpot_ms"] for r in ex["worst_tpot"]] == [8.0, 5.0, 3.5]
+    assert len(reqtrace.recent()) == 6    # the recent buffer keeps all
+
+
+def test_slo_rollup_and_snapshot_surface(mon):
+    att = reqtrace.attach(None, kind="decode")
+    att.first_token()
+    att.note_tokens(4)
+    att.finalize("ok")
+    roll = smetrics.slo_rollup()
+    assert roll["ttft_p50_ms"] is not None
+    assert roll["ttft_p99_ms"] is not None
+    assert roll["tpot_p99_ms"] is not None
+    from paddle_tpu.monitor import export
+    snap = export.snapshot_payload()
+    assert "slow_requests" in snap
+    assert snap["slow_requests"]["worst_ttft"]
+
+
+def test_latency_bucket_family():
+    b = smetrics.LATENCY_BUCKETS_MS
+    assert b[0] == pytest.approx(0.001)
+    assert b[-1] == pytest.approx(10000.0)
+    assert all(x < y for x, y in zip(b, b[1:]))
+    # three buckets per decade, sub-ms through 10s
+    assert len(b) == 22
