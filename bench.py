@@ -615,6 +615,45 @@ def bench_decode(timeout_s=600):
     }
 
 
+def bench_spec_decode(timeout_s=900):
+    """Speculative-decode stage: runs scripts/spec_smoke.py in a
+    subprocess (CPU) and banks the draft-verify numbers: plain sampled
+    tokens/s vs speculative at k=4 and k=8 on the distilled demo pair,
+    the two speedup ratios, and the measured accept rates. The
+    sentinel bands the wall-clock rates very wide; the speedup ratios
+    get a wide band too (they divide two CPU clocks), but the accept
+    rate is pure arithmetic over the verify ledger — tight band, a
+    drop means the accept-prefix rule or the draft distillation
+    regressed, not the weather."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    smoke = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "scripts", "spec_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_spec"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"spec_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    sp = r["speedup"]
+    return {
+        "decode_sampled_tokens_per_s": sp["plain_tokens_per_s"],
+        "decode_spec_tokens_per_s": sp["spec_k8_tokens_per_s"],
+        "decode_spec_speedup_x": sp["speedup_k4_x"],
+        "decode_spec_speedup_k8_x": sp["speedup_k8_x"],
+        "decode_accept_rate": sp["accept_rate_k4"],
+        "decode_accept_rate_k8": sp["accept_rate_k8"],
+        "decode_spec_gates_pass": bool(r["ok"]),
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -1099,6 +1138,16 @@ def main():
                   f"{dec['decode_tokens_per_s']} "
                   f"speedup_x={dec['decode_speedup_x']}", flush=True)
             _RESULTS.update(dec)
+        try:
+            spd = bench_spec_decode()
+        except Exception as e:
+            print(f"spec_decode bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial decode_spec_speedup_x="
+                  f"{spd['decode_spec_speedup_x']} "
+                  f"accept_rate={spd['decode_accept_rate']}", flush=True)
+            _RESULTS.update(spd)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

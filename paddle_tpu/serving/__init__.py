@@ -39,8 +39,14 @@ traffic into them.
 * :mod:`~paddle_tpu.serving.generate`  — :class:`GenerateEngine`:
   continuous-batching autoregressive decode (fixed slot batch, one
   fused step per tick, prefill/decode split, zero steady-state
-  compiles) and :class:`MultiDecodeEngine`, its breaker-aware fleet
-  fan-out
+  compiles), with in-step sampling and an optional draft-model
+  speculative verify loop, and :class:`MultiDecodeEngine`, its
+  breaker-aware fleet fan-out
+* :mod:`~paddle_tpu.serving.sampling`  — :class:`SamplingParams`
+  (temperature / top-k / top-p / per-request seed), the batch-shaped
+  jit-safe filter + Gumbel-max sampler, the counter-based PRNG keys
+  that make streams bit-reproducible across replicas, and the
+  speculative accept-prefix rule
 * :mod:`~paddle_tpu.serving.reqtrace`  — request-scoped tracing: one
   ``serving.request`` record per logical request with the blame-
   assigned stage waterfall (queue/assemble/execute/prefill/decode/
@@ -74,6 +80,7 @@ from . import multi  # noqa: F401
 from . import supervisor  # noqa: F401
 from . import kv_cache  # noqa: F401
 from . import reqtrace  # noqa: F401
+from . import sampling  # noqa: F401
 from . import generate  # noqa: F401
 from .admission import (AdmissionController, QueueFullError,  # noqa: F401
                         DeadlineExpired, ShedError, PRIORITIES)
@@ -81,7 +88,9 @@ from .batcher import DynamicBatcher, Request  # noqa: F401
 from .breaker import CircuitBreaker  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .generate import (GenerateEngine, MultiDecodeEngine,  # noqa: F401
-                       DecodeRequest, replicate_decode, demo_model)
+                       DecodeRequest, replicate_decode, demo_model,
+                       demo_spec_pair)
+from .sampling import SamplingParams  # noqa: F401
 from .kv_cache import KVCachePool  # noqa: F401
 from .multi import (MultiDeviceEngine, NoHealthyReplicaError,  # noqa: F401
                     replicate)
@@ -96,5 +105,6 @@ __all__ = [
     "ShedError", "PRIORITIES", "CircuitBreaker", "NoHealthyReplicaError",
     "ServingSupervisor",
     "GenerateEngine", "MultiDecodeEngine", "DecodeRequest", "KVCachePool",
-    "replicate_decode", "demo_model",
+    "replicate_decode", "demo_model", "demo_spec_pair", "sampling",
+    "SamplingParams",
 ]

@@ -48,6 +48,28 @@ decode traffic exactly as it does for fixed-shape traffic (plus the new
 out across breaker-guarded per-device replicas via the same
 ``MultiDeviceEngine`` machinery (failover, probes, restart).
 
+**Sampling** (PR 17) rides *inside* the fused decode step: temperature
+/ top-k / top-p / per-request seed enter as ``[slots]``-shaped arrays
+(see serving/sampling.py), so greedy and sampled sequences share one
+executable and a request's sampling config can never mint a trace.
+Every random draw uses a counter-based key — a pure function of
+``(request_seed, generation_index)`` — which makes a sequence's token
+stream bit-reproducible across admission order, replica choice,
+hedging, and failover re-prefill.
+
+**Speculative decoding** (``draft_model=`` + ``spec_k=``): a cheap
+draft model proposes ``k`` tokens autoregressively per tick (one
+``lax.scan`` executable over its own :class:`KVCachePool` arena), then
+the target verifies all ``k+1`` positions in one chunked step and the
+accept-prefix rule (serving/sampling.py) keeps the emitted stream
+*distributionally exact* against non-speculative sampling at the same
+seeds. Both arenas write optimistically and roll their slot ledgers
+back to the accepted prefix — pure host bookkeeping, no device copy.
+On full accept the engine emits exactly ``k`` tokens and keeps the
+last proposal as the next tick's input (no bonus token), which is what
+holds the draft and target arenas in per-slot lockstep with zero
+variable-shape catch-up work.
+
 The model contract (duck-typed; :func:`demo_model` is the reference
 implementation)::
 
@@ -59,18 +81,26 @@ implementation)::
     model.decode_fn(state, tokens[S], kv {leaf: [S, cap, *tail]},
                     lengths[S])
         -> (logits[S, V], entry {leaf: [S, *tail]})
+    model.verify_fn(state, tokens[S, C], kv, lengths[S])   # spec targets
+        -> (logits[S, C, V], entry {leaf: [S, C, *tail]})
 
 ``decode_fn`` attends over ``kv[:, :lengths]`` plus the incoming
 token's own K/V; the engine writes that entry at position ``lengths``
-and advances the host-side length. All slot bookkeeping (lengths,
-last tokens, liveness) lives on the host and ships as tiny arrays each
-tick — the only device-resident state is the KV arena itself, so slot
-churn never mints an executable.
+and advances the host-side length. ``verify_fn`` is the chunked
+generalization (``decode_fn`` is its C == 1 special case): position
+``i`` of the chunk attends over the resident history plus chunk
+positions ``<= i``, and all C cache entries come back for the engine's
+optimistic arena write. Only speculative *targets* need it. All slot
+bookkeeping (lengths, last tokens, liveness) lives on the host and
+ships as tiny arrays each tick — the only device-resident state is the
+KV arena itself, so slot churn never mints an executable.
 """
 from __future__ import annotations
 
 import collections
 import concurrent.futures
+import itertools
+import os
 import threading
 import time
 
@@ -85,6 +115,16 @@ from .kv_cache import KVCachePool
 from .multi import MultiDeviceEngine
 from . import metrics
 from . import reqtrace
+from . import sampling as sampling_mod
+
+_seed_counter = itertools.count(1)
+
+
+def _fresh_seed():
+    """Engine-assigned per-request seed (sampled requests that didn't
+    pass one). Unique per process + submit order — and recorded on the
+    request, so failover replay and hedge shadows reuse it verbatim."""
+    return (os.getpid() * 2654435761 + next(_seed_counter)) & 0x7FFFFFFF
 
 
 class DecodeRequest:
@@ -94,13 +134,18 @@ class DecodeRequest:
     failover's first-resolution-wins contract holds."""
 
     __slots__ = ("prompt", "max_new_tokens", "eos_token", "n",
-                 "future", "deadline", "t_enqueue", "priority", "trace")
+                 "future", "deadline", "t_enqueue", "priority", "trace",
+                 "sampling")
 
     def __init__(self, prompt, max_new_tokens, eos_token=None,
-                 deadline=None, priority=1, trace=None):
+                 deadline=None, priority=1, trace=None, sampling=None):
         self.prompt = prompt                    # 1-D int32 host array
         self.max_new_tokens = int(max_new_tokens)
         self.eos_token = eos_token
+        # resolved SamplingParams with a concrete seed — the request
+        # carries it so failover/hedge replay is bit-identical
+        self.sampling = (sampling if sampling is not None
+                         else sampling_mod.SamplingParams(seed=0))
         self.n = 1                              # one sequence
         self.future = concurrent.futures.Future()
         self.deadline = deadline
@@ -170,6 +215,15 @@ class GenerateEngine:
         tick) or ``"drain"`` (run-to-completion waves: no admission
         until *every* slot is free — the static-batching baseline the
         loadgen A/Bs against; same executables, different discipline).
+    sampling : engine-default :class:`~paddle_tpu.serving.sampling.
+        SamplingParams` (or dict) for submits that don't pass their
+        own; None = greedy (the PR 15 behavior, bit for bit).
+    draft_model : enable speculative decoding — a cheaper model of the
+        SAME vocab whose proposals the target verifies. Rides its own
+        :class:`KVCachePool` arena on the same page schedule. The
+        target model must implement ``verify_fn``.
+    spec_k : draft proposals per speculative tick (>= 1); the realized
+        multiplier is ``serving.decode.spec_tokens_per_step``.
     start : launch the tick thread now (False = tests drive
         :meth:`tick` manually).
     """
@@ -177,7 +231,8 @@ class GenerateEngine:
     def __init__(self, model, slots=8, page=64, factor=2.0, max_len=512,
                  prompt_buckets=None, queue_depth=256, deadline_ms=None,
                  refill="continuous", shed=True, slo_goodput_floor=0.90,
-                 start=True, replica_id=None, on_outcome=None):
+                 start=True, replica_id=None, on_outcome=None,
+                 sampling=None, draft_model=None, spec_k=4):
         import jax
         self._jax = jax
         self.model = model
@@ -187,10 +242,40 @@ class GenerateEngine:
             raise ValueError(
                 f"refill must be 'continuous' or 'drain', got {refill!r}")
         self.refill = refill
+        self.default_sampling = sampling_mod.resolve(sampling)
         self.pool = KVCachePool(model.kv_spec(), slots, page=page,
                                 factor=factor, max_len=max_len)
         self.slots = self.pool.slots
         self.max_len = self.pool.max_len
+        self.spec_k = int(spec_k)
+        self.draft_model = draft_model
+        self.draft_pool = None
+        self._draft_state = None
+        if draft_model is not None:
+            if self.spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if int(draft_model.vocab) != int(model.vocab):
+                raise ValueError(
+                    f"draft vocab {draft_model.vocab} != target vocab "
+                    f"{model.vocab} — the accept rule compares "
+                    f"distributions over one vocabulary")
+            if not hasattr(model, "verify_fn"):
+                raise ValueError(
+                    "speculative decoding needs model.verify_fn "
+                    "(chunked decode) on the TARGET model")
+            # the draft arena shares the slot count and page schedule,
+            # so _ensure_capacity can grow both pools in lockstep and
+            # plan_slots([target_spec, draft_spec], ...) prices the pair
+            self.draft_pool = KVCachePool(
+                draft_model.kv_spec(), slots, page=page, factor=factor,
+                max_len=max_len, label="draft")
+            dstate = draft_model.state
+            dev = getattr(model, "device", None)
+            if dev is not None:
+                # fleet replicas share one draft object; pin a state
+                # copy next to this replica's target weights
+                dstate = jax.device_put(dstate, dev)
+            self._draft_state = dstate
         if prompt_buckets is None:
             self.prompt_buckets = tuple(self.pool.seq_buckets)
         else:
@@ -223,7 +308,9 @@ class GenerateEngine:
         self._stats = {"submitted": 0, "completed": 0, "failed": 0,
                        "rejected": 0, "expired": 0, "shed": 0,
                        "ticks": 0, "tokens": 0, "prefills": 0,
-                       "prefill_tokens": 0, "compiles": 0, "grows": 0}
+                       "prefill_tokens": 0, "compiles": 0, "grows": 0,
+                       "draft_steps": 0, "verify_steps": 0,
+                       "spec_proposed": 0, "spec_accepted": 0}
         self._occupancy_sum = 0.0
         self._running = False
         self._closed = False
@@ -250,11 +337,19 @@ class GenerateEngine:
     # -- client surface ----------------------------------------------------
 
     def make_request(self, prompt, max_new_tokens=32, eos_token=None,
-                     deadline_ms=None, priority=None, trace=None):
+                     deadline_ms=None, priority=None, trace=None,
+                     sampling=None, seed=None):
         """Validate one submit into a :class:`DecodeRequest` (not yet
         enqueued — the fleet wrapper builds once, then routes). Pass a
         shed request's ``RequestTrace`` as ``trace=`` when re-submitting
-        so the retry folds into the same ``serving.request`` record."""
+        so the retry folds into the same ``serving.request`` record.
+
+        ``sampling`` is None (engine default; greedy unless the engine
+        was built with one), a dict of knobs, or
+        :class:`~paddle_tpu.serving.sampling.SamplingParams`; ``seed``
+        overrides its per-request seed. A sampled request with no seed
+        gets a fresh one HERE, so the request object carries everything
+        failover or a hedge shadow needs to replay the exact stream."""
         arr = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if arr.size < 1:
             raise ValueError("empty prompt")
@@ -273,8 +368,15 @@ class GenerateEngine:
         deadline = (Deadline.after_ms(deadline_ms)
                     if deadline_ms is not None else None)
         prio = resolve_priority(priority)
+        if sampling is None and seed is None:
+            params = sampling_mod.resolve(self.default_sampling)
+        else:
+            params = sampling_mod.resolve(sampling, seed=seed)
+        if params.seed is None:
+            params.seed = 0 if params.greedy else _fresh_seed()
         return DecodeRequest(arr, m, eos_token=eos_token,
                              deadline=deadline, priority=prio,
+                             sampling=params,
                              trace=reqtrace.attach(
                                  trace, kind="decode", priority=prio,
                                  replica=self.replica_id))
@@ -301,20 +403,24 @@ class GenerateEngine:
         return req.future
 
     def submit(self, prompt, max_new_tokens=32, eos_token=None,
-               deadline_ms=None, priority=None, trace=None):
+               deadline_ms=None, priority=None, trace=None,
+               sampling=None, seed=None):
         """Enqueue one sequence; the future resolves to the generated
         token ids (``np.int32``; the first token comes from the prefill
         itself, EOS — when given and hit — is included and terminal)."""
         return self.submit_request(self.make_request(
             prompt, max_new_tokens=max_new_tokens, eos_token=eos_token,
-            deadline_ms=deadline_ms, priority=priority, trace=trace))
+            deadline_ms=deadline_ms, priority=priority, trace=trace,
+            sampling=sampling, seed=seed))
 
     def run(self, prompt, max_new_tokens=32, eos_token=None,
-            deadline_ms=None, timeout=None, priority=None):
+            deadline_ms=None, timeout=None, priority=None,
+            sampling=None, seed=None):
         return self.submit(prompt, max_new_tokens=max_new_tokens,
                            eos_token=eos_token,
                            deadline_ms=deadline_ms,
-                           priority=priority).result(timeout)
+                           priority=priority, sampling=sampling,
+                           seed=seed).result(timeout)
 
     def depth(self):
         with self._lock:
@@ -327,6 +433,22 @@ class GenerateEngine:
     # executables() exposes both the key count and the honest trace
     # count — the smoke gate pins the latter after warmup.
 
+    @staticmethod
+    def _masked_write(jnp, buffers, entry, rows, pos, active, n_slots):
+        """Scatter per-slot cache entries at ``pos`` into the arena,
+        masked by ``active`` (inactive lanes keep their old rows). The
+        mask rides on the scattered VALUES — gather the old rows, blend,
+        one scatter — so the whole-arena update stays a single aliasable
+        write (the executables donate their arena argument; a masked
+        ``jnp.where`` over the full buffer would force two copies)."""
+        out = {}
+        for name, buf in buffers.items():
+            old = buf[rows, pos]
+            mask = active.reshape((n_slots,) + (1,) * (old.ndim - 1))
+            out[name] = buf.at[rows, pos].set(
+                jnp.where(mask, entry[name], old))
+        return out
+
     def _get_decode(self, cap):
         key = ("decode", cap)
         fn = self._exec.get(key)
@@ -337,20 +459,24 @@ class GenerateEngine:
         decode_fn = self.model.decode_fn
         n_slots = self.slots
 
-        def step(state, buffers, tokens, lengths, active):
+        def step(state, buffers, tokens, lengths, active,
+                 temps, top_ks, top_ps, seeds, positions):
             self._trace_count += 1
             logits, entry = decode_fn(state, tokens, buffers, lengths)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            filt = sampling_mod.filter_logits(logits, temps, top_ks,
+                                              top_ps)
+            nxt = sampling_mod.sample_from_filtered(filt, seeds,
+                                                    positions)
             pos = jnp.minimum(lengths, cap - 1)
             rows = jnp.arange(n_slots)
-            out = {}
-            for name, buf in buffers.items():
-                upd = buf.at[rows, pos].set(entry[name])
-                mask = active.reshape((n_slots,) + (1,) * (buf.ndim - 1))
-                out[name] = jnp.where(mask, upd, buf)
+            out = self._masked_write(jnp, buffers, entry, rows, pos,
+                                     active, n_slots)
             return nxt, out
 
-        fn = jax.jit(step)
+        # the caller always replaces pool.buffers with the result, so
+        # the arena is donated — the scatter updates in place instead
+        # of copying slots × capacity × spec bytes every token
+        fn = jax.jit(step, donate_argnums=(1,))
         self._exec[key] = fn
         self._note_compile(f"decode[cap={cap}]")
         return fn
@@ -361,13 +487,16 @@ class GenerateEngine:
         if fn is not None:
             return fn
         jax = self._jax
-        jnp = jax.numpy
         prefill_fn = self.model.prefill_fn
 
-        def prefill(state, tokens, lengths):
+        def prefill(state, tokens, lengths, temps, top_ks, top_ps,
+                    seeds, positions):
             self._trace_count += 1
             kv, last_logits = prefill_fn(state, tokens, lengths)
-            first = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            filt = sampling_mod.filter_logits(last_logits, temps,
+                                              top_ks, top_ps)
+            first = sampling_mod.sample_from_filtered(filt, seeds,
+                                                      positions)
             return kv, first
 
         fn = jax.jit(prefill)
@@ -375,8 +504,28 @@ class GenerateEngine:
         self._note_compile(f"prefill[L={bucket}]")
         return fn
 
-    def _get_insert(self, bucket, cap):
-        key = ("insert", bucket, cap)
+    def _get_draft_prefill(self, bucket):
+        """Draft-arena prompt ingest: the draft's KV only — the first
+        token is the target prefill's to sample."""
+        key = ("dprefill", bucket)
+        fn = self._exec.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        prefill_fn = self.draft_model.prefill_fn
+
+        def prefill(dstate, tokens, lengths):
+            self._trace_count += 1
+            kv, _last = prefill_fn(dstate, tokens, lengths)
+            return kv
+
+        fn = jax.jit(prefill)
+        self._exec[key] = fn
+        self._note_compile(f"dprefill[L={bucket}]")
+        return fn
+
+    def _get_insert(self, bucket, cap, kind="insert"):
+        key = (kind, bucket, cap)
         fn = self._exec.get(key)
         if fn is not None:
             return fn
@@ -391,13 +540,13 @@ class GenerateEngine:
                     buf, chunk[name], start)
             return out
 
-        fn = jax.jit(insert)
+        fn = jax.jit(insert, donate_argnums=(0,))
         self._exec[key] = fn
-        self._note_compile(f"insert[L={bucket}, cap={cap}]")
+        self._note_compile(f"{kind}[L={bucket}, cap={cap}]")
         return fn
 
-    def _get_grow(self, old_cap, new_cap):
-        key = ("grow", old_cap, new_cap)
+    def _get_grow(self, old_cap, new_cap, kind="grow"):
+        key = (kind, old_cap, new_cap)
         fn = self._exec.get(key)
         if fn is not None:
             return fn
@@ -416,7 +565,96 @@ class GenerateEngine:
 
         fn = jax.jit(grow)
         self._exec[key] = fn
-        self._note_compile(f"grow[{old_cap}->{new_cap}]")
+        self._note_compile(f"{kind}[{old_cap}->{new_cap}]")
+        return fn
+
+    def _get_spec_draft(self, cap):
+        """The draft proposal loop: k autoregressive draft steps as one
+        executable (``lax.scan``, so k never multiplies dispatches).
+        Proposal ``i`` is drawn from the filtered draft distribution
+        with the SAME ``(seed, pos0+i, SALT_TOKEN)`` key the
+        non-speculative path would use at that generation index — that
+        identity is what makes a self-draft reproduce the
+        non-speculative stream. Returns ``(proposals[S, k],
+        q_probs[S, k, V], updated draft buffers)``."""
+        key = ("sdraft", cap)
+        fn = self._exec.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        jnp = jax.numpy
+        draft_fn = self.draft_model.decode_fn
+        n_slots = self.slots
+        k = self.spec_k
+
+        def propose(dstate, dbufs, tokens, lengths, active,
+                    temps, top_ks, top_ps, seeds, pos0):
+            self._trace_count += 1
+            rows = jnp.arange(n_slots)
+
+            def body(carry, i):
+                bufs, tok, ln = carry
+                logits, entry = draft_fn(dstate, tok, bufs, ln)
+                filt = sampling_mod.filter_logits(logits, temps,
+                                                  top_ks, top_ps)
+                d = sampling_mod.sample_from_filtered(filt, seeds,
+                                                      pos0 + i)
+                q = sampling_mod.probs_from_filtered(filt)
+                pos = jnp.minimum(ln, cap - 1)
+                bufs = self._masked_write(jnp, bufs, entry, rows, pos,
+                                          active, n_slots)
+                return (bufs, d, ln + 1), (d, q)
+
+            (bufs, _tok, _ln), (ds, qs) = jax.lax.scan(
+                body, (dbufs, tokens, lengths), jnp.arange(k))
+            return (jnp.transpose(ds, (1, 0)),
+                    jnp.transpose(qs, (1, 0, 2)), bufs)
+
+        fn = jax.jit(propose, donate_argnums=(1,))
+        self._exec[key] = fn
+        self._note_compile(f"sdraft[cap={cap}, k={k}]")
+        return fn
+
+    def _get_verify(self, cap):
+        """The target's batched verify: one chunked forward over
+        ``[last, d_1 .. d_k]`` evaluates all k+1 positions, writes the
+        k+1 cache entries optimistically (the host ledger rolls back to
+        the accepted prefix), and runs the accept-prefix rule in-graph.
+        Returns ``(n_accepted[S], resampled[S], updated buffers)``."""
+        key = ("verify", cap)
+        fn = self._exec.get(key)
+        if fn is not None:
+            return fn
+        jax = self._jax
+        jnp = jax.numpy
+        verify_fn = self.model.verify_fn
+        n_slots = self.slots
+        k = self.spec_k
+
+        def verify(state, buffers, chunk, lengths, active,
+                   temps, top_ks, top_ps, seeds, pos0, proposals,
+                   q_probs):
+            self._trace_count += 1
+            logits, entry = verify_fn(state, chunk, buffers, lengths)
+            rows = jnp.arange(n_slots)
+            pos = jnp.minimum(
+                lengths[:, None] + jnp.arange(k + 1)[None, :], cap - 1)
+            out = self._masked_write(jnp, buffers, entry, rows[:, None],
+                                     pos, active, n_slots)
+            # filter all k+1 target distributions with this slot's knobs
+            flat = logits.reshape(n_slots * (k + 1), -1)
+            rep = lambda a: jnp.repeat(a, k + 1)    # noqa: E731
+            p_flat = sampling_mod.probs_from_filtered(
+                sampling_mod.filter_logits(flat, rep(temps),
+                                           rep(top_ks), rep(top_ps)))
+            p_probs = p_flat.reshape(n_slots, k + 1, -1)
+            a, resampled = sampling_mod.accept_prefix(
+                p_probs, q_probs, proposals, seeds, pos0)
+            return a, resampled, out
+
+        fn = jax.jit(verify, donate_argnums=(1,))
+        self._exec[key] = fn
+        self._note_compile(f"verify[cap={cap}, k={k}]")
         return fn
 
     def _note_compile(self, what):
@@ -429,12 +667,26 @@ class GenerateEngine:
         :meth:`warmup` across any amount of join/leave churn."""
         return len(self._exec), self._trace_count
 
+    def _sampling_args(self, n):
+        """Zero-valued (= greedy) sampling arrays of batch width ``n``
+        for warmup and probe calls — shapes and dtypes must match the
+        live tick's exactly or the zero-retrace gate trips."""
+        import jax.numpy as jnp
+        return (jnp.zeros((n,), jnp.float32),    # temps
+                jnp.zeros((n,), jnp.int32),      # top_ks
+                jnp.ones((n,), jnp.float32),     # top_ps
+                jnp.zeros((n,), jnp.uint32),     # seeds
+                jnp.zeros((n,), jnp.int32))      # positions
+
     def warmup(self, *_signatures):
         """Mint and trace every executable the engine can ever need:
         one decode step per capacity bucket, one grow per consecutive
         bucket pair, one prefill per prompt bucket, and one insert per
-        (prompt bucket, capacity) pair that can co-occur. After this,
-        steady-state churn — including cache growth — runs entirely on
+        (prompt bucket, capacity) pair that can co-occur — plus, when a
+        draft model is mounted, the speculative family (draft prefill /
+        insert / grow per the same buckets, and one draft-scan + verify
+        pair per capacity). After this, steady-state churn — including
+        cache growth and any accept/reject pattern — runs entirely on
         cached executables. Returns the number compiled. (Positional
         signatures from the fleet wrapper are accepted and ignored —
         a decode engine's shapes come from its bucket families.)"""
@@ -446,13 +698,24 @@ class GenerateEngine:
         tokens_s = jnp.zeros((self.slots,), jnp.int32)
         ones_s = jnp.ones((self.slots,), jnp.int32)
         active = jnp.zeros((self.slots,), bool)
+        samp_s = self._sampling_args(self.slots)
+        samp_1 = self._sampling_args(1)
+        speculative = self.draft_model is not None
+        dspec = self.draft_pool._leaf_list if speculative else None
+
+        def zeros_arena(leaf_list, cap):
+            # fresh per donating call — the executables consume (donate)
+            # their arena argument, so a shared warmup buffer would be
+            # a use-after-donate
+            return {name: jnp.zeros((self.slots, cap) + tail, dt)
+                    for name, tail, dt in leaf_list}
+
         with _monitor.trace.span("serving.warmup",
                                  buckets=len(family)):
             for cap in family:
-                bufs = {name: jnp.zeros((self.slots, cap) + tail, dt)
-                        for name, tail, dt in spec}
                 nxt, out = self._get_decode(cap)(
-                    state, bufs, tokens_s, ones_s, active)
+                    state, zeros_arena(spec, cap), tokens_s, ones_s,
+                    active, *samp_s)
                 self._jax.block_until_ready(nxt)
                 for lb in self.prompt_buckets:
                     if lb > cap:
@@ -460,16 +723,51 @@ class GenerateEngine:
                     chunk = {name: jnp.zeros((1, lb) + tail, dt)
                              for name, tail, dt in spec}
                     self._jax.block_until_ready(self._get_insert(lb, cap)(
-                        bufs, chunk, jnp.int32(0)))
+                        zeros_arena(spec, cap), chunk, jnp.int32(0)))
+                if speculative:
+                    ds, qs, _ = self._get_spec_draft(cap)(
+                        self._draft_state, zeros_arena(dspec, cap),
+                        tokens_s, ones_s, active, *samp_s)
+                    self._jax.block_until_ready(ds)
+                    k = self.spec_k
+                    a, t, _ = self._get_verify(cap)(
+                        state, zeros_arena(spec, cap),
+                        jnp.zeros((self.slots, k + 1), jnp.int32),
+                        ones_s, active, *samp_s,
+                        jnp.zeros((self.slots, k), jnp.int32),
+                        jnp.zeros((self.slots, k, self.model.vocab),
+                                  jnp.float32))
+                    self._jax.block_until_ready(a)
+                    for lb in self.prompt_buckets:
+                        if lb > cap:
+                            continue
+                        dchunk = {name: jnp.zeros((1, lb) + tail, dt)
+                                  for name, tail, dt in dspec}
+                        self._jax.block_until_ready(
+                            self._get_insert(lb, cap, kind="dinsert")(
+                                zeros_arena(dspec, cap), dchunk,
+                                jnp.int32(0)))
             for old, new in zip(family, family[1:]):
                 bufs = {name: jnp.zeros((self.slots, old) + tail, dt)
                         for name, tail, dt in spec}
                 self._jax.block_until_ready(self._get_grow(old, new)(bufs))
+                if speculative:
+                    dbufs = {name: jnp.zeros((self.slots, old) + tail,
+                                             dt)
+                             for name, tail, dt in dspec}
+                    self._jax.block_until_ready(
+                        self._get_grow(old, new, kind="dgrow")(dbufs))
             for lb in self.prompt_buckets:
                 kv, first = self._get_prefill(lb)(
                     state, jnp.zeros((1, lb), jnp.int32),
-                    jnp.ones((1,), jnp.int32))
+                    jnp.ones((1,), jnp.int32), *samp_1)
                 self._jax.block_until_ready(first)
+                if speculative:
+                    dkv = self._get_draft_prefill(lb)(
+                        self._draft_state, jnp.zeros((1, lb), jnp.int32),
+                        jnp.ones((1,), jnp.int32))
+                    self._jax.block_until_ready(
+                        next(iter(dkv.values())))
         return len(self._exec) - before
 
     # -- lifecycle ---------------------------------------------------------
@@ -511,6 +809,8 @@ class GenerateEngine:
                     leftovers.append(slot.req)
                     slot.req = None
                     self.pool.free(s)
+                    if self.draft_pool is not None:
+                        self.draft_pool.note_length(s, 0)
         for r in leftovers:
             r.resolve_exception(RuntimeError("decode engine closed"))
         from ..monitor import sampler as _sampler
@@ -539,22 +839,44 @@ class GenerateEngine:
         }
 
     def probe(self, timeout_s=1.0):
-        """Half-open test traffic: run the decode executable on an
-        all-inactive batch on a side thread (the tick thread may be the
-        thing that's wedged) and report whether it finished in time."""
+        """Half-open test traffic: run the decode executable (or, on a
+        speculative engine, the verify executable) on an all-inactive
+        batch on a side thread (the tick thread may be the thing that's
+        wedged) and report whether it finished in time."""
         import jax.numpy as jnp
-        if ("decode", self.pool.capacity) not in self._exec:
+        cap = self.pool.capacity
+        kind = ("decode" if ("decode", cap) in self._exec
+                else "verify" if ("verify", cap) in self._exec
+                else None)
+        if kind is None:
             return None          # never warmed / served — nothing to test
         done = threading.Event()
         err = []
 
         def _go():
             try:
-                fn = self._exec[("decode", self.pool.capacity)]
-                nxt, _ = fn(self.model.state, self.pool.buffers,
-                            jnp.zeros((self.slots,), jnp.int32),
-                            jnp.zeros((self.slots,), jnp.int32),
-                            jnp.zeros((self.slots,), bool))
+                fn = self._exec[(kind, cap)]
+                zeros = jnp.zeros((self.slots,), jnp.int32)
+                inactive = jnp.zeros((self.slots,), bool)
+                samp = self._sampling_args(self.slots)
+                # a throwaway arena, NOT pool.buffers — the executable
+                # donates (consumes) its arena argument, and the live
+                # pool must survive the probe
+                bufs = {name: jnp.zeros(
+                            (self.slots, self.pool.capacity) + tail, dt)
+                        for name, tail, dt in self.pool._leaf_list}
+                if kind == "decode":
+                    nxt, _ = fn(self.model.state, bufs,
+                                zeros, zeros, inactive, *samp)
+                else:
+                    k = self.spec_k
+                    nxt, _t, _b = fn(
+                        self.model.state, bufs,
+                        jnp.zeros((self.slots, k + 1), jnp.int32),
+                        zeros, inactive, *samp,
+                        jnp.zeros((self.slots, k), jnp.int32),
+                        jnp.zeros((self.slots, k, self.model.vocab),
+                                  jnp.float32))
                 self._jax.block_until_ready(nxt)
             except BaseException as e:   # noqa: BLE001 - probe verdict
                 err.append(e)
@@ -578,9 +900,12 @@ class GenerateEngine:
 
     def disown_inflight(self):
         """Failover: evict every live sequence and hand its request
-        over. Partial output is discarded — greedy decode is
-        deterministic, so the adopting replica regenerates the same
-        tokens from the prompt (first resolution wins either way)."""
+        over. Partial output is discarded — decode is a pure function
+        of the request (greedy argmax, or counter-based sampling keys
+        derived from the request's own ``(seed, generation_index)``),
+        so the adopting replica's re-prefill regenerates a
+        bit-identical stream from the prompt, speculative or not
+        (first resolution wins either way)."""
         taken = []
         evicted = []
         with self._lock:
@@ -591,6 +916,8 @@ class GenerateEngine:
                     slot.req = None
                     slot.tokens = None
                     self.pool.free(s)
+                    if self.draft_pool is not None:
+                        self.draft_pool.note_length(s, 0)
         trc = _monitor.trace
         if trc.enabled() and evicted:
             now_pc = time.perf_counter()
@@ -679,7 +1006,8 @@ class GenerateEngine:
             self._tick_t0 = t0
         try:
             admitted = self._admit()
-            stepped = self._decode_once()
+            stepped = (self._spec_once() if self.draft_model is not None
+                       else self._decode_once())
         finally:
             with self._lock:
                 self._tick_t0 = None
@@ -737,6 +1065,12 @@ class GenerateEngine:
             new = next_bucket(old + 1, self.pool.seq_buckets)
             fn = self._get_grow(old, new)
             self.pool.grow_to(new, lambda bufs, _o, _n: fn(bufs))
+            if self.draft_pool is not None:
+                # the two arenas share one page schedule; growing them
+                # in lockstep keeps every spec executable single-cap
+                dfn = self._get_grow(old, new, kind="dgrow")
+                self.draft_pool.grow_to(new,
+                                        lambda bufs, _o, _n: dfn(bufs))
             with self._stats_lock:
                 self._stats["grows"] += 1
             # growth pad marker on the arena's shared lane — lines up
@@ -768,13 +1102,30 @@ class GenerateEngine:
             t0 = time.monotonic()
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :p] = req.prompt
+            sp = req.sampling
+            # generation index 0: the prefill's sampled token — the
+            # same counter key a failover re-prefill will derive
             kv, first = self._get_prefill(bucket)(
                 self.model.state, jnp.asarray(tokens),
-                jnp.asarray([p], jnp.int32))
+                jnp.asarray([p], jnp.int32),
+                jnp.asarray([sp.temperature], jnp.float32),
+                jnp.asarray([sp.top_k], jnp.int32),
+                jnp.asarray([sp.top_p], jnp.float32),
+                jnp.asarray([sp.seed or 0], jnp.uint32),
+                jnp.zeros((1,), jnp.int32))
             first = int(first[0])
             self.pool.buffers = self._get_insert(bucket,
                                                  self.pool.capacity)(
                 self.pool.buffers, kv, jnp.int32(s))
+            self.pool.note_length(s, p)
+            if self.draft_pool is not None:
+                dkv = self._get_draft_prefill(bucket)(
+                    self._draft_state, jnp.asarray(tokens),
+                    jnp.asarray([p], jnp.int32))
+                self.draft_pool.buffers = self._get_insert(
+                    bucket, self.draft_pool.capacity, kind="dinsert")(
+                    self.draft_pool.buffers, dkv, jnp.int32(s))
+                self.draft_pool.note_length(s, p)
             ms = (time.monotonic() - t0) * 1e3
             metrics.record_prefill(p, ms, bucket)
             with self._stats_lock:
@@ -815,23 +1166,49 @@ class GenerateEngine:
 
     # -- the fused decode step ---------------------------------------------
 
-    def _decode_once(self):
-        import jax.numpy as jnp
+    def _gather_batch(self, extra=1):
+        """Snapshot the live lanes into the tick's host arrays: tokens /
+        lengths / active plus the per-slot sampling knobs (the batch-
+        shaped arrays that keep every request config on one executable)
+        and each lane's generation index (= the counter the PRNG keys
+        derive from). ``extra`` is the per-tick arena headroom (1 for
+        plain decode, k+1 for a speculative verify). Caller must NOT
+        hold the lock."""
         with self._lock:
             assigned = [(s, slot.req) for s, slot in enumerate(self._slots)
                         if slot.req is not None]
             if not assigned:
-                return False
+                return None
             tokens = np.zeros((self.slots,), np.int32)
             lengths = np.zeros((self.slots,), np.int32)
             active = np.zeros((self.slots,), bool)
+            temps = np.zeros((self.slots,), np.float32)
+            top_ks = np.zeros((self.slots,), np.int32)
+            top_ps = np.ones((self.slots,), np.float32)
+            seeds = np.zeros((self.slots,), np.uint32)
+            positions = np.zeros((self.slots,), np.int32)
             max_needed = 0
-            for s, _req in assigned:
+            for s, req in assigned:
                 slot = self._slots[s]
+                sp = req.sampling
                 tokens[s] = slot.last_token
                 lengths[s] = slot.length
                 active[s] = True
-                max_needed = max(max_needed, slot.length + 1)
+                temps[s] = sp.temperature
+                top_ks[s] = sp.top_k
+                top_ps[s] = sp.top_p
+                seeds[s] = sp.seed or 0
+                positions[s] = len(slot.tokens)
+                max_needed = max(max_needed, slot.length + extra)
+        return (assigned, tokens, lengths, active,
+                (temps, top_ks, top_ps, seeds, positions), max_needed)
+
+    def _decode_once(self):
+        import jax.numpy as jnp
+        batch = self._gather_batch(extra=1)
+        if batch is None:
+            return False
+        assigned, tokens, lengths, active, samp, max_needed = batch
         self._ensure_capacity(max_needed)
         try:
             if _faults.enabled():
@@ -840,7 +1217,8 @@ class GenerateEngine:
             fn = self._get_decode(self.pool.capacity)
             nxt, new_bufs = fn(self.model.state, self.pool.buffers,
                                jnp.asarray(tokens), jnp.asarray(lengths),
-                               jnp.asarray(active))
+                               jnp.asarray(active),
+                               *(jnp.asarray(a) for a in samp))
             nxt = np.asarray(nxt)
             step_ms = (time.monotonic() - t0) * 1e3
         except BaseException as e:   # noqa: BLE001 - fail the wave
@@ -861,6 +1239,7 @@ class GenerateEngine:
                 slot.length += 1
                 slot.tokens.append(tok)
                 slot.last_token = tok
+                self.pool.note_length(s, slot.length)
                 if (req.eos_token is not None and tok == req.eos_token) \
                         or len(slot.tokens) >= req.max_new_tokens:
                     finished.append((s, req, slot.tokens, slot.t_seat))
@@ -889,6 +1268,147 @@ class GenerateEngine:
             self._complete(req, toks)
         return True
 
+    def _spec_once(self):
+        """One speculative tick: the draft proposes ``k`` tokens per
+        live lane (one scan executable), the target verifies all k+1
+        positions in one chunked call, and the host ledger settles each
+        lane to its accepted prefix:
+
+        * partial accept (``a < k``): emit ``d_1..d_a`` plus the
+          residual resample — ``a + 1`` tokens;
+        * full accept: emit exactly ``d_1..d_k`` and keep ``d_k`` as
+          the next tick's input. **No bonus token** — emitting the
+          target's k+1-th sample would leave the draft arena one
+          entry behind the target's, and the catch-up write is a
+          variable-shape call. Skipping it keeps both arenas in
+          per-slot lockstep forever, for one token of upside.
+
+        Both executables write optimistically; ``note_length`` then
+        ``rollback`` trims each pool's ledger to the kept prefix
+        (pure host bookkeeping — no device copies)."""
+        import jax.numpy as jnp
+        k = self.spec_k
+        batch = self._gather_batch(extra=k + 1)
+        if batch is None:
+            return False
+        assigned, tokens, lengths, active, samp, max_needed = batch
+        # a lane within k of its admission-checked budget still verifies
+        # a full k+1 chunk — the writes past max_len are dropped by the
+        # scatter (OOB update semantics) and the ledger clamps below, so
+        # the chunk shape (and the executable) never varies
+        self._ensure_capacity(min(max_needed, self.pool.max_len))
+        cap = self.pool.capacity
+        try:
+            if _faults.enabled():
+                _faults.maybe_serving_fault(self.replica_id)
+            t0 = time.monotonic()
+            samp_dev = tuple(jnp.asarray(a) for a in samp)
+            tok_dev = jnp.asarray(tokens)
+            len_dev = jnp.asarray(lengths)
+            act_dev = jnp.asarray(active)
+            ds, qs, dbufs = self._get_spec_draft(cap)(
+                self._draft_state, self.draft_pool.buffers,
+                tok_dev, len_dev, act_dev, *samp_dev)
+            # settle the draft arena BEFORE verify can raise: the scan
+            # donated (consumed) the old buffers, so the pool must point
+            # at the new ones even if this tick's wave fails
+            self.draft_pool.buffers = dbufs
+            chunk = jnp.concatenate([tok_dev[:, None], ds], axis=1)
+            a, resampled, new_bufs = self._get_verify(cap)(
+                self.model.state, self.pool.buffers, chunk, len_dev,
+                act_dev, *samp_dev, ds, qs)
+            a = np.asarray(a)
+            resampled = np.asarray(resampled)
+            ds_host = np.asarray(ds)
+            step_ms = (time.monotonic() - t0) * 1e3
+        except BaseException as e:   # noqa: BLE001 - fail the wave
+            self._note_outcome(False, e)
+            self._fail_active(assigned, e)
+            return True
+        self._note_outcome(True)
+        self.pool.buffers = new_bufs
+        finished = []
+        emitted_total = 0
+        accepted_total = 0
+        with self._lock:
+            n_active = 0
+            for s, req in assigned:
+                slot = self._slots[s]
+                if slot.req is not req:
+                    continue        # disowned / failed over mid-step
+                n_active += 1
+                L = int(lengths[s])
+                ai = int(a[s])
+                if ai >= k:
+                    new_toks = [int(t) for t in ds_host[s]]
+                else:
+                    new_toks = [int(t) for t in ds_host[s, :ai]]
+                    new_toks.append(int(resampled[s]))
+                # EOS / budget truncate: everything past the stop token
+                # is unemitted, so the live g-indexing never skews
+                emitted = []
+                done = False
+                for t in new_toks:
+                    emitted.append(t)
+                    if (req.eos_token is not None
+                            and t == req.eos_token) \
+                            or len(slot.tokens) + len(emitted) \
+                            >= req.max_new_tokens:
+                        done = True
+                        break
+                e = len(emitted)
+                # ledger settle: verify wrote target entries for chunk
+                # tokens [last, d_1..d_k] at L..L+k; the draft scan
+                # wrote [last, d_1..d_k-1] at L..L+k-1. Keep exactly
+                # the new last_token's predecessors: L + e entries.
+                # Clamp to capacity: a lane within k of max_len still
+                # speculates a full chunk, and the tail writes past the
+                # arena were dropped on-device (truncated here anyway).
+                self.pool.note_length(s, min(L + k + 1, cap))
+                self.pool.rollback(s, L + e)
+                self.draft_pool.note_length(s, min(L + k, cap))
+                if e < k:
+                    self.draft_pool.rollback(s, L + e)
+                slot.tokens.extend(emitted)
+                slot.length = L + e
+                slot.last_token = emitted[-1]
+                if req.trace is not None:
+                    req.trace.note_spec(k, ai)
+                emitted_total += e
+                accepted_total += ai
+                if done:
+                    finished.append((s, req, slot.tokens, slot.t_seat))
+                    slot.req = None
+                    slot.tokens = None
+                    self.pool.free(s)
+                    self.draft_pool.note_length(s, 0)
+            occupancy = n_active / self.slots
+        with self._stats_lock:
+            self._stats["ticks"] += 1
+            self._stats["tokens"] += emitted_total
+            self._stats["draft_steps"] += k
+            self._stats["verify_steps"] += 1
+            self._stats["spec_proposed"] += k * n_active
+            self._stats["spec_accepted"] += accepted_total
+            self._occupancy_sum += occupancy
+        metrics.record_decode_tick(n_active, self.slots, emitted_total,
+                                   step_ms)
+        metrics.record_spec_tick(k * n_active, accepted_total,
+                                 emitted_total, k)
+        trc = _monitor.trace
+        if trc.enabled() and finished:
+            now_pc = time.perf_counter()
+            for s, req, toks, t_seat in finished:
+                rid = (req.trace.ctx.rid if req.trace is not None
+                       else None)
+                trc.lane_complete(f"{self._lane}.slot{s}",
+                                  f"req {rid}" if rid else "req",
+                                  t_seat, now_pc,
+                                  rid=rid, tokens=len(toks))
+        for _s, req, toks, _t in finished:
+            self._complete(req, toks)
+        return True
+
     def _fail_active(self, assigned, exc):
         with self._lock:
             failed = []
@@ -900,6 +1420,8 @@ class GenerateEngine:
                 slot.req = None
                 slot.tokens = None
                 self.pool.free(s)
+                if self.draft_pool is not None:
+                    self.draft_pool.note_length(s, 0)
         with self._stats_lock:
             self._stats["failed"] += len(failed)
         trc = _monitor.trace
@@ -977,13 +1499,15 @@ class MultiDecodeEngine(MultiDeviceEngine):
                               **self._engine_kwargs)
 
     def submit(self, prompt, max_new_tokens=32, eos_token=None,
-               deadline_ms=None, priority=None, trace=None):
+               deadline_ms=None, priority=None, trace=None,
+               sampling=None, seed=None):
         rep = self._pick_replica()
         req = rep.engine.make_request(prompt,
                                       max_new_tokens=max_new_tokens,
                                       eos_token=eos_token,
                                       deadline_ms=deadline_ms,
-                                      priority=priority, trace=trace)
+                                      priority=priority, trace=trace,
+                                      sampling=sampling, seed=seed)
         fut = rep.engine.submit_request(req)
         with self._hedge_lock:
             self._submitted += 1
@@ -993,16 +1517,20 @@ class MultiDecodeEngine(MultiDeviceEngine):
         return fut
 
     def run(self, prompt, max_new_tokens=32, eos_token=None,
-            deadline_ms=None, timeout=None, priority=None):
+            deadline_ms=None, timeout=None, priority=None,
+            sampling=None, seed=None):
         return self.submit(prompt, max_new_tokens=max_new_tokens,
                            eos_token=eos_token,
                            deadline_ms=deadline_ms,
-                           priority=priority).result(timeout)
+                           priority=priority, sampling=sampling,
+                           seed=seed).result(timeout)
 
     def _maybe_hedge(self, req, primary_index):
-        """Decode hedge: re-prefill the same prompt on a second replica
-        (greedy decode is deterministic, so both produce the same
-        tokens; first resolution wins)."""
+        """Decode hedge: re-prefill the same prompt on a second replica.
+        The shadow carries the primary's resolved ``sampling`` (seed
+        included), so greedy or sampled, both replicas derive the same
+        counter keys and produce the same tokens; first resolution
+        wins."""
         if req.future.done():
             return
         with self._hedge_lock:
@@ -1020,6 +1548,7 @@ class MultiDecodeEngine(MultiDeviceEngine):
                                eos_token=req.eos_token,
                                deadline=req.deadline,
                                priority=req.priority,
+                               sampling=req.sampling,
                                # the shadow rides the SAME context as a
                                # hedge attempt: whichever resolution wins
                                # the shared done-latch emits the record
@@ -1193,9 +1722,94 @@ class DemoLM:
         logits = self._norm(x) @ state["embed"].T
         return logits, entry
 
+    def verify_fn(self, state, tokens, kv, lengths):
+        """Chunked decode — ``decode_fn`` generalized to a ``(S, C)``
+        chunk for speculative verify. Chunk position ``i`` sits at
+        arena position ``lengths + i``: it attends over the resident
+        history (masked by live length) plus chunk positions ``<= i``,
+        and all C cache entries come back for the engine's optimistic
+        write. The C == 1 case computes exactly what ``decode_fn``
+        does (masked scores are exact zeros after softmax, so the
+        extra padded lanes never perturb the sums) — that identity is
+        the greedy-parity gate in scripts/spec_smoke.py."""
+        import jax.numpy as jnp
+        s, c = tokens.shape
+        h, hd = self.heads, self.head_dim
+        cap = next(iter(kv.values())).shape[1]
+        inv = 1.0 / np.sqrt(hd)
+        positions = lengths[:, None] + jnp.arange(c)[None, :]
+        x = state["embed"][tokens] + state["pos"][positions]
+        entry = {}
+        hist_mask = (jnp.arange(cap)[None, None, None, :]
+                     < lengths[:, None, None, None])      # [S,1,1,cap]
+        self_mask = (jnp.arange(c)[None, :]
+                     <= jnp.arange(c)[:, None])[None, :, None, :]
+        for layer in range(self.layers):
+            hidden = self._norm(x)
+            q = (hidden @ state[f"wq{layer}"]).reshape(s, c, h, hd)
+            k_new = (hidden @ state[f"wk{layer}"]).reshape(s, c, h, hd)
+            v_new = (hidden @ state[f"wv{layer}"]).reshape(s, c, h, hd)
+            entry[f"k{layer}"] = k_new
+            entry[f"v{layer}"] = v_new
+            scores_h = jnp.einsum("schd,sChd->schC", q,
+                                  kv[f"k{layer}"]) * inv
+            scores_h = jnp.where(hist_mask, scores_h, -1e9)
+            scores_c = jnp.einsum("schd,sChd->schC", q, k_new) * inv
+            scores_c = jnp.where(self_mask, scores_c, -1e9)
+            scores = jnp.concatenate([scores_h, scores_c], axis=-1)
+            probs = jnp.exp(scores - jnp.max(scores, axis=-1,
+                                             keepdims=True))
+            probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+            out = jnp.einsum("schC,sChd->schd", probs[..., :cap],
+                             kv[f"v{layer}"]) \
+                + jnp.einsum("schC,sChd->schd", probs[..., cap:], v_new)
+            x = x + out.reshape(s, c, self.dim) @ state[f"wo{layer}"]
+            hidden = self._norm(x)
+            x = x + jnp.maximum(
+                hidden @ state[f"w1{layer}"], 0.0) @ state[f"w2{layer}"]
+        logits = self._norm(x) @ state["embed"].T
+        return logits, entry
+
 
 def demo_model(vocab=64, dim=32, heads=2, layers=2, max_len=512, seed=0):
     """The reference decode model for docs, tests, the loadgen, and the
     smoke/bench stages."""
     return DemoLM(vocab=vocab, dim=dim, heads=heads, layers=layers,
                   max_len=max_len, seed=seed)
+
+
+def demo_spec_pair(vocab=64, dim=32, heads=2, draft_layers=1,
+                   extra_layers=1, max_len=512, seed=0, distill=0.15):
+    """A (target, draft) :class:`DemoLM` pair built for a high accept
+    rate — the shape a distilled draft gives you in production:
+
+    * the target is a ``draft_layers + extra_layers`` model whose
+      *refinement* layers' weights are scaled by ``distill`` — each
+      extra layer's residual contribution lands at roughly
+      ``distill**2`` (q·k and w1·w2 both carry two damped factors), so
+      the target's distribution is a small perturbation of its prefix;
+    * the draft IS that prefix: it shares the embedding / position /
+      first-``draft_layers`` weight **arrays** with the target (a
+      rebuild from the same seed would re-split the PRNG differently),
+      so the pair costs one model's memory plus the extra layers.
+
+    Smaller ``distill`` → higher accept rate → more emitted tokens per
+    verify step; the loadgen A/B and scripts/spec_smoke.py use this
+    pair to demonstrate the speculative speedup honestly (same target
+    math on both sides of the A/B)."""
+    import copy
+    target = DemoLM(vocab=vocab, dim=dim, heads=heads,
+                    layers=draft_layers + extra_layers,
+                    max_len=max_len, seed=seed)
+    eps = float(distill)
+    state = dict(target.state)
+    for layer in range(draft_layers, target.layers):
+        for w in ("wq", "wk", "wv", "wo", "w1", "w2"):
+            state[f"{w}{layer}"] = state[f"{w}{layer}"] * eps
+    target.state = state
+    draft = copy.copy(target)
+    draft.layers = int(draft_layers)
+    draft.state = {k: v for k, v in state.items()
+                   if k in ("embed", "pos")
+                   or int(k[2:]) < draft.layers}
+    return target, draft

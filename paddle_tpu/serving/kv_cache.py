@@ -54,7 +54,12 @@ def _leaves(spec):
 
 def bytes_per_token(spec):
     """Exact per-token KV footprint of one sequence: the sum over spec
-    leaves of ``prod(tail) * dtype.itemsize``."""
+    leaves of ``prod(tail) * dtype.itemsize``. Accepts a single kv
+    spec or a list of specs (a speculative deployment prices the
+    target arena *and* the draft arena as one number — both pools
+    share the slot count and page schedule, so their footprints add)."""
+    if isinstance(spec, (list, tuple)):
+        return sum(bytes_per_token(s) for s in spec)
     return sum(int(np.prod(tail, dtype=np.int64)) * dt.itemsize
                for _, tail, dt in _leaves(spec))
 
@@ -74,12 +79,15 @@ class KVCachePool:
         hard ceiling on prompt + generated tokens per sequence.
     """
 
-    def __init__(self, spec, slots, page=128, factor=2.0, max_len=1024):
+    def __init__(self, spec, slots, page=128, factor=2.0, max_len=1024,
+                 label=None):
         import jax.numpy as jnp
         self.spec = dict(spec)
         self.slots = int(slots)
         if self.slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
+        self.label = label          # metrics namespace ("draft" for the
+        #                             speculative draft arena)
         self.seq_buckets = grow_buckets(page, factor, max_len)
         self.max_len = int(self.seq_buckets[-1])
         self.capacity = int(self.seq_buckets[0])
@@ -89,7 +97,12 @@ class KVCachePool:
             for name, tail, dt in self._leaf_list}
         self._lock = threading.Lock()
         self._free = list(range(self.slots))[::-1]   # pop() -> slot 0 first
+        # per-slot live length: how many leading arena positions hold
+        # *accepted* history. Readers mask by it; rollback() shrinks it.
+        self._lengths = [0] * self.slots
         self._grows = 0
+        self._rollbacks = 0
+        self._rollback_tokens = 0
         self._publish()
 
     # -- slot bookkeeping --------------------------------------------------
@@ -97,7 +110,11 @@ class KVCachePool:
     def alloc(self):
         """Claim a free slot index, or None when the batch is full."""
         with self._lock:
-            return self._free.pop() if self._free else None
+            if not self._free:
+                return None
+            s = self._free.pop()
+            self._lengths[s] = 0
+            return s
 
     def free(self, slot):
         """Return a slot to the pool. The stale K/V rows are left in
@@ -108,6 +125,50 @@ class KVCachePool:
             if slot in self._free:
                 raise ValueError(f"slot {slot} double-freed")
             self._free.append(int(slot))
+            self._lengths[int(slot)] = 0
+
+    def length(self, slot):
+        """Live (accepted) length of one slot's history."""
+        with self._lock:
+            return self._lengths[int(slot)]
+
+    def note_length(self, slot, new_len):
+        """Record that arena positions ``[0, new_len)`` of ``slot`` now
+        hold written history (prefill insert, decode write, or a
+        speculative verify that wrote k+1 positions ahead of
+        acceptance)."""
+        new_len = int(new_len)
+        if new_len < 0 or new_len > self.capacity:
+            raise ValueError(
+                f"length {new_len} outside [0, capacity={self.capacity}]")
+        with self._lock:
+            self._lengths[int(slot)] = new_len
+
+    def rollback(self, slot, new_len):
+        """Truncate one slot's live length to ``new_len`` WITHOUT
+        freeing pages — the speculative verify-reject path: the target
+        wrote k+1 positions optimistically, acceptance kept a prefix,
+        and the positions past it become dead. No device data moves
+        (every reader masks by length, and the next write overwrites
+        in place); this is pure ledger truncation, the primitive
+        prefix-cache reuse (ROADMAP item 3) will also need. Growing a
+        length is note_length's job — rollback refuses it."""
+        new_len = int(new_len)
+        with self._lock:
+            cur = self._lengths[int(slot)]
+            if new_len > cur:
+                raise ValueError(
+                    f"rollback to {new_len} would GROW slot {slot} "
+                    f"(live length {cur}) — use note_length for writes")
+            if new_len < 0:
+                raise ValueError(f"rollback length {new_len} < 0")
+            dropped = cur - new_len
+            self._lengths[int(slot)] = new_len
+            self._rollbacks += 1
+            self._rollback_tokens += dropped
+        if dropped:
+            metrics.record_rollback(dropped, label=self.label)
+        return dropped
 
     def free_slots(self):
         with self._lock:
@@ -189,7 +250,8 @@ class KVCachePool:
     def _publish(self):
         headroom, limit = self.headroom()
         metrics.record_cache(self.bytes(), self.capacity,
-                             headroom_bytes=headroom, limit_bytes=limit)
+                             headroom_bytes=headroom, limit_bytes=limit,
+                             label=self.label)
 
     def stats(self):
         return {
@@ -201,6 +263,8 @@ class KVCachePool:
             "cache_bytes": self.bytes(),
             "cache_max_bytes": self.max_bytes(),
             "grows": self._grows,
+            "rollbacks": self._rollbacks,
+            "rollback_tokens": self._rollback_tokens,
         }
 
 
@@ -210,7 +274,8 @@ def fits_budget(spec, slots, max_len, limit_bytes=None,
     device budget with ``reserve_frac`` held back for weights and
     activations? Returns (fits: bool | None, needed_bytes, limit).
     None means no budget is known — same contract as the planner's
-    feasibility column."""
+    feasibility column. Pass ``spec`` as a list of kv specs to price a
+    speculative deployment (target + draft arenas) as one pre-flight."""
     needed = int(slots) * int(max_len) * bytes_per_token(spec)
     if limit_bytes is None:
         try:
@@ -228,7 +293,8 @@ def plan_slots(spec, max_len, limit_bytes=None, reserve_frac=0.5,
                max_slots=256):
     """Inverse budget: the largest slot count whose worst-case pool
     fits in ``(1 - reserve_frac)`` of the budget. None when no budget
-    is known."""
+    is known. A list ``spec`` prices target + draft arenas together,
+    so the planned slot count already pays for speculation."""
     if limit_bytes is None:
         try:
             from ..monitor.memory import device_hbm_limit

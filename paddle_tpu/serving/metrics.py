@@ -90,6 +90,28 @@ Generative-decode series (the continuous-batching engine):
 * ``slo.tokens_per_s`` / ``slo.decode_p99_ms`` — rolling decode SLO
   window (:data:`TOKENS_WINDOW_S`) the supervisor scales replicas off
 
+Speculative-decode series (draft-model verify loop; every token series
+above counts **accepted** tokens only — rejected draft proposals never
+inflate ``serving.decode.tokens`` or ``slo.tokens_per_s``):
+
+* ``serving.decode.draft_steps`` — draft-model autoregressive steps
+  (k per speculative tick)
+* ``serving.decode.verify_steps`` — batched target verify steps (one
+  per speculative tick)
+* ``serving.decode.spec_proposed`` / ``serving.decode.spec_accepted``
+  — draft proposals offered vs accepted by the accept-prefix rule
+* ``serving.decode.accept_rate`` — gauge: accepted ÷ proposed over the
+  rolling :data:`TOKENS_WINDOW_S` window (the health signal for a
+  draft/target pairing — a cold draft shows up here first)
+* ``serving.decode.spec_tokens_per_step`` — gauge: accepted tokens
+  (resample included) per verify step over the window; the speculative
+  multiplier actually realized, upper-bounded by ``spec_k``
+* ``serving.decode.rollbacks`` / ``serving.decode.rollback_tokens`` —
+  KV-ledger truncations after verify rejects (optimistically written
+  positions beyond the accepted prefix), target and draft arenas
+  combined; the draft arena's footprint publishes under
+  ``serving.decode.draft_cache_bytes`` / ``..draft_cache_capacity``
+
 Span sites (``monitor.trace``): ``serving.enqueue``,
 ``serving.batch_assemble``, ``serving.execute``, ``serving.scatter``,
 ``serving.warmup`` — the Perfetto view of queue→batch→MXU.
@@ -318,6 +340,7 @@ def reset_windows():
         _tokens_window.clear()
         _decode_steps.clear()
         _prefill_steps.clear()
+        _spec_window.clear()
 
 
 def record_compiles(n=1):
@@ -447,6 +470,7 @@ _decode_lock = threading.Lock()
 _tokens_window = collections.deque()   # (t_monotonic, n_tokens)
 _decode_steps = collections.deque()    # (t, step_ms)
 _prefill_steps = collections.deque()   # (t, prefill_ms)
+_spec_window = collections.deque()     # (t, proposed, accepted, emitted)
 
 
 def record_decode_tick(active_slots, total_slots, n_tokens, step_ms):
@@ -499,16 +523,20 @@ def record_decode_compile(n=1, what=""):
 
 
 def record_cache(cache_bytes, capacity, headroom_bytes=None,
-                 limit_bytes=None):
+                 limit_bytes=None, label=None):
+    """KV-arena footprint gauges; ``label`` namespaces a secondary
+    arena (the speculative draft pool publishes under
+    ``serving.decode.draft_cache_*``)."""
     if not _monitor.enabled():
         return
-    _monitor.gauge("serving.decode.cache_bytes").set(int(cache_bytes))
-    _monitor.gauge("serving.decode.cache_capacity").set(int(capacity))
+    prefix = f"serving.decode.{label}_cache" if label \
+        else "serving.decode.cache"
+    _monitor.gauge(f"{prefix}_bytes").set(int(cache_bytes))
+    _monitor.gauge(f"{prefix}_capacity").set(int(capacity))
     if headroom_bytes is not None:
-        _monitor.gauge("serving.decode.cache_headroom").set(
-            int(headroom_bytes))
+        _monitor.gauge(f"{prefix}_headroom").set(int(headroom_bytes))
     if limit_bytes is not None:
-        _monitor.gauge("serving.decode.cache_limit").set(int(limit_bytes))
+        _monitor.gauge(f"{prefix}_limit").set(int(limit_bytes))
 
 
 def record_cache_grow(new_capacity):
@@ -516,6 +544,61 @@ def record_cache_grow(new_capacity):
         _monitor.counter("serving.decode.cache_grows").inc()
         _monitor.emit(kind="serving", event="cache_grow",
                       capacity=int(new_capacity))
+
+
+def record_rollback(n_tokens, label=None):
+    """A KV-ledger truncation: ``n_tokens`` optimistically-written
+    positions past the accepted prefix went dead (speculative verify
+    reject)."""
+    if _monitor.enabled():
+        _monitor.counter("serving.decode.rollbacks").inc()
+        _monitor.counter("serving.decode.rollback_tokens").inc(
+            int(n_tokens))
+
+
+def record_spec_tick(proposed, accepted, emitted, draft_steps):
+    """One speculative tick across the batch: the draft offered
+    ``proposed`` tokens (``draft_steps`` autoregressive draft calls),
+    the accept-prefix rule kept ``accepted`` of them, and ``emitted``
+    tokens actually landed (accepted prefix + the residual resample;
+    these are the ONLY tokens that count toward tokens/s). Fills the
+    rolling accept-rate window whether or not the monitor is enabled —
+    it's a control signal, like :func:`tokens_window`."""
+    now = time.monotonic()
+    with _decode_lock:
+        _spec_window.append((now, int(proposed), int(accepted),
+                             int(emitted)))
+        _sweep(_spec_window, now, TOKENS_WINDOW_S)
+    if not _monitor.enabled():
+        return
+    _monitor.counter("serving.decode.draft_steps").inc(int(draft_steps))
+    _monitor.counter("serving.decode.verify_steps").inc()
+    _monitor.counter("serving.decode.spec_proposed").inc(int(proposed))
+    _monitor.counter("serving.decode.spec_accepted").inc(int(accepted))
+    rate, per_step = spec_window(now)
+    if rate is not None:
+        _monitor.gauge("serving.decode.accept_rate").set(round(rate, 4))
+    if per_step is not None:
+        _monitor.gauge("serving.decode.spec_tokens_per_step").set(
+            round(per_step, 3))
+
+
+def spec_window(now=None):
+    """Control-loop read of the speculative window: (accept_rate |
+    None, emitted tokens per verify step | None) over the last
+    :data:`TOKENS_WINDOW_S` seconds. None means no speculative traffic
+    in the window."""
+    now = time.monotonic() if now is None else now
+    with _decode_lock:
+        _sweep(_spec_window, now, TOKENS_WINDOW_S)
+        if not _spec_window:
+            return None, None
+        proposed = sum(p for _, p, _a, _e in _spec_window)
+        accepted = sum(a for _, _p, a, _e in _spec_window)
+        emitted = sum(e for _, _p, _a, e in _spec_window)
+        steps = len(_spec_window)
+    rate = (accepted / proposed) if proposed else None
+    return rate, emitted / steps
 
 
 def tokens_window(now=None):
@@ -550,9 +633,12 @@ def decode_rollup(now=None):
         decode_ms = sum(ms for _, ms in _decode_steps)
     busy = prefill_ms + decode_ms
     ratio = (prefill_ms / busy) if busy > 0 else None
+    accept_rate, spec_per_step = spec_window(now)
     out = {"tokens_per_s": tps, "decode_p99_ms": p99,
            "prefill_p50_ms": _percentile(pf, 0.50),
-           "prefill_ratio": ratio}
+           "prefill_ratio": ratio,
+           "accept_rate": accept_rate,
+           "spec_tokens_per_step": spec_per_step}
     if _monitor.enabled():
         if tps is not None:
             _monitor.gauge("slo.tokens_per_s").set(round(tps, 3))

@@ -180,7 +180,8 @@ class Attempt:
     construction. ``req.trace`` holds the Attempt (None = disabled)."""
 
     __slots__ = ("ctx", "origin", "replica", "t_start", "stage", "t_mark",
-                 "stages", "t_first", "n_tokens")
+                 "stages", "t_first", "n_tokens", "spec_proposed",
+                 "spec_accepted")
 
     def __init__(self, ctx, origin, replica):
         now = _MONO()
@@ -193,6 +194,8 @@ class Attempt:
         self.stages = {}
         self.t_first = None
         self.n_tokens = None
+        self.spec_proposed = 0
+        self.spec_accepted = 0
 
     # -- stage machine ------------------------------------------------------
 
@@ -221,7 +224,17 @@ class Attempt:
         self.t_first = now
 
     def note_tokens(self, n):
+        """``tokens`` (and the ``tpot_ms`` derived from it) count
+        *accepted* tokens — the ones the caller actually receives. A
+        speculative engine's rejected draft proposals never land here;
+        they show in the ``accept_rate`` field instead."""
         self.n_tokens = int(n)
+
+    def note_spec(self, proposed, accepted):
+        """Per-tick speculative tally for this sequence: draft tokens
+        offered vs accepted by the verify step."""
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
 
     def shed(self, level=None, retry_after_ms=None):
         self.ctx.note_shed(level, retry_after_ms)
@@ -291,6 +304,11 @@ class Attempt:
         }
         for stage, secs in self.stages.items():
             rec[f"{stage}_ms"] = round(secs * 1e3, 3)
+        if self.spec_proposed:
+            rec["spec_proposed"] = self.spec_proposed
+            rec["spec_accepted"] = self.spec_accepted
+            rec["accept_rate"] = round(
+                self.spec_accepted / self.spec_proposed, 4)
         if error is not None:
             rec["error"] = error
         ctx.record_ = rec

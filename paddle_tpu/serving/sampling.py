@@ -1,0 +1,314 @@
+"""paddle_tpu.serving.sampling — vectorized slot-level token sampling
+and the speculative accept-prefix rule.
+
+Two design constraints drive everything here, both inherited from the
+decode engine's shape discipline (serving/generate.py):
+
+* **Batch-shaped knobs, zero new executables.** Temperature / top-k /
+  top-p / seed are *data*, not trace constants: they enter the fused
+  decode step as ``[slots]``-shaped arrays, so a batch mixing greedy
+  and sampled sequences at different temperatures runs the SAME
+  executable that pure-greedy traffic does. ``temperature <= 0`` means
+  greedy (argmax) for that row; ``top_k <= 0`` and ``top_p == 1.0``
+  disable their filters. Nothing about a request's sampling config can
+  mint a trace.
+
+* **Counter-based keys, not a key stream.** The key for every random
+  decision is derived statelessly as::
+
+      fold_in(PRNGKey(request_seed), position * N_SALTS + salt)
+
+  where ``position`` is the token's *generation index* (0 = the token
+  the prefill emits) and ``salt`` picks the decision kind
+  (:data:`SALT_TOKEN` for the token draw, :data:`SALT_ACCEPT` for the
+  speculative accept test, :data:`SALT_RESID` for the residual
+  resample). Because the key is a pure function of
+  ``(seed, position, salt)``, a sequence's token stream is
+  bit-reproducible no matter which tick admitted it, which replica ran
+  it, how it was batched, or whether failover re-prefilled it from
+  scratch — the property the failover/requeue and hedging paths lean
+  on now that decode is no longer greedy-only.
+
+The speculative primitives (:func:`accept_prefix`) implement the
+standard draft-verify rule: accept draft proposal ``d_i ~ q_i`` while
+``u_i * q_i(d_i) <= p_i(d_i)`` and resample the first rejected
+position from the normalized residual ``max(p - q, 0)``. Per position
+the emitted marginal is ``q(x) * min(1, p(x)/q(x)) + P(reject) *
+resid(x) = p(x)``, so the emitted stream is *distributionally exact*
+against non-speculative sampling of the target — and because the
+proposal draw at generation index ``g`` consumes exactly the
+``(seed, g, SALT_TOKEN)`` key the non-speculative path would, a
+self-draft (q == p) reproduces the non-speculative stream token for
+token. tests/test_spec_decode.py carries the chi-squared proof
+obligation; docs/serving.md states the guarantee.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# Filtered-out logits get this, not -inf: -inf arithmetic breeds NaNs
+# under XLA (0 * -inf in masked softmax backward paths) while exp(-1e30)
+# is exactly 0.0 in float32.
+NEG = -1e30
+
+# Salt per random-decision kind; the per-position counter is
+# position * N_SALTS + salt, so decision kinds never collide and
+# positions stay independent.
+SALT_TOKEN = 0       # the token draw itself (sampled decode + proposals)
+SALT_ACCEPT = 1      # speculative accept test u_i
+SALT_RESID = 2       # residual resample at the first rejected position
+N_SALTS = 4          # room to grow without re-keying history
+
+
+class SamplingParams:
+    """One request's decode-sampling config.
+
+    ``temperature <= 0`` selects greedy (argmax) decode and the other
+    knobs are ignored. ``top_k <= 0`` disables the top-k filter;
+    ``top_p`` must sit in (0, 1] and ``1.0`` disables the nucleus
+    filter. ``seed`` is the per-request PRNG root — two requests with
+    the same prompt, params, and seed produce bit-identical streams on
+    any replica; ``None`` lets the engine assign a fresh one at
+    ``make_request`` time (recorded on the request so failover and
+    hedge shadows replay identically).
+    """
+
+    __slots__ = ("temperature", "top_k", "top_p", "seed")
+
+    def __init__(self, temperature=0.0, top_k=0, top_p=1.0, seed=None):
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if not (0.0 < self.top_p <= 1.0):
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if seed is not None:
+            seed = int(seed)
+            if seed < 0:
+                raise ValueError(f"seed must be >= 0, got {seed}")
+        self.seed = seed
+
+    @property
+    def greedy(self):
+        return self.temperature <= 0.0
+
+    def __eq__(self, other):
+        return (isinstance(other, SamplingParams)
+                and self.temperature == other.temperature
+                and self.top_k == other.top_k
+                and self.top_p == other.top_p
+                and self.seed == other.seed)
+
+    def __repr__(self):
+        return (f"SamplingParams(temperature={self.temperature}, "
+                f"top_k={self.top_k}, top_p={self.top_p}, "
+                f"seed={self.seed})")
+
+
+GREEDY = SamplingParams()
+
+
+def resolve(sampling=None, seed=None):
+    """Normalize the ``sampling=`` submit knob into
+    :class:`SamplingParams`: None (greedy), a dict of knob overrides,
+    or a ready-made params object. ``seed=`` overrides the params'
+    own seed either way."""
+    if sampling is None:
+        params = SamplingParams()
+    elif isinstance(sampling, SamplingParams):
+        params = SamplingParams(sampling.temperature, sampling.top_k,
+                                sampling.top_p, sampling.seed)
+    elif isinstance(sampling, dict):
+        params = SamplingParams(**sampling)
+    else:
+        raise TypeError(
+            f"sampling must be None, a dict, or SamplingParams — "
+            f"got {type(sampling).__name__}")
+    if seed is not None:
+        params.seed = int(seed)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# counter-based keys (all jit-safe, vectorized over the slot axis)
+
+
+def keys_for(seeds, positions, salt):
+    """``[S]`` PRNG keys, one per slot: a pure function of
+    ``(seed, position, salt)`` — no stream, no state."""
+    import jax
+    import jax.numpy as jnp
+    counters = (positions.astype(jnp.uint32) * np.uint32(N_SALTS)
+                + np.uint32(salt))
+
+    def one(s, c):
+        return jax.random.fold_in(jax.random.PRNGKey(s), c)
+
+    return jax.vmap(one)(seeds.astype(jnp.uint32), counters)
+
+
+def uniform_for(seeds, positions, salt):
+    """One U(0,1) per entry; ``seeds`` and ``positions`` broadcast to a
+    common shape first (used as ``[S, k]`` by the accept rule)."""
+    import jax
+    import jax.numpy as jnp
+    seeds = jnp.asarray(seeds)
+    positions = jnp.asarray(positions)
+    shape = jnp.broadcast_shapes(seeds.shape, positions.shape)
+    s_flat = jnp.broadcast_to(seeds, shape).reshape(-1)
+    p_flat = jnp.broadcast_to(positions, shape).reshape(-1)
+    keys = keys_for(s_flat, p_flat, salt)
+    u = jax.vmap(lambda k: jax.random.uniform(k, ()))(keys)
+    return u.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# the filter pipeline
+
+
+def filter_logits(logits, temperature, top_k, top_p):
+    """Apply temperature / top-k / top-p per row of ``logits [S, V]``;
+    all three knobs are ``[S]`` arrays. Returns filtered logits where
+    excluded tokens sit at :data:`NEG`.
+
+    Row semantics:
+
+    * ``temperature <= 0`` — greedy: the row collapses to a one-hot of
+      its argmax (ties break to the lowest token id, matching
+      ``jnp.argmax``), making ``sample`` deterministic and the
+      speculative accept test exact.
+    * ``top_k <= 0`` — keep all ``V``; ``top_k == 1`` is greedy by
+      construction. Ties *at the k boundary* resolve by sort order
+      (value-descending, then lowest token id), so the kept set is
+      deterministic.
+    * ``top_p == 1.0`` — nucleus filter off (plain temperature). The
+      nucleus is the shortest sorted prefix with cumulative mass
+      ``>= top_p``; the top-1 token always survives.
+
+    The sort-based filter body runs under a batch-wide ``lax.cond``:
+    when NO row asks for top-k or top-p (greedy and plain-temperature
+    traffic — the overwhelmingly common batch), the full-vocab sort,
+    cumsum, and scatter are skipped at runtime while the executable
+    stays one and the same. This is what keeps the speculative draft
+    scan (which re-filters every proposal) from paying ``k`` sorts per
+    tick for knobs nobody set.
+    """
+    import jax
+    import jax.numpy as jnp
+    s, v = logits.shape
+    temperature = temperature.astype(jnp.float32)
+    greedy = temperature <= 0.0
+    t = jnp.where(greedy, 1.0, temperature)
+    z = (logits / t[:, None]).astype(jnp.float32)
+
+    def _apply_filters(zz):
+        # one descending sort drives both filters; lax.top_k breaks
+        # ties by lowest index, which is what makes the "ties"
+        # semantics stable
+        svals, sidx = jax.lax.top_k(zz, v)
+        k_eff = jnp.where(top_k <= 0, v, jnp.clip(top_k, 1, v))
+        in_k = jnp.arange(v)[None, :] < k_eff[:, None]
+        kz = jnp.where(in_k, svals, NEG)
+
+        # nucleus in sorted space: keep ranks whose *exclusive*
+        # cumulative mass is < p — rank 0 has exclusive mass 0, so the
+        # top token always survives even at tiny p
+        probs = jax.nn.softmax(kz, axis=-1)
+        cum_excl = jnp.cumsum(probs, axis=-1) - probs
+        keep = in_k & (cum_excl < top_p[:, None])
+        filt_sorted = jnp.where(keep, kz, NEG)
+
+        rows = jnp.arange(s)[:, None]
+        return jnp.full((s, v), NEG, jnp.float32).at[rows, sidx].set(
+            filt_sorted)
+
+    filtering = jnp.any(((top_k > 0) & (top_k < v)) | (top_p < 1.0))
+    filt = jax.lax.cond(filtering, _apply_filters, lambda zz: zz, z)
+
+    am = jnp.argmax(z, axis=-1)
+    onehot = jnp.arange(v)[None, :] == am[:, None]
+    greedy_filt = jnp.where(onehot, 0.0, NEG)
+    return jnp.where(greedy[:, None], greedy_filt, filt)
+
+
+def probs_from_filtered(filtered):
+    """Normalized distribution over the surviving tokens (greedy rows
+    come out one-hot)."""
+    import jax
+    return jax.nn.softmax(filtered, axis=-1)
+
+
+def sample_from_filtered(filtered, seeds, positions, salt=SALT_TOKEN):
+    """Gumbel-max draw per row of ``filtered [S, V]`` under the
+    counter key ``(seed, position, salt)``. A greedy (one-hot) row
+    returns its argmax regardless of the noise — greedy requests
+    consume no effective randomness."""
+    import jax
+    import jax.numpy as jnp
+    v = filtered.shape[-1]
+    keys = keys_for(jnp.asarray(seeds), jnp.asarray(positions), salt)
+    g = jax.vmap(
+        lambda k: jax.random.gumbel(k, (v,), jnp.float32))(keys)
+    return jnp.argmax(filtered + g, axis=-1).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# the speculative accept-prefix rule
+
+
+def accept_prefix(p_probs, q_probs, proposals, seeds, pos0):
+    """The draft-verify accept rule, vectorized over slots.
+
+    Parameters
+    ----------
+    p_probs : ``[S, k+1, V]`` — the *target* model's filtered
+        distributions at generation indices ``pos0 .. pos0+k`` (the
+        verify step evaluates one position past the last proposal; that
+        trailing distribution is unused here but rides along so the
+        verify executable stays single-output-shape).
+    q_probs : ``[S, k, V]`` — the *draft* distributions the proposals
+        were drawn from.
+    proposals : ``[S, k]`` int32 — draft tokens ``d_1 .. d_k``;
+        proposal ``i`` was drawn with the ``(seed, pos0+i,
+        SALT_TOKEN)`` key.
+    seeds / pos0 : ``[S]`` — per-slot seed and the generation index of
+        the first proposal.
+
+    Returns
+    -------
+    ``(n_accepted [S], resampled [S])`` — the accepted-prefix length
+    ``a`` in ``0..k``, and the residual-resampled token for generation
+    index ``pos0 + a``. When ``a == k`` (full accept) the resampled
+    token is a don't-care: the engine emits the k proposals and keeps
+    ``d_k`` as the next decode input — no bonus token is drawn, which
+    is what keeps the draft and target arenas in lockstep.
+
+    Accept proposal ``i`` iff ``u_i * q_i(d_i) <= p_i(d_i)`` with
+    ``u_i`` from the ``(seed, pos0+i, SALT_ACCEPT)`` key; the first
+    reject resamples from ``normalize(max(p - q, 0))`` under
+    ``SALT_RESID`` (falling back to ``p`` itself if the residual
+    underflows to zero mass, e.g. q == p in float32).
+    """
+    import jax
+    import jax.numpy as jnp
+    s, k, v = q_probs.shape
+    rows = jnp.arange(s)
+    cols = jnp.arange(k)[None, :]
+    pos = pos0[:, None] + cols                           # [S, k]
+
+    u = uniform_for(seeds[:, None], pos, SALT_ACCEPT)    # [S, k]
+    p_at = p_probs[rows[:, None], cols, proposals]       # p_i(d_i)
+    q_at = q_probs[rows[:, None], cols, proposals]       # q_i(d_i)
+    ok = u * q_at <= p_at
+    # accepted-prefix length: leading run of True
+    a = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+
+    j = jnp.minimum(a, k - 1)                            # reject index
+    pj = p_probs[rows, j]                                # [S, V]
+    qj = q_probs[rows, j]
+    resid = jnp.maximum(pj - qj, 0.0)
+    mass = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(mass > 0.0, resid / mass, pj)
+    resid_logits = jnp.where(resid > 0.0, jnp.log(resid), NEG)
+    resampled = sample_from_filtered(resid_logits, seeds, pos0 + j,
+                                     salt=SALT_RESID)
+    return a, resampled

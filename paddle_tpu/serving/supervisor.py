@@ -180,6 +180,15 @@ class ServingSupervisor:
         slo_ctx = {k: round(rollup[k], 3)
                    for k in ("ttft_p99_ms", "tpot_p99_ms")
                    if rollup.get(k) is not None}
+        # speculative context: a tokens/s sag with a healthy accept
+        # rate is slot starvation (scale up helps); a sag WITH a
+        # collapsed accept rate is a draft/target mismatch (scale up
+        # won't) — the verdict carries both so /snapshot can tell them
+        # apart
+        if decode:
+            for k in ("accept_rate", "spec_tokens_per_step"):
+                if decode.get(k) is not None:
+                    slo_ctx[k] = round(decode[k], 3)
         if goodput is not None and submitted >= 20 \
                 and goodput < self.goodput_floor:
             self._idle_ticks = 0

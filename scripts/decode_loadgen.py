@@ -21,10 +21,19 @@ Prints one JSON result line::
 
     {"continuous": {...}, "drain": {...}, "speedup_x": 2.7, ...}
 
+With ``--sampling`` the same load runs sampled (temperature / top-k /
+top-p, per-request seeds ``--seed-base + i`` so any run is bit-
+reproducible); with ``--spec`` the A/B becomes speculative-vs-plain
+decode on the SAME sampled traffic and slot count — the tokens/s ratio
+is the draft-verify win, and the result line carries the measured
+accept rate.
+
 Usage::
 
     python scripts/decode_loadgen.py --requests 64 --slots 8
     python scripts/decode_loadgen.py --mode continuous --rate 200
+    python scripts/decode_loadgen.py --sampling temperature=1.0,top_k=8
+    python scripts/decode_loadgen.py --spec --spec-k 8 --draft pair
 """
 import argparse
 import json
@@ -71,13 +80,18 @@ def _pct(sorted_vals, q):
 
 
 def run_load(model, mode, workload, slots, max_len, prompt_buckets,
-             rate=None, seed=0, record_path=None):
+             rate=None, seed=0, record_path=None, sampling=None,
+             seed_base=None, draft=None, spec_k=4):
     """Drive one engine in ``mode`` over the workload; return the
     measurement dict. ``rate`` is the Poisson arrival rate in req/s
     (None = offered all at once — pure capacity measurement). With the
     monitor enabled, every request's ``serving.request`` record (ttft,
     tpot, stage waterfall, hops) is collected; ``record_path`` appends
-    them as one-JSONL-per-request artifact."""
+    them as one-JSONL-per-request artifact. ``sampling`` (a dict /
+    SamplingParams) turns every request sampled, with per-request seed
+    ``seed_base + i``; ``draft`` plugs a draft model in for the
+    speculative verify loop (the result then carries accept-rate and
+    tokens-per-verify)."""
     from paddle_tpu import serving
     from paddle_tpu.serving import metrics
 
@@ -85,17 +99,21 @@ def run_load(model, mode, workload, slots, max_len, prompt_buckets,
     eng = serving.GenerateEngine(
         model, slots=slots, page=32, factor=2.0, max_len=max_len,
         prompt_buckets=prompt_buckets, queue_depth=len(workload) + 8,
-        refill=mode, shed=False, start=True)
+        refill=mode, shed=False, start=True,
+        draft_model=draft, spec_k=spec_k)
     eng.warmup()
     n_exec, n_trace = eng.executables()
 
     rng = np.random.RandomState(seed + 1)
     reqs = []
     t0 = time.perf_counter()
-    for prompt, new in workload:
+    for i, (prompt, new) in enumerate(workload):
         if rate:
             time.sleep(float(rng.exponential(1.0 / rate)))
-        r = eng.make_request(prompt, max_new_tokens=new, eos_token=None)
+        r = eng.make_request(
+            prompt, max_new_tokens=new, eos_token=None,
+            sampling=sampling,
+            seed=(seed_base + i) if seed_base is not None else None)
         eng.submit_request(r)
         reqs.append(r)
     outs = [r.future.result(timeout=120) for r in reqs]
@@ -131,9 +149,23 @@ def run_load(model, mode, workload, slots, max_len, prompt_buckets,
             "queue_p99_ms": rnd(_pct(queues, 0.99)),
         }
 
+    spec = {}
+    if draft is not None:
+        spec = {
+            "spec_k": spec_k,
+            "verify_steps": stats["verify_steps"],
+            "accept_rate": (round(stats["spec_accepted"]
+                                  / max(stats["spec_proposed"], 1), 4)),
+            "spec_tokens_per_step": (round(stats["tokens"]
+                                           / max(stats["verify_steps"],
+                                                 1), 3)),
+            "pool_rollbacks": stats.get("pool_rollbacks", 0),
+        }
+
     tokens = int(sum(len(o) for o in outs))
     return {
         **slo,
+        **spec,
         "mode": mode,
         "requests": len(workload),
         "tokens": tokens,
@@ -167,6 +199,19 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--mode", choices=["both", "continuous", "drain"],
                     default="both")
+    ap.add_argument("--sampling", default=None,
+                    help="comma key=value SamplingParams, e.g. "
+                         "temperature=1.0,top_k=8,top_p=0.9")
+    ap.add_argument("--seed-base", type=int, default=1000,
+                    help="request i samples with seed seed-base + i")
+    ap.add_argument("--spec", action="store_true",
+                    help="A/B speculative vs plain decode instead of "
+                         "continuous vs drain (implies sampled traffic)")
+    ap.add_argument("--spec-k", type=int, default=8,
+                    help="draft tokens proposed per verify step")
+    ap.add_argument("--draft", choices=["pair", "self"], default="pair",
+                    help="pair = distilled demo draft/target pair; "
+                         "self = target drafts for itself (accept ~1)")
     ap.add_argument("--out-dir", default=None,
                     help="enable the monitor JSONL sink here")
     args = ap.parse_args()
@@ -184,26 +229,68 @@ def main():
         # the TTFT/TPOT table works without an artifact directory
         monitor.enable()
 
-    # dim 256 keeps the fused decode step expensive enough that the
-    # slot-efficiency ratio (not host overhead) dominates the A/B
-    model = serving.demo_model(vocab=64, dim=256, heads=4, layers=2,
-                               max_len=args.max_len, seed=1)
+    sampling = None
+    if args.sampling:
+        sampling = {}
+        for kv in args.sampling.split(","):
+            k, _, v = kv.partition("=")
+            sampling[k.strip()] = (int(v) if k.strip() == "top_k"
+                                   else float(v))
     prompt_buckets = (4, 16)
     workload = make_workload(args.requests, prompt_buckets,
                              args.max_len, seed=args.seed)
-
     result = {"requests": args.requests, "slots": args.slots,
-              "rate": args.rate or None}
-    modes = ["continuous", "drain"] if args.mode == "both" else [args.mode]
-    for mode in modes:
-        result[mode] = run_load(model, mode, workload, args.slots,
-                                args.max_len, prompt_buckets,
-                                rate=args.rate or None, seed=args.seed,
-                                record_path=record_path)
-    if "continuous" in result and "drain" in result:
-        result["speedup_x"] = round(
-            result["continuous"]["tokens_per_s"]
-            / max(result["drain"]["tokens_per_s"], 1e-9), 2)
+              "rate": args.rate or None, "sampling": sampling}
+
+    if args.spec:
+        # speculative A/B: same sampled traffic, same slots, draft
+        # on/off. The pair's deep target amortises each verify over
+        # spec_k drafted tokens; "self" isolates the loop's overhead
+        # at accept rate ~1.
+        sampling = sampling or {"temperature": 1.0}
+        result["sampling"] = sampling
+        if args.draft == "pair":
+            target, draft = serving.demo_spec_pair(
+                vocab=64, dim=192, heads=2, draft_layers=1,
+                extra_layers=7, max_len=args.max_len, seed=1,
+                distill=0.10)
+        else:
+            target = serving.demo_model(vocab=64, dim=192, heads=2,
+                                        layers=2, max_len=args.max_len,
+                                        seed=1)
+            draft = target
+        result["nonspec"] = run_load(
+            target, "continuous", workload, args.slots, args.max_len,
+            prompt_buckets, rate=args.rate or None, seed=args.seed,
+            record_path=record_path, sampling=sampling,
+            seed_base=args.seed_base)
+        result["spec"] = run_load(
+            target, "continuous", workload, args.slots, args.max_len,
+            prompt_buckets, rate=args.rate or None, seed=args.seed,
+            record_path=record_path, sampling=sampling,
+            seed_base=args.seed_base, draft=draft, spec_k=args.spec_k)
+        result["spec_speedup_x"] = round(
+            result["spec"]["tokens_per_s"]
+            / max(result["nonspec"]["tokens_per_s"], 1e-9), 2)
+        result["accept_rate"] = result["spec"]["accept_rate"]
+        modes = ["nonspec", "spec"]
+    else:
+        # dim 256 keeps the fused decode step expensive enough that the
+        # slot-efficiency ratio (not host overhead) dominates the A/B
+        model = serving.demo_model(vocab=64, dim=256, heads=4, layers=2,
+                                   max_len=args.max_len, seed=1)
+        modes = (["continuous", "drain"] if args.mode == "both"
+                 else [args.mode])
+        for mode in modes:
+            result[mode] = run_load(
+                model, mode, workload, args.slots, args.max_len,
+                prompt_buckets, rate=args.rate or None, seed=args.seed,
+                record_path=record_path, sampling=sampling,
+                seed_base=args.seed_base if sampling else None)
+        if "continuous" in result and "drain" in result:
+            result["speedup_x"] = round(
+                result["continuous"]["tokens_per_s"]
+                / max(result["drain"]["tokens_per_s"], 1e-9), 2)
 
     # the SLO table rides next to the tokens/s headline (stderr, so the
     # stdout contract stays one JSON line)

@@ -194,6 +194,22 @@ METRICS = [
      ("decode_ttft_p99_ms",), ("decode_ttft_p99_ms",), "lower", 1.00),
     ("decode_tpot_p99_ms",
      ("decode_tpot_p99_ms",), ("decode_tpot_p99_ms",), "lower", 1.00),
+    # speculative-decode stage (bench_spec_decode / spec_smoke): the
+    # spec-vs-plain speedup divides two shared-box clocks — wide band;
+    # the accept rate is pure verify-ledger arithmetic on fixed seeds —
+    # tight band, a drop means the accept-prefix rule or the draft
+    # distillation regressed, not the weather
+    ("decode_spec_speedup_x",
+     ("decode_spec_speedup_x",), ("decode_spec_speedup_x",),
+     "higher", 1.00),
+    ("decode_spec_speedup_k8_x",
+     ("decode_spec_speedup_k8_x",), ("decode_spec_speedup_k8_x",),
+     "higher", 1.00),
+    ("decode_accept_rate",
+     ("decode_accept_rate",), ("decode_accept_rate",), "higher", 0.10),
+    ("decode_spec_tokens_per_s",
+     ("decode_spec_tokens_per_s",), ("decode_spec_tokens_per_s",),
+     "higher", 1.00),
 ]
 
 

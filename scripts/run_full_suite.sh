@@ -131,6 +131,17 @@ bash scripts/decode_smoke.sh "$MONITOR_DIR/decode_smoke"
 dcd=$?
 [ $dcd -ne 0 ] && rc=$((rc == 0 ? dcd : rc))
 
+# spec gate: sampled + speculative decoding — greedy spec bit-identical
+# to non-spec, sampled self-draft bit-identical with every proposal
+# accepted, seed-reproducible streams across admission orders, and the
+# loadgen A/B on the distilled pair (>= 1.5x at k=4, >= 2.0x at k=8,
+# accept >= 0.9, zero post-warmup compiles in every arm)
+echo ""
+echo "-- spec smoke gate --"
+bash scripts/spec_smoke.sh "$MONITOR_DIR/spec_smoke"
+spc=$?
+[ $spc -ne 0 ] && rc=$((rc == 0 ? spc : rc))
+
 # memory-plan gate: under a virtual HBM budget, a model 4x past the
 # no-remat ceiling trains under the auto-picked policy (predicted peak
 # under the limit pre-flight), offload spans ride their own track with

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# CI gate for sampled + speculative decoding: greedy spec output bit-
+# identical to non-spec (distilled draft), sampled self-draft streams
+# bit-identical with every proposal accepted, seed-reproducible streams
+# across admission orders, and the loadgen A/B on the distilled demo
+# pair — spec >= 1.5x plain sampled tokens/s at k=4 and >= 2.0x at
+# k=8, accept rate >= 0.9, zero post-warmup compiles in every arm.
+# Tier-1-safe: tiny models, CPU, a few minutes.
+#
+# Usage: scripts/spec_smoke.sh [out_dir]
+# The monitor JSONL (with the spec_smoke record) lands in out_dir
+# (default /tmp/paddle_tpu_spec_smoke); the last stdout line is one
+# JSON result record.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+OUT_DIR="${1:-/tmp/paddle_tpu_spec_smoke}"
+JAX_PLATFORMS=cpu \
+python scripts/spec_smoke.py --out-dir "$OUT_DIR"

@@ -1,0 +1,208 @@
+"""serving.sampling (PR 17): the jit-safe filter pipeline (greedy /
+temperature / top-k / top-p as batch-shaped knobs), counter-based PRNG
+key determinism, Gumbel-max draw statistics, and the SamplingParams /
+resolve() surface. All CPU, all fast."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.serving import sampling
+
+
+def _filt(logits, temps, top_ks, top_ps):
+    return np.asarray(sampling.filter_logits(
+        jnp.asarray(logits, jnp.float32),
+        jnp.asarray(temps, jnp.float32),
+        jnp.asarray(top_ks, jnp.int32),
+        jnp.asarray(top_ps, jnp.float32)))
+
+
+# ---------------------------------------------------------------------------
+# SamplingParams / resolve
+
+
+def test_params_validation_and_resolve():
+    p = sampling.SamplingParams(temperature=0.7, top_k=5, top_p=0.9,
+                                seed=3)
+    assert not p.greedy
+    assert sampling.SamplingParams().greedy
+    with pytest.raises(ValueError):
+        sampling.SamplingParams(top_p=0.0)
+    with pytest.raises(ValueError):
+        sampling.SamplingParams(top_p=1.5)
+    with pytest.raises(ValueError):
+        sampling.SamplingParams(seed=-1)
+    # dict / params / None forms; seed override; defensive copy
+    assert sampling.resolve(None).greedy
+    d = sampling.resolve({"temperature": 1.0, "top_k": 4}, seed=9)
+    assert d.top_k == 4 and d.seed == 9
+    r = sampling.resolve(p, seed=11)
+    assert r == sampling.SamplingParams(0.7, 5, 0.9, 11)
+    assert p.seed == 3            # the original is untouched
+    with pytest.raises(TypeError):
+        sampling.resolve("greedy")
+
+
+# ---------------------------------------------------------------------------
+# the filter pipeline
+
+
+def test_greedy_row_is_onehot_argmax():
+    logits = np.array([[0.1, 2.0, -1.0, 2.0],    # tie -> lowest id
+                       [3.0, 0.0, 0.0, 0.0]], np.float32)
+    out = _filt(logits, [0.0, -1.0], [0, 0], [1.0, 1.0])
+    assert (out[0] > sampling.NEG / 2).tolist() == [False, True, False,
+                                                    False]
+    assert (out[1] > sampling.NEG / 2).tolist() == [True, False, False,
+                                                    False]
+
+
+def test_top_k_1_equals_greedy_choice():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(6, 16)).astype(np.float32)
+    k1 = _filt(logits, np.ones(6), np.ones(6, np.int32), np.ones(6))
+    kept = k1 > sampling.NEG / 2
+    assert (kept.sum(axis=1) == 1).all()
+    assert (kept.argmax(axis=1) == logits.argmax(axis=1)).all()
+    # and the draw from a single-survivor row is deterministic
+    tok = np.asarray(sampling.sample_from_filtered(
+        jnp.asarray(k1), jnp.arange(6, dtype=jnp.uint32),
+        jnp.zeros(6, jnp.int32)))
+    assert (tok == logits.argmax(axis=1)).all()
+
+
+def test_top_p_1_is_plain_temperature():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(4, 12)).astype(np.float32)
+    out = _filt(logits, 2.0 * np.ones(4), np.zeros(4, np.int32),
+                np.ones(4))
+    np.testing.assert_allclose(out, logits / 2.0, rtol=1e-6)
+
+
+def test_top_k_keeps_exactly_k():
+    rng = np.random.default_rng(2)
+    logits = rng.normal(size=(5, 20)).astype(np.float32)
+    for k in (1, 3, 7, 20, 25):
+        out = _filt(logits, np.ones(5), k * np.ones(5, np.int32),
+                    np.ones(5))
+        kept = (out > sampling.NEG / 2).sum(axis=1)
+        assert (kept == min(k, 20)).all()
+
+
+def test_top_p_nucleus_and_top1_survives():
+    # peaked row: tiny p keeps only the top token; flat row keeps ~p*V
+    logits = np.array([[10.0, 0.0, 0.0, 0.0, 0.0],
+                       [0.0, 0.0, 0.0, 0.0, 0.0]], np.float32)
+    out = _filt(logits, np.ones(2), np.zeros(2, np.int32),
+                np.array([0.01, 0.5]))
+    assert (out[0] > sampling.NEG / 2).sum() == 1
+    # flat: exclusive cumsum < 0.5 keeps ceil(0.5 * 5) = 3 ranks
+    assert (out[1] > sampling.NEG / 2).sum() == 3
+
+
+def test_mixed_batch_rows_are_independent():
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(3, 10)).astype(np.float32)
+    mixed = _filt(logits, [0.0, 1.0, 0.5], [0, 4, 0], [1.0, 1.0, 0.7])
+    for i, (t, k, p) in enumerate([(0.0, 0, 1.0), (1.0, 4, 1.0),
+                                   (0.5, 0, 0.7)]):
+        solo = _filt(logits[i:i + 1], [t], [k], [p])
+        np.testing.assert_array_equal(mixed[i], solo[0])
+
+
+# ---------------------------------------------------------------------------
+# counter keys: determinism and independence
+
+
+def test_keys_are_pure_functions_of_seed_and_position():
+    seeds = jnp.asarray([7, 7, 9], jnp.uint32)
+    pos = jnp.asarray([0, 1, 0], jnp.int32)
+    k1 = np.asarray(sampling.keys_for(seeds, pos, sampling.SALT_TOKEN))
+    k2 = np.asarray(sampling.keys_for(seeds, pos, sampling.SALT_TOKEN))
+    np.testing.assert_array_equal(k1, k2)
+    assert not np.array_equal(k1[0], k1[1])     # position matters
+    assert not np.array_equal(k1[0], k1[2])     # seed matters
+    ka = np.asarray(sampling.keys_for(seeds, pos, sampling.SALT_ACCEPT))
+    assert not np.array_equal(k1[0], ka[0])     # salt matters
+
+
+def test_uniform_for_broadcasts_and_is_deterministic():
+    u = np.asarray(sampling.uniform_for(
+        jnp.asarray([5, 6], jnp.uint32)[:, None],
+        jnp.arange(4)[None, :], sampling.SALT_ACCEPT))
+    assert u.shape == (2, 4)
+    assert ((0.0 <= u) & (u < 1.0)).all()
+    u2 = np.asarray(sampling.uniform_for(
+        jnp.asarray([5, 6], jnp.uint32)[:, None],
+        jnp.arange(4)[None, :], sampling.SALT_ACCEPT))
+    np.testing.assert_array_equal(u, u2)
+
+
+def test_sampled_stream_matches_distribution_chi_squared():
+    """10k Gumbel-max draws from a fixed 8-token distribution must fit
+    the softmax probabilities (chi-squared, df=7, alpha=0.001)."""
+    logits = jnp.asarray(
+        np.array([2.0, 1.5, 1.0, 0.5, 0.0, -0.5, -1.0, -1.5],
+                 np.float32))
+    n = 10_000
+    filt = sampling.filter_logits(
+        jnp.broadcast_to(logits, (n, 8)),
+        jnp.ones((n,), jnp.float32), jnp.zeros((n,), jnp.int32),
+        jnp.ones((n,), jnp.float32))
+    toks = np.asarray(sampling.sample_from_filtered(
+        filt, jnp.full((n,), 123, jnp.uint32),
+        jnp.arange(n, dtype=jnp.int32)))
+    expected = n * np.asarray(jax.nn.softmax(logits))
+    observed = np.bincount(toks, minlength=8)
+    chi2 = float(((observed - expected) ** 2 / expected).sum())
+    assert chi2 < 24.32, chi2     # chi2_{0.999, df=7}
+
+
+def test_accept_prefix_rule_basics():
+    """Hand-checkable acceptance: q == p accepts everything; q
+    concentrated on a token p excludes rejects at once."""
+    v, k = 4, 2
+    p = np.full((1, k + 1, v), 0.25, np.float32)
+    q_same = np.full((1, k, v), 0.25, np.float32)
+    props = np.zeros((1, k), np.int32)
+    a, _res = sampling.accept_prefix(
+        jnp.asarray(p), jnp.asarray(q_same), jnp.asarray(props),
+        jnp.asarray([3], jnp.uint32), jnp.asarray([5], jnp.int32))
+    assert int(a[0]) == k         # u * 0.25 <= 0.25 always
+    # draft proposes token 0 with certainty but p(0) = 0 -> reject at
+    # position 0, resample lands on a token with p > 0
+    p0 = np.array([[[0.0, 0.5, 0.5, 0.0]] * (k + 1)], np.float32)
+    q0 = np.array([[[1.0, 0.0, 0.0, 0.0]] * k], np.float32)
+    a0, res0 = sampling.accept_prefix(
+        jnp.asarray(p0), jnp.asarray(q0), jnp.asarray(props),
+        jnp.asarray([3], jnp.uint32), jnp.asarray([5], jnp.int32))
+    assert int(a0[0]) == 0
+    assert int(res0[0]) in (1, 2)
+
+
+def test_accept_prefix_emitted_marginal_is_target():
+    """The speculative exactness proof obligation, empirically: over
+    many seeds, the position-0 emitted token (accepted proposal OR
+    residual resample) must be distributed as the TARGET p — despite
+    proposals coming from a very different draft q. Chi-squared on a
+    4-token toy, df=3, alpha=0.001."""
+    v, k, n = 4, 1, 10_000
+    p_row = np.array([0.5, 0.25, 0.125, 0.125], np.float32)
+    q_row = np.array([0.125, 0.125, 0.25, 0.5], np.float32)
+    p = np.broadcast_to(p_row, (n, k + 1, v)).astype(np.float32)
+    q = np.broadcast_to(q_row, (n, k, v)).astype(np.float32)
+    seeds = jnp.arange(n, dtype=jnp.uint32)
+    pos0 = jnp.zeros((n,), jnp.int32)
+    # proposals drawn from q under the SALT_TOKEN key (as the draft
+    # scan would)
+    props = sampling.sample_from_filtered(
+        jnp.log(jnp.asarray(q[:, 0])), seeds, pos0)[:, None]
+    a, res = sampling.accept_prefix(
+        jnp.asarray(p), jnp.asarray(q), props, seeds, pos0)
+    a = np.asarray(a)
+    emitted = np.where(a >= 1, np.asarray(props)[:, 0], np.asarray(res))
+    expected = n * p_row
+    observed = np.bincount(emitted, minlength=v)
+    chi2 = float(((observed - expected) ** 2 / expected).sum())
+    assert chi2 < 16.27, chi2     # chi2_{0.999, df=3}

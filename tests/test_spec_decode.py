@@ -1,0 +1,320 @@
+"""Speculative decoding (PR 17): draft-verify exactness (greedy spec ==
+greedy non-spec for ANY draft; self-draft sampled streams are
+bit-identical), per-request-seed reproducibility across admission
+orders and failover, KV-ledger rollback bookkeeping, zero-recompile
+churn with the spec executable family, and the draft-arena budget
+arithmetic. All CPU, all fast — the plain/self-draft engines are
+module-scoped (one warmup each); counter-keyed streams are history
+independent, so sharing a warm engine across tests is sound."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from paddle_tpu import serving
+from paddle_tpu.serving import kv_cache
+from paddle_tpu.serving.generate import GenerateEngine
+
+
+@pytest.fixture(scope="module")
+def model():
+    return serving.demo_model(vocab=32, dim=16, heads=2, layers=2,
+                              max_len=64, seed=1)
+
+
+@pytest.fixture(scope="module")
+def spec_pair():
+    return serving.demo_spec_pair(vocab=32, dim=16, heads=2,
+                                  draft_layers=1, extra_layers=1,
+                                  max_len=64, seed=1, distill=0.2)
+
+
+def _drive(eng, futs):
+    futs = futs if isinstance(futs, list) else [futs]
+    for _ in range(3000):
+        eng.tick()
+        if all(f.done() for f in futs):
+            return [f.result() for f in futs]
+    raise AssertionError("decode did not finish")
+
+
+def _engine(model, draft=None, k=4, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("page", 16)
+    kw.setdefault("max_len", 16)       # single-cap family -> one compile
+    kw.setdefault("prompt_buckets", (16,))
+    return GenerateEngine(model, start=False, draft_model=draft,
+                          spec_k=k, **kw)
+
+
+@pytest.fixture(scope="module")
+def plain_eng(model):
+    eng = _engine(model)
+    eng.warmup()
+    yield eng
+    eng.close(drain=False)
+
+
+@pytest.fixture(scope="module")
+def spec_eng(model):
+    eng = _engine(model, draft=model, k=4)
+    eng.warmup()
+    yield eng
+    eng.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# the KV ledger: note_length / rollback
+
+
+def test_pool_rollback_is_pure_ledger_truncation():
+    pool = kv_cache.KVCachePool({"k0": ((2, 4), "float32")}, slots=2,
+                                page=16, max_len=32)
+    s = pool.alloc()
+    pool.note_length(s, 5)
+    assert pool.length(s) == 5
+    pool.note_length(s, 10)            # a verify wrote k+1 ahead
+    assert pool.rollback(s, 7) == 3    # … and 3 went dead
+    assert pool.length(s) == 7
+    assert pool.rollback(s, 7) == 0    # no-op rollback drops nothing
+    with pytest.raises(ValueError):
+        pool.rollback(s, 9)            # growing is note_length's job
+    with pytest.raises(ValueError):
+        pool.rollback(s, -1)
+    with pytest.raises(ValueError):
+        pool.note_length(s, 99)        # past capacity
+    st = pool.stats()
+    assert st["rollbacks"] == 2 and st["rollback_tokens"] == 3
+    pool.free(s)
+    assert pool.length(s) == 0
+
+
+def test_bytes_per_token_prices_spec_pair_as_list(spec_pair):
+    target, draft = spec_pair
+    bt = kv_cache.bytes_per_token(target.kv_spec())
+    bd = kv_cache.bytes_per_token(draft.kv_spec())
+    assert kv_cache.bytes_per_token(
+        [target.kv_spec(), draft.kv_spec()]) == bt + bd
+    fits, needed, _ = kv_cache.fits_budget(
+        [target.kv_spec(), draft.kv_spec()], slots=4, max_len=64,
+        limit_bytes=10 ** 9)
+    assert fits and needed == 4 * 64 * (bt + bd)
+    n_pair = kv_cache.plan_slots([target.kv_spec(), draft.kv_spec()],
+                                 max_len=64, limit_bytes=10 ** 7,
+                                 reserve_frac=0.5, max_slots=10 ** 6)
+    n_solo = kv_cache.plan_slots(target.kv_spec(), max_len=64,
+                                 limit_bytes=10 ** 7, reserve_frac=0.5,
+                                 max_slots=10 ** 6)
+    # pricing the pair buys fewer slots from the same budget
+    assert 1 <= n_pair < n_solo
+    assert n_pair == int(0.5 * 10 ** 7 / (64 * (bt + bd)))
+
+
+# ---------------------------------------------------------------------------
+# exactness
+
+
+def test_greedy_spec_equals_nonspec_any_draft(model, plain_eng):
+    """The greedy-parity guarantee: with temperature 0 the accept rule
+    keeps a proposal iff it IS the target argmax, and every reject
+    resamples from the argmax one-hot — so even a totally unrelated
+    draft model yields the target's exact greedy stream."""
+    bad_draft = serving.demo_model(vocab=32, dim=16, heads=2, layers=1,
+                                   max_len=64, seed=99)
+    want = _drive(plain_eng,
+                  plain_eng.submit([3, 1, 4, 1, 5],
+                                   max_new_tokens=11))[0]
+    for k in (1, 4):
+        spec = _engine(model, draft=bad_draft, k=k)
+        spec.warmup()
+        got = _drive(spec, spec.submit([3, 1, 4, 1, 5],
+                                       max_new_tokens=11))[0]
+        st = spec.stats()
+        spec.close(drain=False)
+        np.testing.assert_array_equal(got, want)
+        assert st["verify_steps"] > 0 and st["spec_proposed"] > 0
+
+
+def test_sampled_self_draft_is_bit_identical(plain_eng, spec_eng):
+    """q == p and shared (seed, position, SALT_TOKEN) keys: the draft
+    proposes exactly what non-speculative sampling would draw, and the
+    accept test u * q(d) <= p(d) always passes — the streams match bit
+    for bit, including top-k/top-p filtered ones."""
+    configs = [{"temperature": 1.0},
+               {"temperature": 0.8, "top_k": 6},
+               {"temperature": 1.2, "top_p": 0.9},
+               {"temperature": 1.0, "top_k": 8, "top_p": 0.8}]
+    want = [_drive(plain_eng,
+                   plain_eng.submit([7, 2], max_new_tokens=12,
+                                    sampling=c, seed=100 + i))[0]
+            for i, c in enumerate(configs)]
+    st0 = spec_eng.stats()
+    got = [_drive(spec_eng,
+                  spec_eng.submit([7, 2], max_new_tokens=12,
+                                  sampling=c, seed=100 + i))[0]
+           for i, c in enumerate(configs)]
+    st1 = spec_eng.stats()
+    for w, g in zip(want, got):
+        np.testing.assert_array_equal(g, w)
+    # self-draft: everything the draft proposed was accepted
+    assert (st1["spec_accepted"] - st0["spec_accepted"]
+            == st1["spec_proposed"] - st0["spec_proposed"] > 0)
+
+
+def test_eos_mid_chunk_truncates_spec_stream(plain_eng, spec_eng):
+    """An EOS inside the accepted prefix must terminate the sequence AT
+    the EOS — tokens past it are never emitted, exactly like the
+    non-speculative path."""
+    probe = _drive(plain_eng,
+                   plain_eng.submit([5, 9], max_new_tokens=12,
+                                    sampling={"temperature": 1.3},
+                                    seed=7))[0]
+    eos = int(probe[len(probe) // 2])      # a token we KNOW occurs
+    want = _drive(plain_eng,
+                  plain_eng.submit([5, 9], max_new_tokens=12,
+                                   eos_token=eos,
+                                   sampling={"temperature": 1.3},
+                                   seed=7))[0]
+    assert want[-1] == eos
+    got = _drive(spec_eng,
+                 spec_eng.submit([5, 9], max_new_tokens=12,
+                                 eos_token=eos,
+                                 sampling={"temperature": 1.3},
+                                 seed=7))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# seed reproducibility across admission orders
+
+
+@pytest.mark.parametrize("speculative", [False, True])
+def test_seed_reproducible_across_admission_orders(
+        model, plain_eng, spec_eng, speculative):
+    """The same (prompt, params, seed) must produce the same stream no
+    matter when it was admitted, who it shared the batch with, or
+    whether speculation was on — the counter-key contract."""
+    draft = model if speculative else None
+    eng = spec_eng if speculative else plain_eng
+    reqs = [([2 + i, 5], {"temperature": 1.0, "top_k": 8}, 40 + i)
+            for i in range(4)]
+    futs = [eng.submit(p, max_new_tokens=12, sampling=c, seed=s)
+            for p, c, s in reqs]
+    batch_all = _drive(eng, futs)
+    # admit one at a time, in reverse, with decode ticks in between
+    eng2 = _engine(model, draft=draft)
+    eng2.warmup()
+    staggered = {}
+    for p, c, s in reversed(reqs):
+        f = eng2.submit(p, max_new_tokens=12, sampling=c, seed=s)
+        eng2.tick()                      # partial progress before the
+        staggered[s] = f                 # next admission
+    for (p, c, s), want in zip(reqs, batch_all):
+        got = _drive(eng2, staggered[s])[0]
+        np.testing.assert_array_equal(got, want)
+    eng2.close(drain=False)
+
+
+@pytest.mark.parametrize("speculative", [False, True])
+def test_failover_requeue_is_bit_identical(
+        model, plain_eng, spec_eng, speculative):
+    """Satellite 1: hang a replica mid-generation, disown its in-flight
+    sequences, requeue on a second engine — the adopting replica's
+    re-prefill must regenerate the exact stream a clean run produces,
+    sampled or speculative (the docstring's claim, enforced)."""
+    draft = model if speculative else None
+    a = _engine(model, draft=draft)
+    a.warmup()
+    fut = a.submit([11, 3, 8], max_new_tokens=12,
+                   sampling={"temperature": 0.9, "top_p": 0.95},
+                   seed=77)
+    for _ in range(2):
+        a.tick()                 # partial output exists on replica A
+    assert not fut.done()
+    moved = a.disown_inflight() + a.steal_pending()
+    assert len(moved) == 1
+    a.close(drain=False)
+
+    b = spec_eng if speculative else plain_eng
+    b.requeue(moved)
+    got = _drive(b, fut)[0]
+    # the clean reference: same request, fresh admission, no failover —
+    # per-request counter keys make it independent of the slot history
+    want = _drive(b, b.submit([11, 3, 8], max_new_tokens=12,
+                              sampling={"temperature": 0.9,
+                                        "top_p": 0.95},
+                              seed=77))[0]
+    np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# zero-recompile churn + bookkeeping
+
+
+def test_spec_churn_mints_no_executables(spec_eng):
+    base = spec_eng.executables()
+    st0 = spec_eng.stats()
+    rng = np.random.default_rng(0)
+    futs = []
+    for i in range(10):
+        samp = (None if i % 3 == 0
+                else {"temperature": 0.5 + 0.1 * i,
+                      "top_k": int(i % 5), "top_p": 0.8 + 0.02 * i})
+        futs.append(spec_eng.submit(rng.integers(0, 32, size=1 + i % 7),
+                                    max_new_tokens=4 + i % 5,
+                                    sampling=samp, seed=i))
+    _drive(spec_eng, futs)
+    assert spec_eng.executables() == base
+    st1 = spec_eng.stats()
+    assert st1["completed"] - st0["completed"] == 10
+    assert st1["spec_accepted"] <= st1["spec_proposed"]
+    # verify over-writes settle via ledger rollback every tick
+    assert st1["pool_rollbacks"] > st0["pool_rollbacks"]
+
+
+def test_draft_pool_tracks_target_capacity_to_the_brim(model):
+    """Growth keeps the draft arena in lockstep with the target, AND a
+    request admitted at exactly prompt + max_new == max_len survives
+    speculation: near the budget the verify chunk reaches past max_len
+    — the device drops the out-of-range writes and the ledger clamps,
+    so the stream completes and still matches the non-spec one
+    (regression: this used to raise out of _ensure_capacity)."""
+    eng = _engine(model, max_len=32)
+    eng.warmup()
+    want = _drive(eng, eng.submit(list(range(1, 9)),
+                                  max_new_tokens=24))[0]
+    eng.close(drain=False)
+    spec = _engine(model, draft=model, k=4, max_len=32)
+    spec.warmup()
+    assert spec.draft_pool.capacity == spec.pool.capacity == 16
+    f = spec.submit(list(range(1, 9)), max_new_tokens=24)  # 8+24 == 32
+    got = _drive(spec, f)[0]
+    assert spec.pool.capacity == 32          # the sequence outgrew page
+    assert spec.draft_pool.capacity == spec.pool.capacity
+    base = spec.executables()
+    spec.close(drain=False)
+    assert base == spec.executables()        # growth minted nothing
+    assert len(got) == 24                    # full budget, no early stop
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_validates_vocab_k_and_verify_fn(model):
+    other_vocab = serving.demo_model(vocab=16, dim=16, heads=2,
+                                     layers=1, max_len=64, seed=2)
+    with pytest.raises(ValueError, match="vocab"):
+        _engine(model, draft=other_vocab)
+    with pytest.raises(ValueError, match="spec_k"):
+        _engine(model, draft=model, k=0)
+
+    class _Shim:
+        """model surface minus verify_fn."""
+        def __init__(self, m):
+            self._m = m
+            self.vocab = m.vocab
+            self.state = m.state
+            self.device = None
+            self.kv_spec = m.kv_spec
+            self.prefill_fn = m.prefill_fn
+            self.decode_fn = m.decode_fn
+
+    with pytest.raises(ValueError, match="verify_fn"):
+        _engine(_Shim(model), draft=model)
