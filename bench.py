@@ -654,6 +654,57 @@ def bench_spec_decode(timeout_s=900):
     }
 
 
+def bench_lifecycle(timeout_s=900):
+    """Serving-lifecycle stage: runs scripts/lifecycle_smoke.py and a
+    short scripts/soak_chaos.py in subprocesses (CPU, 4 virtual
+    devices) and banks the zero-downtime numbers: the p99 of a full
+    fleet drain (in-flight decode streams run to completion), requests
+    dropped across a rolling weight hot-swap (must be zero — the swap
+    migrates, never sheds), and the goodput the fleet holds through the
+    mixed-fault chaos soak. The sentinel bands the drain latency very
+    wide (it's CPU decode wall-clock), but swap drops and soak goodput
+    tight — those are correctness ratios, and any drift means the
+    drain/migrate/swap discipline regressed."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    here = os.path.dirname(os.path.abspath(__file__))
+    smoke = os.path.join(here, "scripts", "lifecycle_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_lifecycle"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"lifecycle_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    soak = os.path.join(here, "scripts", "soak_chaos.py")
+    sproc = subprocess.run(
+        [sys.executable, soak, "--out-dir",
+         "/tmp/paddle_tpu_bench_soak", "--duration", "20"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    sline = next((ln for ln in reversed(sproc.stdout.splitlines())
+                  if ln.startswith("{")), None)
+    if sproc.returncode != 0 or sline is None:
+        raise RuntimeError(
+            f"soak_chaos rc={sproc.returncode}: "
+            f"{(sproc.stderr or sproc.stdout)[-400:]}")
+    s = json.loads(sline)
+    return {
+        "lifecycle_drain_p99_ms": r["drain_p99_ms"],
+        "lifecycle_swap_dropped": r["swap_dropped"],
+        "lifecycle_soak_goodput": s["goodput"],
+        "lifecycle_soak_requests": s["requests"],
+        "lifecycle_gates_pass": bool(r["ok"]),
+        "lifecycle_soak_gates_pass": bool(s["ok_gate"]),
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -1148,6 +1199,17 @@ def main():
                   f"{spd['decode_spec_speedup_x']} "
                   f"accept_rate={spd['decode_accept_rate']}", flush=True)
             _RESULTS.update(spd)
+        try:
+            lcy = bench_lifecycle()
+        except Exception as e:
+            print(f"lifecycle bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial lifecycle_drain_p99_ms="
+                  f"{lcy['lifecycle_drain_p99_ms']} "
+                  f"soak_goodput={lcy['lifecycle_soak_goodput']}",
+                  flush=True)
+            _RESULTS.update(lcy)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

@@ -202,9 +202,12 @@ def snapshot_payload():
         import sys
         blocks, _ = _serving_health()
         ssup = sys.modules.get("paddle_tpu.serving.supervisor")
+        smulti = sys.modules.get("paddle_tpu.serving.multi")
         decision = ssup.last_decision() if ssup is not None else None
+        lifecycle = smulti.last_lifecycle() if smulti is not None else None
         if blocks is not None or decision is not None:
-            serving_block = {"fleets": blocks, "last_decision": decision}
+            serving_block = {"fleets": blocks, "last_decision": decision,
+                             "last_lifecycle": lifecycle}
     except Exception:
         serving_block = None
     # slow-request exemplars: the N worst completed waterfalls by ttft

@@ -53,6 +53,7 @@ from .retry import (RetryPolicy, RetryExhausted, TransientError,  # noqa: F401
 from .guard import NaNGuard, NonFiniteError  # noqa: F401
 from .watchdog import Watchdog  # noqa: F401
 from .preempt import PreemptionHandler  # noqa: F401
+from .preempt import subscribe, unsubscribe  # noqa: F401
 from .faults import HostLossError  # noqa: F401
 from .elastic import ElasticSupervisor  # noqa: F401
 
@@ -61,7 +62,8 @@ __all__ = [
     "elastic", "RetryPolicy", "RetryExhausted", "TransientError",
     "retry_call", "retrying", "is_transient", "NaNGuard",
     "NonFiniteError", "Watchdog", "PreemptionHandler", "HostLossError",
-    "ElasticSupervisor", "Deadline", "record",
+    "ElasticSupervisor", "Deadline", "record", "subscribe",
+    "unsubscribe",
 ]
 
 # PADDLE_TPU_FAULTS='[{"kind":"loader","step":3}]' registers faults at

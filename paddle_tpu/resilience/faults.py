@@ -32,6 +32,15 @@ Well-known kinds (the registry itself is string-keyed and open):
                         trip the breaker and fail the batch over
 * ``replica_slow``    — sleep ``delay`` inside a replica's batch
                         execution (straggler; hedged-request food)
+* ``preempt_replica`` — simulated scheduler preemption notice for one
+                        serving replica: the supervisor must flip it to
+                        ``draining`` and migrate its queued + in-flight
+                        work (zero lost requests; fires in the
+                        supervisor tick, replica-targeted)
+* ``publish_corrupt`` — garble one shard of a published checkpoint just
+                        before a live weight hot-swap reads it; the
+                        quorum ``validate()`` must refuse the swap and
+                        quarantine the publish
 
 Serving faults target replicas, not steps: pass ``replica=1`` (or a
 list) to :func:`inject` and the spec only fires for that replica id —

@@ -26,7 +26,9 @@ traffic into them.
   execute,scatter}`` trace spans
 * :mod:`~paddle_tpu.serving.multi`     — :class:`MultiDeviceEngine`:
   health-aware fan-out over per-device state replicas, with per-replica
-  circuit breakers, hedged stragglers, and failover re-dispatch
+  circuit breakers, hedged stragglers, failover re-dispatch, graceful
+  preemption drain (SIGTERM → ``draining`` → zero-loss migration), and
+  rolling live weight hot-swap (``swap_weights``)
 * :mod:`~paddle_tpu.serving.breaker`   — the three-state
   :class:`CircuitBreaker` (closed → open → half_open) each replica
   carries

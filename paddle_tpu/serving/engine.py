@@ -100,6 +100,9 @@ class ServingEngine:
         # identity inside a MultiDeviceEngine fleet (fault targeting,
         # breaker gauges); None for a standalone engine
         self.replica_id = replica_id
+        # served weights version: bumped by the fleet's rolling
+        # hot-swap and stamped into every request's reqtrace record
+        self.weights_version = 0
         # breaker feedback: called with (ok: bool, exc|None) after each
         # batch execution attempt settles
         self.on_outcome = on_outcome
@@ -214,7 +217,8 @@ class ServingEngine:
                        seq_real=seq_real, seq_padded=seq_padded,
                        trace=reqtrace.attach(trace, kind="serve",
                                              priority=prio,
-                                             replica=self.replica_id))
+                                             replica=self.replica_id,
+                                             version=self.weights_version))
 
     def submit_request(self, req):
         """Enqueue an already-built ``Request``; returns its future.
@@ -309,12 +313,15 @@ class ServingEngine:
         since the drain thread last made progress, and time since the
         last successful batch."""
         now = time.monotonic() if now is None else now
+        age = self._batcher.inflight_age(now)
         return {
             "queue_depth": self._batcher.depth(),
-            "inflight_age_s": self._batcher.inflight_age(now),
+            "inflight_age_s": age,
             "inflight_token": self._batcher.inflight_token(),
             "last_progress_age_s": self._batcher.last_progress_age(now),
             "last_ok_age_s": now - self._last_ok_t,
+            # in-flight request count — what a drain waits to hit zero
+            "active": 0 if age is None else 1,
         }
 
     def probe(self, timeout_s=1.0):

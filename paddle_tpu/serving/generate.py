@@ -237,6 +237,9 @@ class GenerateEngine:
         self._jax = jax
         self.model = model
         self.replica_id = replica_id
+        # served weights version: bumped by the fleet's rolling
+        # hot-swap and stamped into every request's reqtrace record
+        self.weights_version = 0
         self.on_outcome = on_outcome
         if refill not in ("continuous", "drain"):
             raise ValueError(
@@ -379,7 +382,8 @@ class GenerateEngine:
                              sampling=params,
                              trace=reqtrace.attach(
                                  trace, kind="decode", priority=prio,
-                                 replica=self.replica_id))
+                                 replica=self.replica_id,
+                                 version=self.weights_version))
 
     def submit_request(self, req):
         """Admit + enqueue; returns the future. Raises ``ShedError`` /
@@ -830,12 +834,16 @@ class GenerateEngine:
         with self._lock:
             t0 = self._tick_t0
             depth = len(self._queue)
+            seated = sum(1 for s in self._slots if s.req is not None)
         return {
             "queue_depth": depth,
             "inflight_age_s": None if t0 is None else now - t0,
             "inflight_token": t0,
             "last_progress_age_s": now - self._last_progress,
             "last_ok_age_s": now - self._last_ok_t,
+            # seated (still-generating) sequences — what a drain waits
+            # to hit zero
+            "active": seated,
         }
 
     def probe(self, timeout_s=1.0):

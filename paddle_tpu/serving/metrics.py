@@ -384,7 +384,12 @@ def goodput_window(now=None):
 
 # -- resilience series ------------------------------------------------------
 
-_BREAKER_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2}
+#: ``draining`` is a routing state, not a breaker state — a draining
+#: replica is healthy but refusing new work while it finishes (or
+#: migrates) what it holds; /healthz and the gauges must not read it
+#: as ``open``
+_BREAKER_STATE_NUM = {"closed": 0, "half_open": 1, "open": 2,
+                      "draining": 3}
 
 
 def record_shed(priority, level, retry_after_ms):
@@ -448,6 +453,21 @@ def record_replica_restart(replica):
 def record_active_replicas(n):
     if _monitor.enabled():
         _monitor.gauge("serving.active_replicas").set(int(n))
+
+
+def record_lifecycle(event, **fields):
+    """Serving lifecycle ledger (``serving.lifecycle.*``): drains,
+    undrains, weight swaps, refused publishes — the events /snapshot
+    replays to explain a fleet's zero-downtime history."""
+    if _monitor.enabled():
+        _monitor.counter(f"serving.lifecycle.{event}").inc()
+        _monitor.emit(kind="serving", event="lifecycle",
+                      lifecycle=event, **fields)
+
+
+def record_weights_version(version):
+    if _monitor.enabled():
+        _monitor.gauge("serving.weights_version").set(int(version))
 
 
 def record_supervisor(decision, **fields):

@@ -29,6 +29,20 @@ stalls), so routing is **health-aware**:
   replica and the first result wins, with total hedges capped at
   ``hedge_budget`` of traffic so the cure can't out-eat the disease.
 
+Beyond failure handling, the fleet has a *lifecycle*: scheduler
+preemption (SIGTERM, or the injected ``preempt_replica`` fault) flips a
+replica to **draining** — healthy but refusing new work — and migrates
+its queued and in-flight requests to peers over the same
+``disown_inflight``/``requeue`` deterministic-replay path failover
+uses, so a preemption loses zero requests and sampled streams complete
+bit-identical to the fault-free run. :meth:`MultiDeviceEngine.
+swap_weights` rolls new weights through the fleet one replica at a
+time (drain-lite → place state → probe → readmit) without dropping a
+request or minting an executable; a quorum-failing checkpoint publish
+never swaps in. Every fleet subscribes itself to
+``resilience.preempt`` at construction — a process-level SIGTERM
+drains every live fleet.
+
 :func:`replicate` is the state mechanic (one Predictor view per device,
 sharing the model object, with a per-device executable cache);
 :class:`MultiDeviceEngine` is the operational wrapper.
@@ -47,10 +61,19 @@ from .admission import ShedError
 from .breaker import CircuitBreaker
 from .engine import ServingEngine
 from . import metrics
+from ..resilience import faults as _faults
+from ..resilience import preempt as _preempt
 
 #: live MultiDeviceEngines — /healthz walks this (weak: an un-closed
 #: engine can still be collected)
 _ACTIVE = weakref.WeakSet()
+
+#: most recent lifecycle event across all fleets (the /snapshot block)
+_LAST_LIFECYCLE = None
+
+
+def last_lifecycle():
+    return _LAST_LIFECYCLE
 
 #: floor on the auto hedge delay: below this, hedges fire on normal
 #: scheduling jitter and burn the budget on non-stragglers
@@ -96,9 +119,19 @@ class _Replica:
         self.engine = engine
         self.breaker = breaker
         self.active = active
+        # draining: healthy but refusing NEW work (preemption notice or
+        # a rolling weight swap); distinct from an open breaker
+        self.draining = False
         self.handled_token = None    # last in-flight dispatch failed over
         self.restart_token = None    # last in-flight dispatch restarted on
         self.restarts = 0
+
+    @property
+    def state(self):
+        """Routing state for /healthz and the gauges: ``draining``
+        masks the (healthy) breaker state while the replica refuses
+        admission."""
+        return "draining" if self.draining else self.breaker.state
 
 
 class _Hedger(threading.Thread):
@@ -232,6 +265,24 @@ class MultiDeviceEngine:
                 self, interval_s=supervisor_interval_s,
                 restart_after_s=restart_after_s,
                 tokens_floor=tokens_floor)
+        # lifecycle: served weights version (stamped into reqtrace
+        # records), the fleet's last lifecycle event, and the process
+        # preemption subscription — SIGTERM drains this fleet; the
+        # subscription holds the fleet weakly so an un-closed engine
+        # can still be collected
+        self.weights_version = 0
+        for r in self._replicas:
+            r.engine.weights_version = 0
+        self._lifecycle = None
+        self._swap_lock = threading.Lock()
+        _self_ref = weakref.ref(self)
+
+        def _on_preempt(signum, _ref=_self_ref):
+            owner = _ref()
+            if owner is not None:
+                owner.drain_fleet(reason=f"preempt:{signum}")
+
+        self._preempt_cb = _preempt.subscribe(_on_preempt)
         _ACTIVE.add(self)
         metrics.record_active_replicas(
             sum(1 for r in self._replicas if r.active))
@@ -285,11 +336,11 @@ class MultiDeviceEngine:
             self._rr = (self._rr + 1) % n
         for idx in order:
             r = self._replicas[idx]
-            if not r.active or idx in exclude:
+            if not r.active or r.draining or idx in exclude:
                 continue
             if r.breaker.allow():
                 return r
-        states = {r.index: r.breaker.state for r in self._replicas}
+        states = {r.index: r.state for r in self._replicas}
         raise NoHealthyReplicaError(
             f"no healthy replica (breakers: {states}); retry after "
             f"{self._breaker_kwargs['cooldown_s'] * 1e3:.0f}ms",
@@ -373,26 +424,25 @@ class MultiDeviceEngine:
         if p99_ms:
             self._hedge_delay_s = max(MIN_HEDGE_S, float(p99_ms) / 1e3)
 
-    # -- failover / restart (supervisor verdicts) --------------------------
+    # -- failover / drain / restart (supervisor verdicts) ------------------
 
-    def _failover(self, replica, reason=""):
-        """Move a tripped replica's queued and in-flight requests to
-        healthy peers. The in-flight group is *disowned* first, so even
-        if the hung dispatch eventually completes, whichever resolution
-        lands first wins and the other is swallowed — exactly once,
-        either way."""
+    def _migrate(self, replica, hop, reason=""):
+        """Move a replica's queued and in-flight requests to healthy
+        peers (the shared spine under failover AND graceful drain). The
+        in-flight group is *disowned* first, so even if the source
+        dispatch eventually completes, whichever resolution lands first
+        wins and the other is swallowed — exactly once, either way.
+        Decode requests regenerate bit-identically on the adopting
+        replica (counter-based sampling — see ``disown_inflight``)."""
         moved = replica.engine.disown_inflight()
         moved += replica.engine.steal_pending()
         moved = [r for r in moved if not r.future.done()]
         if not moved:
             return 0
-        with self._hedge_lock:
-            self._failovers += 1
-        metrics.record_failover(replica.index, len(moved))
         for r in moved:
             tr = getattr(r, "trace", None)
             if tr is not None:
-                tr.hop("failover", replica=replica.index, reason=reason)
+                tr.hop(hop, replica=replica.index, reason=reason)
         try:
             target = self._pick_replica(exclude=(replica.index,))
         except NoHealthyReplicaError as e:
@@ -401,6 +451,252 @@ class MultiDeviceEngine:
             return len(moved)
         target.engine.requeue(moved)
         return len(moved)
+
+    def _failover(self, replica, reason=""):
+        """Move a tripped replica's work to healthy peers and count it."""
+        moved = self._migrate(replica, "failover", reason)
+        if moved:
+            with self._hedge_lock:
+                self._failovers += 1
+            metrics.record_failover(replica.index, moved)
+        return moved
+
+    # -- graceful drain (preemption / rolling swap) ------------------------
+
+    def _record_lifecycle(self, event, **fields):
+        global _LAST_LIFECYCLE
+        entry = {"event": event, "t": time.time(), **fields}
+        self._lifecycle = entry
+        _LAST_LIFECYCLE = entry
+        metrics.record_lifecycle(event, **fields)
+
+    def _resolve_replica(self, replica):
+        if isinstance(replica, _Replica):
+            return replica
+        return self._replicas[int(replica)]
+
+    def _has_peer(self, exclude_index):
+        """Is there anywhere for migrated work to land?"""
+        return any(r.active and not r.draining
+                   and r.breaker.state != "open"
+                   and r.index != exclude_index for r in self._replicas)
+
+    def drain_replica(self, replica, reason="preempt"):
+        """Preemption notice for ONE replica: stop admitting, migrate
+        its queued and in-flight work to healthy peers (zero lost
+        requests — streams regenerate bit-identically). With no healthy
+        peer the replica keeps its work and finishes it while refusing
+        new admissions. Returns the number of requests migrated."""
+        r = self._resolve_replica(replica)
+        if r.draining:
+            return 0
+        r.draining = True
+        moved = self._migrate(r, "drain", reason) \
+            if self._has_peer(r.index) else 0
+        self._record_lifecycle("drain", replica=r.index, reason=reason,
+                               moved=moved)
+        return moved
+
+    def undrain_replica(self, replica, reason=""):
+        """Readmit a drained replica into the rotation."""
+        r = self._resolve_replica(replica)
+        if not r.draining:
+            return
+        r.draining = False
+        self._record_lifecycle("undrain", replica=r.index, reason=reason)
+
+    def drain_fleet(self, reason="preempt"):
+        """Process-level preemption notice (SIGTERM): EVERY replica
+        stops admitting new work; queued and in-flight requests run to
+        completion in place (there is no healthy peer to migrate to —
+        the whole process is going away). Subsequent submits shed with
+        :class:`NoHealthyReplicaError`. Poll :meth:`drained` / block on
+        :meth:`drain_wait` before exiting."""
+        flipped = [r.index for r in self._replicas if not r.draining]
+        for r in self._replicas:
+            r.draining = True
+        self._record_lifecycle("drain_fleet", reason=reason,
+                               replicas=len(flipped))
+        return len(flipped)
+
+    def drained(self, now=None):
+        """True when no replica holds queued or in-flight work."""
+        for r in self._replicas:
+            h = r.engine.heartbeat(now)
+            if h["queue_depth"] or h.get("active"):
+                return False
+        return True
+
+    def drain_wait(self, timeout_s=10.0, poll_s=0.01):
+        """Block until :meth:`drained` (or timeout); returns the final
+        drained verdict."""
+        deadline = time.monotonic() + float(timeout_s)
+        while not self.drained():
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
+    # -- live weight hot-swap ----------------------------------------------
+
+    def _replica_empty(self, r, timeout_s, poll_s=0.005):
+        """Wait until one replica holds no queued or in-flight work."""
+        deadline = time.monotonic() + float(timeout_s)
+        while True:
+            h = r.engine.heartbeat()
+            if not h["queue_depth"] and not h.get("active") \
+                    and h["inflight_age_s"] is None:
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    def _resolve_swap_source(self, source, step):
+        """Turn a swap source into a host state tree.
+
+        ``source`` is a live pytree (served as-is), a sharded checkpoint
+        directory path, or a ``CheckpointManager`` (+ ``step``) whose
+        published step directory is resolved. Directory sources must
+        pass the full quorum :func:`io.sharded.validate` — a corrupt
+        publish is quarantined (``<dir>.corrupt``), counted
+        (``serving.lifecycle.swap_refused``) and never swaps in."""
+        import os
+        from ..io import sharded as _sharded
+        dirname = None
+        if hasattr(source, "_sharded_path"):
+            if step is None:
+                raise ValueError(
+                    "swap_weights(CheckpointManager) needs step=")
+            dirname = source._sharded_path(step)
+        elif isinstance(source, (str, os.PathLike)):
+            dirname = os.fspath(source)
+        if dirname is None:
+            return source     # a live tree
+        # the publish-corruption fault garbles one committed shard just
+        # before the swap reads it — quorum validation must catch it
+        spec = _faults.fire("publish_corrupt", None) \
+            if _faults.enabled() else None
+        if spec is not None:
+            shards = sorted(f for f in os.listdir(dirname)
+                            if f.endswith(".npy"))
+            if shards:
+                _faults.garble_file(os.path.join(dirname, shards[0]))
+        ok, why = _sharded.validate(dirname)
+        if not ok:
+            quarantine = dirname + ".corrupt"
+            try:
+                os.replace(dirname, quarantine)
+            except OSError:
+                quarantine = None
+            self._record_lifecycle("swap_refused", source=dirname,
+                                   why=why, quarantined=quarantine)
+            raise ValueError(
+                f"swap_weights: publish {dirname} failed quorum "
+                f"validation ({why}); quarantined, serving version "
+                f"{self.weights_version} unchanged")
+        state, _manifest = _sharded.load_state(dirname, verify=False)
+        # a CheckpointManager publish wraps the tree ({"step":…,
+        # "model": …}); unwrap to the served payload
+        if isinstance(state, dict) and "model" in state:
+            state = state["model"]
+        return state
+
+    def _check_swap_shapes(self, new_tree):
+        """Same-shape contract: the swap must not mint executables, so
+        treedef and every leaf's (shape, dtype) must match the serving
+        template."""
+        import jax
+        import numpy as np
+        old_leaves, old_def = jax.tree_util.tree_flatten(
+            self.predictor.state)
+        new_leaves, new_def = jax.tree_util.tree_flatten(new_tree)
+        if old_def != new_def:
+            return f"tree structure mismatch: {new_def} != {old_def}"
+        for i, (a, b) in enumerate(zip(old_leaves, new_leaves)):
+            sa, sb = np.shape(a), np.shape(b)
+            if sa != sb:
+                return f"leaf {i} shape mismatch: {sb} != {sa}"
+        return None
+
+    def swap_weights(self, source, step=None, version=None, probe=True,
+                     drain_timeout_s=10.0, probe_timeout_s=2.0):
+        """Roll new weights through the live fleet, one replica at a
+        time, without dropping a request or minting an executable.
+
+        Per replica: drain-lite (stop admitting; migrate its queued +
+        in-flight work to peers when any exist, else let it finish in
+        place), ``device_put`` the new state onto its device, half-open
+        style :meth:`~ServingEngine.probe` with the fresh weights, then
+        readmit. State rides the executables as an *argument* (the
+        state-as-argument jit contract), so a same-shape swap reuses
+        every compiled executable — ``executables()`` before and after
+        must agree.
+
+        ``source``: a live state pytree, a sharded checkpoint directory,
+        or a ``CheckpointManager`` with ``step=`` — directory sources
+        must pass quorum validation (see :meth:`_resolve_swap_source`).
+        ``version`` defaults to ``weights_version + 1``. On a probe
+        failure the whole roll is unwound — the failing replica AND
+        every already-swapped replica get their old state back — so the
+        fleet is never left serving mixed weights. Returns the new
+        version."""
+        import jax
+        with self._swap_lock:
+            state = self._resolve_swap_source(source, step)
+            why = self._check_swap_shapes(state)
+            if why is not None:
+                self._record_lifecycle("swap_refused", why=why)
+                raise ValueError(f"swap_weights: {why}")
+            new_version = (int(version) if version is not None
+                           else self.weights_version + 1)
+            swapped = []   # (replica, old_state) — rollback ledger
+            for r in self._replicas:
+                was_draining = r.draining
+                r.draining = True
+                try:
+                    if self._has_peer(r.index):
+                        self._migrate(r, "swap", reason="hot_swap")
+                    self._replica_empty(r, drain_timeout_s)
+                    old_state = r.predictor.state
+                    r.predictor.state = jax.device_put(state, r.device)
+                    if probe:
+                        ok = r.engine.probe(timeout_s=probe_timeout_s)
+                        # None = never served, nothing to replay: pass
+                        if ok is False:
+                            # unwind the WHOLE roll: a half-swapped
+                            # fleet serving mixed weights breaks the
+                            # bit-reproducibility contract
+                            r.predictor.state = old_state
+                            for rb, rb_old in swapped:
+                                rb.predictor.state = rb_old
+                                rb.engine.weights_version = \
+                                    self.weights_version
+                            self._record_lifecycle(
+                                "swap_failed", replica=r.index,
+                                version=new_version,
+                                rolled_back=[x.index for x, _ in swapped])
+                            raise RuntimeError(
+                                f"swap_weights: probe failed on replica "
+                                f"{r.index} with version {new_version}; "
+                                f"the roll was unwound and the fleet "
+                                f"keeps serving version "
+                                f"{self.weights_version}")
+                    r.engine.weights_version = new_version
+                    swapped.append((r, old_state))
+                finally:
+                    r.draining = was_draining
+            # the template feeds _restart/_replicate: future rebuilds
+            # must come up on the new version
+            self.predictor.state = state
+            self.weights_version = new_version
+            metrics.record_weights_version(new_version)
+            self._record_lifecycle(
+                "swap", version=new_version,
+                source=("tree" if not isinstance(source, (str,))
+                        and not hasattr(source, "_sharded_path")
+                        else "checkpoint"),
+                replicas=len(swapped))
+            return new_version
 
     def _restart(self, replica):
         """Re-``replicate()`` state onto the replica's device, swap in a
@@ -440,7 +736,7 @@ class MultiDeviceEngine:
 
     def _activate_one(self):
         for r in self._replicas:
-            if not r.active:
+            if not r.active and not r.draining:
                 r.active = True
                 metrics.record_active_replicas(self._active_count())
                 return r
@@ -450,7 +746,7 @@ class MultiDeviceEngine:
         if self._active_count() <= self.min_replicas:
             return None
         for r in reversed(self._replicas):
-            if r.active:
+            if r.active and not r.draining:
                 r.active = False
                 # drain its queue onto the survivors
                 moved = [q for q in r.engine.steal_pending()
@@ -486,6 +782,7 @@ class MultiDeviceEngine:
             self.supervisor.stop()
         if self._hedger is not None:
             self._hedger.stop()
+        _preempt.unsubscribe(self._preempt_cb)
         _ACTIVE.discard(self)
         for r in self._replicas:
             # a hung replica must not hold close() hostage: bound the
@@ -518,35 +815,45 @@ class MultiDeviceEngine:
             agg["failovers"] = self._failovers
         agg["restarts"] = sum(r.restarts for r in self._replicas)
         agg["active_replicas"] = self._active_count()
-        agg["breakers"] = {r.index: r.breaker.state
-                           for r in self._replicas}
+        agg["draining_replicas"] = sum(
+            1 for r in self._replicas if r.draining)
+        agg["weights_version"] = self.weights_version
+        agg["breakers"] = {r.index: r.state for r in self._replicas}
         return agg
 
     def health(self, now=None):
-        """The /healthz ``serving`` block: per-replica breaker state and
-        heartbeat ages, plus ``all_open`` (no replica can take traffic
-        → the endpoint answers 503)."""
+        """The /healthz ``serving`` block: per-replica routing state
+        (``state`` is the breaker state, or ``draining`` — a healthy
+        replica refusing admission is NOT unhealthy) and heartbeat
+        ages, plus ``all_open`` (no replica can take traffic → the
+        endpoint answers 503; a fully draining fleet reads all_open
+        because it really is refusing traffic)."""
         now = time.monotonic() if now is None else now
         reps = []
         any_admitting = False
         for r in self._replicas:
             h = r.engine.heartbeat(now)
-            state = r.breaker.state
-            if r.active and state != "open":
+            if r.active and not r.draining and r.breaker.state != "open":
                 any_admitting = True
             reps.append({
                 "replica": r.index,
                 "device": str(r.device),
-                "breaker": state,
+                "state": r.state,
+                "breaker": r.breaker.state,
+                "draining": bool(r.draining),
                 "active": bool(r.active),
                 "queue_depth": h["queue_depth"],
+                "inflight": h.get("active", 0),
                 "inflight_age_s": None if h["inflight_age_s"] is None
                 else round(h["inflight_age_s"], 3),
                 "heartbeat_age_s": round(h["last_ok_age_s"], 3),
                 "restarts": r.restarts,
             })
         out = {"replicas": reps, "all_open": not any_admitting,
-               "active_replicas": self._active_count()}
+               "active_replicas": self._active_count(),
+               "weights_version": self.weights_version}
+        if self._lifecycle is not None:
+            out["last_lifecycle"] = self._lifecycle
         if self.supervisor is not None:
             out["supervisor"] = self.supervisor.last_decision()
         return out
@@ -567,6 +874,7 @@ def publish_gauges():
         return
     for eng in list(_ACTIVE):
         metrics.record_active_replicas(eng._active_count())
+        metrics.record_weights_version(eng.weights_version)
         for r in eng._replicas:
             _monitor.gauge(f"serving.breaker_state.{r.index}").set(
-                metrics._BREAKER_STATE_NUM.get(r.breaker.state, -1))
+                metrics._BREAKER_STATE_NUM.get(r.state, -1))

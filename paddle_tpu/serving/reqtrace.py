@@ -142,12 +142,14 @@ class RequestTrace:
         self.flow_open = False
         self.record_ = None
 
-    def attempt(self, origin="submit", replica=None):
+    def attempt(self, origin="submit", replica=None, version=None):
         """Mint one dispatch attempt (primary submit, hedge shadow, or
-        post-shed retry). The attempt IS what rides on ``req.trace``."""
+        post-shed retry). The attempt IS what rides on ``req.trace``.
+        ``version`` stamps the serving fleet's weights version — the
+        audit trail a rolling hot-swap leaves on every record."""
         with self.lock:
             self.attempts += 1
-        return Attempt(self, origin, replica)
+        return Attempt(self, origin, replica, version)
 
     def hop(self, kind, replica=None, **fields):
         """Record one lineage hop (enqueue/hedge/failover/requeue/shed)
@@ -179,15 +181,16 @@ class Attempt:
     the stage being LEFT, so the breakdown sums to wall time by
     construction. ``req.trace`` holds the Attempt (None = disabled)."""
 
-    __slots__ = ("ctx", "origin", "replica", "t_start", "stage", "t_mark",
-                 "stages", "t_first", "n_tokens", "spec_proposed",
+    __slots__ = ("ctx", "origin", "replica", "version", "t_start", "stage",
+                 "t_mark", "stages", "t_first", "n_tokens", "spec_proposed",
                  "spec_accepted")
 
-    def __init__(self, ctx, origin, replica):
+    def __init__(self, ctx, origin, replica, version=None):
         now = _MONO()
         self.ctx = ctx
         self.origin = origin
         self.replica = replica
+        self.version = version
         self.t_start = now
         self.stage = "queue"
         self.t_mark = now
@@ -302,6 +305,8 @@ class Attempt:
                       else 1.0),
             "hops": hops,
         }
+        if self.version is not None:
+            rec["weights_version"] = self.version
         for stage, secs in self.stages.items():
             rec[f"{stage}_ms"] = round(secs * 1e3, 3)
         if self.spec_proposed:
@@ -339,17 +344,20 @@ def new_trace(kind="serve", priority=1):
     return RequestTrace(kind, priority)
 
 
-def attach(trace, kind="serve", priority=1, replica=None):
+def attach(trace, kind="serve", priority=1, replica=None, version=None):
     """The make_request() entry point: mint a fresh context (trace=None)
     or a retry attempt on an existing one (trace=RequestTrace from a
     shed caller re-submitting). Returns the Attempt to ride on
-    ``req.trace``, or None when tracing is off."""
+    ``req.trace``, or None when tracing is off. ``version`` is the
+    serving engine's current weights version (stamped into the terminal
+    record)."""
     if trace is None:
         ctx = new_trace(kind, priority)
-        return None if ctx is None else ctx.attempt("submit", replica)
+        return None if ctx is None else ctx.attempt("submit", replica,
+                                                    version)
     if isinstance(trace, Attempt):
         trace = trace.ctx
-    return trace.attempt("retry", replica)
+    return trace.attempt("retry", replica, version)
 
 
 def transition(requests, stage, flow=False):

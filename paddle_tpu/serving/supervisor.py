@@ -36,6 +36,7 @@ import time
 import weakref
 
 from . import metrics
+from ..resilience import faults as _faults
 
 #: most recent decision across all supervisors (the /snapshot block)
 _LAST_DECISION = None
@@ -138,7 +139,22 @@ class ServingSupervisor:
         hb = replica.engine.heartbeat(now)
         age = hb["inflight_age_s"]
         token = hb["inflight_token"]
-        busy = bool(hb["queue_depth"]) or age is not None
+        busy = bool(hb["queue_depth"]) or age is not None \
+            or bool(hb.get("active"))
+
+        # preemption notice (injected): graceful drain, not a hang —
+        # the replica is healthy, the scheduler just wants it back
+        if _faults.enabled() and _faults.fire(
+                "preempt_replica", None, replica=replica.index) is not None:
+            moved = owner.drain_replica(replica, reason="preempt_replica")
+            self._decide("drain", replica=replica.index, moved=moved)
+            return busy
+
+        # a draining replica is finishing (or has migrated) its work —
+        # no hang verdicts, no probes; readmission is the drain owner's
+        # call (undrain / swap completion), not the supervisor's
+        if replica.draining:
+            return busy
 
         # hang: one verdict per dispatch (the token is the dispatch's
         # start time — a NEW dispatch hanging gets its own verdict)

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Zero-downtime serving lifecycle gate: preempt drain under load,
+# SIGTERM fleet drain, rolling weight hot-swap, corrupt-publish refusal.
+# Forces the 4-device CPU topology before any jax import.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT_DIR="${1:-/tmp/paddle_tpu_lifecycle_smoke}"
+
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+python scripts/lifecycle_smoke.py --out-dir "$OUT_DIR"

@@ -210,6 +210,19 @@ METRICS = [
     ("decode_spec_tokens_per_s",
      ("decode_spec_tokens_per_s",), ("decode_spec_tokens_per_s",),
      "higher", 1.00),
+    # serving-lifecycle stage (bench_lifecycle): fleet drain latency is
+    # CPU decode wall-clock (very wide band); swap drops and the chaos
+    # soak's goodput are correctness ratios — tight bands, any drift
+    # means the drain/migrate/swap discipline itself regressed
+    ("lifecycle_drain_p99_ms",
+     ("lifecycle_drain_p99_ms",), ("lifecycle_drain_p99_ms",),
+     "lower", 1.00),
+    ("lifecycle_swap_dropped",
+     ("lifecycle_swap_dropped",), ("lifecycle_swap_dropped",),
+     "lower", 0.10),
+    ("lifecycle_soak_goodput",
+     ("lifecycle_soak_goodput",), ("lifecycle_soak_goodput",),
+     "higher", 0.10),
 ]
 
 

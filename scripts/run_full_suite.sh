@@ -165,6 +165,18 @@ bash scripts/request_smoke.sh "$MONITOR_DIR/request_smoke"
 rqs=$?
 [ $rqs -ne 0 ] && rc=$((rc == 0 ? rqs : rc))
 
+# serving-lifecycle gate: an injected preemption drains its replica and
+# migrates queued + in-flight decode streams with zero loss and
+# bit-identical outputs; SIGTERM drains the whole fleet (in-flight
+# completes, post-drain submits shed); a rolling weight hot-swap lands
+# under load with zero dropped requests and zero new executables; a
+# corrupt publish is refused by quorum validation and quarantined
+echo ""
+echo "-- lifecycle smoke gate --"
+bash scripts/lifecycle_smoke.sh "$MONITOR_DIR/lifecycle_smoke"
+lcy=$?
+[ $lcy -ne 0 ] && rc=$((rc == 0 ? lcy : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

@@ -161,6 +161,31 @@ def test_main_fast_and_full_stage_selection(bench, monkeypatch):
                         lambda **k: calls.append("s512") or (1.0, 0.0))
     monkeypatch.setattr(bench, "bench_bert_long",
                         lambda **k: calls.append("s2048") or (1.0, 0.0))
+    # The subprocess-launching stages (each spawns its own python+jax
+    # and runs a full smoke script) are stage-selection no-ops here:
+    # their behavior is gated by their own scripts/*_smoke.sh entries in
+    # run_full_suite.sh, and running them for real turns this wiring
+    # test into a multi-minute integration run.
+    monkeypatch.setattr(bench, "bench_serving",
+                        lambda **k: (1.0, 2.0, 300.0, 3.0))
+    monkeypatch.setattr(bench, "bench_serving_degraded", lambda **k: {
+        "serving_degraded_goodput": 1.0,
+        "serving_degraded_high_goodput": 1.0})
+    monkeypatch.setattr(bench, "bench_collective_overlap", lambda **k: {
+        "collective_overlap_ratio": 0.5})
+    monkeypatch.setattr(bench, "bench_fused_optimizer", lambda **k: {
+        "fused_optimizer_bytes_reduction": 0.5})
+    monkeypatch.setattr(bench, "bench_planner", lambda **k: {
+        "planner_chosen": "x", "planner_candidates": 1})
+    monkeypatch.setattr(bench, "bench_memory_plan", lambda **k: {
+        "memory_plan_picked": "none", "memory_plan_ceiling_multiple": 1.0})
+    monkeypatch.setattr(bench, "bench_decode", lambda **k: {
+        "decode_tokens_per_s": 1.0, "decode_speedup_x": 2.0})
+    monkeypatch.setattr(bench, "bench_spec_decode", lambda **k: {
+        "decode_spec_speedup_x": 1.5, "decode_accept_rate": 0.95})
+    monkeypatch.setattr(bench, "bench_lifecycle", lambda **k: {
+        "lifecycle_drain_p99_ms": 1.0, "lifecycle_swap_dropped": 0,
+        "lifecycle_soak_goodput": 1.0})
     for argv, expect_extra in ((["bench.py", "--fast"], False),
                                (["bench.py"], True)):
         bench._RESULTS.clear()

@@ -318,3 +318,57 @@ def test_spec_validates_vocab_k_and_verify_fn(model):
 
     with pytest.raises(ValueError, match="verify_fn"):
         _engine(_Shim(model), draft=model)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: preemption landing inside a speculative chunk
+
+
+def test_preempt_between_draft_and_verify_requeues_bit_identical(
+        model, spec_eng):
+    """A preemption drain lands at the worst possible instant: after
+    the draft scan proposed a chunk but before the target verified it.
+    The disown must reclaim the slot and both KV ledgers cleanly (the
+    settle loop skips disowned slots; the in-flight verify's writes
+    die with the ledger), and the requeued request must regenerate a
+    bit-identical stream on the adopting replica."""
+    a = _engine(model, draft=model)
+    a.warmup()
+    fut = a.submit([9, 4, 17, 2], max_new_tokens=12,
+                   sampling={"temperature": 0.9, "top_p": 0.95},
+                   seed=88)
+    a.tick()                           # prefill seats the sequence
+    assert not fut.done() and a.pool.used_slots() == 1
+
+    orig = a._get_verify
+    moved = []
+
+    def hijack(cap):
+        real = orig(cap)
+
+        def wrapper(*args, **kw):
+            if not moved:              # the notice arrives mid-chunk
+                moved.extend(a.disown_inflight())
+            return real(*args, **kw)   # verify runs against a dead slot
+        return wrapper
+
+    a._get_verify = hijack
+    for _ in range(4):
+        a.tick()
+        if moved:
+            break
+    assert len(moved) == 1 and not fut.done()
+    # ledger rollback: slot freed, both arenas read empty for it
+    assert a.pool.used_slots() == 0
+    assert all(s.req is None for s in a._slots)
+    assert a.pool.length(0) == 0 and a.draft_pool.length(0) == 0
+    # the engine survived verifying into the disowned slot
+    a.tick()
+    a.close(drain=False)
+
+    spec_eng.requeue(moved)
+    got = _drive(spec_eng, fut)[0]
+    want = _drive(spec_eng, spec_eng.submit(
+        [9, 4, 17, 2], max_new_tokens=12,
+        sampling={"temperature": 0.9, "top_p": 0.95}, seed=88))[0]
+    np.testing.assert_array_equal(got, want)
