@@ -705,6 +705,42 @@ def bench_lifecycle(timeout_s=900):
     }
 
 
+def bench_fleet_telemetry(timeout_s=600):
+    """Fleet telemetry stage: runs scripts/telemetry_smoke.py (a
+    4-process decode fleet publishing snapshots, with one straggler and
+    one compile-storm worker injected) and banks the plane's two costs:
+    the CPU a worker burns publishing snapshots as a percentage of its
+    run (must stay tiny — this is the price every fleet member pays)
+    and the wall-clock from load start to the first anomaly alert
+    firing (the page-the-operator latency). Both band wide in the
+    sentinel — they are wall-clock on a shared box — but the gates_pass
+    bit is exact: merge oracle, alert discipline, goodput
+    reconciliation, and disabled-mode silence all held."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    here = os.path.dirname(os.path.abspath(__file__))
+    smoke = os.path.join(here, "scripts", "telemetry_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_telemetry", "--fast"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"telemetry_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    return {
+        "fleet_agg_overhead_pct": r["fleet_agg_overhead_pct"],
+        "alert_detection_latency_s": r["alert_detection_latency_s"],
+        "fleet_sources": r["sources"],
+        "telemetry_gates_pass": bool(r["ok"]),
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -1210,6 +1246,17 @@ def main():
                   f"soak_goodput={lcy['lifecycle_soak_goodput']}",
                   flush=True)
             _RESULTS.update(lcy)
+        try:
+            tlm = bench_fleet_telemetry()
+        except Exception as e:
+            print(f"fleet telemetry bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial fleet_agg_overhead_pct="
+                  f"{tlm['fleet_agg_overhead_pct']} "
+                  f"alert_latency_s="
+                  f"{tlm['alert_detection_latency_s']}", flush=True)
+            _RESULTS.update(tlm)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

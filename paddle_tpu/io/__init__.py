@@ -243,10 +243,16 @@ class CheckpointManager:
             if extra:
                 state["extra"] = extra
             from . import sharded as _sharded
+            _t0_save = time.perf_counter()
             with _monitor.trace.span("checkpoint.save", step=step,
                                      sharded=True):
                 _sharded.save_state(self._sharded_path(step), state,
                                     step=step)
+            if _monitor.enabled():
+                # wall seconds the train loop spent inside the save —
+                # the checkpoint category of the goodput ledger
+                _monitor.counter("ckpt.save_s").inc(
+                    time.perf_counter() - _t0_save)
             self._valid_cache.pop(step, None)
             self._gc()
             return
@@ -275,6 +281,7 @@ class CheckpointManager:
                 f.flush()
                 os.fsync(f.fileno())
 
+        _t0_save = time.perf_counter()
         with _monitor.trace.span("checkpoint.save", step=step):
             _retry.retry_call(_write, label="ckpt_save")
             digest = _sha256_file(tmp)
@@ -284,6 +291,9 @@ class CheckpointManager:
             # to verifying by unpickling
             with open(path + ".sha256", "w", encoding="utf-8") as f:
                 f.write(digest + "\n")
+        if _monitor.enabled():
+            _monitor.counter("ckpt.save_s").inc(
+                time.perf_counter() - _t0_save)
         self._valid_cache.pop(step, None)
         self._gc()
 
@@ -463,6 +473,7 @@ class CheckpointManager:
                 return None
         sharded = self._has_sharded(chosen) and not os.path.exists(
             self._path(chosen))
+        _t0_restore = time.perf_counter()
         if sharded:
             from . import sharded as _sharded
             from ..parallel import collective as _collective
@@ -475,6 +486,11 @@ class CheckpointManager:
             with _monitor.trace.span("checkpoint.restore", step=chosen):
                 state = _retry.retry_call(
                     load, self._path(chosen), label="ckpt_load")
+        if _monitor.enabled():
+            # restores happen on resume/rollback — the goodput ledger's
+            # restart_rollback category
+            _monitor.counter("ckpt.restore_s").inc(
+                time.perf_counter() - _t0_restore)
         if model is not None and "model" in state:
             model.set_state_dict(state["model"])
         if optimizer is not None and "optimizer" in state:

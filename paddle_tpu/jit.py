@@ -270,11 +270,17 @@ class StaticFunction:
             # AOT the fresh entry (the compile the first call pays
             # anyway) so monitor.xla records its measured flops/bytes;
             # any failure keeps the original jitted callable
+            import time as _time
+            _t0_compile = _time.perf_counter()
             with _monitor.trace.span("jit.aot_capture", fn=fn_label):
                 entry["uncompiled"] = entry["jitted"]
                 entry["jitted"] = _monitor.xla.aot_capture(
                     entry["jitted"], f"jit.{fn_label}",
                     (state_vals, arrays))
+            # wall seconds the AOT compile cost — the goodput ledger's
+            # compile category (monitor/step.py)
+            _monitor.counter("jit.compile_s").inc(
+                _time.perf_counter() - _t0_compile)
         with _monitor.trace.span(f"jit.{fn_label}"):
             try:
                 out_arrays, new_state = entry["jitted"](state_vals, arrays)

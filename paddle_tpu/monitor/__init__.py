@@ -179,7 +179,8 @@ import time
 from .registry import Registry, JsonlSink, read_jsonl  # noqa: F401
 from .step import (StepMonitor, mfu, peak_flops_for_device,  # noqa: F401
                    transformer_train_flops_per_token,
-                   device_memory_stats,
+                   device_memory_stats, GoodputLedger,
+                   GOODPUT_CATEGORIES,
                    BERT_BASE_PARAMS, RESNET50_TRAIN_FLOPS_PER_IMAGE)
 
 __all__ = [
@@ -187,8 +188,9 @@ __all__ = [
     "histogram", "emit", "snapshot", "reset", "jsonl_path",
     "record_collective", "StepMonitor", "mfu", "peak_flops_for_device",
     "transformer_train_flops_per_token", "device_memory_stats",
+    "GoodputLedger", "GOODPUT_CATEGORIES",
     "read_jsonl", "trace", "xla", "serve", "export", "sampler",
-    "profile", "memory",
+    "profile", "memory", "fleet", "alerts",
 ]
 
 _registry = Registry()
@@ -222,12 +224,20 @@ def _resolve_sink_path(path):
     return os.path.join(p, f"events-{os.getpid()}.jsonl")
 
 
-def enable(path=None, time_dispatch=None):
+def enable(path=None, time_dispatch=None, max_bytes=None,
+           telemetry_dir=None):
     """Turn monitoring on. `path` is a directory (an events-<pid>.jsonl
     file is created inside) or a *.jsonl file path; default is
     $PADDLE_TPU_MONITOR_DIR, and with neither the registry collects
     in-memory only. time_dispatch=True additionally histograms host-side
     per-op dispatch latency ($PADDLE_TPU_MONITOR_TIME_DISPATCH).
+    max_bytes caps the JSONL sink — past it the file rotates to
+    ``.1``/``.2`` instead of growing unbounded
+    ($PADDLE_TPU_MONITOR_MAX_BYTES). telemetry_dir arms the fleet
+    snapshot publisher: this process periodically drops an atomic
+    metrics snapshot a FleetAggregator in any process can merge
+    ($PADDLE_TPU_TELEMETRY_DIR; see monitor/fleet.py). Without it, no
+    publisher thread starts and no snapshot files are written.
     Returns the JSONL path (or None). Idempotent; a new path replaces
     the old sink."""
     global _enabled, _sink, _time_dispatch
@@ -235,18 +245,27 @@ def enable(path=None, time_dispatch=None):
         time_dispatch = os.environ.get(
             "PADDLE_TPU_MONITOR_TIME_DISPATCH", "") not in ("", "0")
     _time_dispatch = bool(time_dispatch)
+    if max_bytes is None:
+        env = os.environ.get("PADDLE_TPU_MONITOR_MAX_BYTES", "")
+        max_bytes = int(env) if env else None
 
     target = path or os.environ.get("PADDLE_TPU_MONITOR_DIR")
     if target:
         fp = _resolve_sink_path(target)
-        if _sink is None or _sink.path != os.path.abspath(fp):
+        if (_sink is None or _sink.path != os.path.abspath(fp)
+                or _sink.max_bytes != max_bytes):
             # close the previous sink BEFORE installing the new one — a
             # re-enable with a new path must not leak the old file handle
             old, _sink = _sink, None
             if old is not None:
                 old.close()
-            _sink = JsonlSink(fp)
+            _sink = JsonlSink(fp, max_bytes=max_bytes)
     _enabled = True
+
+    telemetry_target = telemetry_dir or os.environ.get(
+        "PADDLE_TPU_TELEMETRY_DIR")
+    if telemetry_target:
+        fleet.start_publisher(telemetry_target)
 
     if os.environ.get("PADDLE_TPU_TRACE", "") not in ("", "0"):
         trace.enable()
@@ -278,6 +297,8 @@ def disable(flush_counters=True):
     dispatch.install_monitor_hook(None)
     sampler.stop()
     export.stop()
+    fleet.stop_publisher()
+    fleet.stop_server()
     _enabled = False
     if _sink is not None:
         _sink.close()
@@ -361,4 +382,5 @@ def record_collective(op, axis_name, nbytes):
 
 # imported last: the submodules reach back into this namespace
 # (gauge/emit/snapshot), which is fully populated by this point
-from . import trace, xla, export, sampler, profile, memory  # noqa: E402,F401
+from . import (trace, xla, export, sampler, profile,  # noqa: E402,F401
+               memory, fleet, alerts)

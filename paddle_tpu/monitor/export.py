@@ -269,9 +269,22 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 self._send(200, json.dumps(snapshot_payload(),
                                            default=str),
                            "application/json")
+            elif path == "/fleet":
+                # merged cross-process rollup — only when this process
+                # hosts a FleetAggregator (monitor/fleet.py)
+                from . import fleet as _fleet
+                agg = _fleet.active_aggregator()
+                if agg is None:
+                    self._send(404, "no fleet aggregator in this "
+                                    "process\n",
+                               "text/plain; charset=utf-8")
+                else:
+                    self._send(200, json.dumps(agg.payload(),
+                                               default=str),
+                               "application/json")
             elif path == "/":
                 self._send(200, "paddle_tpu telemetry: "
-                                "/metrics /healthz /snapshot\n",
+                                "/metrics /healthz /snapshot /fleet\n",
                            "text/plain; charset=utf-8")
             else:
                 self._send(404, "not found\n",

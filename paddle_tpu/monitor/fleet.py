@@ -1,0 +1,570 @@
+"""paddle_tpu.monitor.fleet — the cross-process telemetry plane.
+
+Every instrument below this module is per-process: one Registry, one
+JSONL sink, one ``/metrics`` endpoint per PID. The pod-scale fleets the
+serving tier replicates toward (ROADMAP item 3) need *fleet* answers —
+"what is the fleet's p99 TTFT", "which replica is the straggler" — and
+those are only computable from merged raw distributions, never from
+averaging per-process percentiles. This module is the wire + merge
+layer:
+
+* **Snapshot publishing** — :class:`SnapshotPublisher` (armed by
+  ``monitor.enable(telemetry_dir=...)`` or ``PADDLE_TPU_TELEMETRY_DIR``)
+  periodically writes ``Registry.export_snapshot()`` — a versioned JSON
+  body carrying counters, gauges, and *full-bounds* histogram exports —
+  to ``<dir>/snap-<source>.json`` via tmp-file + ``os.replace``, so a
+  reader never sees a torn snapshot. Disabled mode stays disabled: no
+  thread, zero files.
+* **Merging** — :class:`FleetAggregator` scrapes the directory and
+  folds every fresh snapshot into fleet series: counters **sum**,
+  gauges are **last-write-wins** by snapshot timestamp (and a source
+  past ``staleness_ttl_s`` drops out of the rollup entirely — a dead
+  replica must not pin its final gauges into the fleet view forever),
+  histograms merge **bucket-wise** — legal exactly because every
+  serving latency histogram shares :data:`~paddle_tpu.serving.metrics.
+  LATENCY_BUCKETS_MS` bounds (asserted by tests/test_fleet.py, and by
+  :func:`merge_histograms` itself at merge time). Fleet percentiles
+  come from the merged bucket ladder: within one bucket width of the
+  true union-of-events percentile.
+* **Serving** — :func:`serve` starts an HTTP server whose ``/metrics``
+  renders the *merged* registry as OpenMetrics and whose ``/fleet``
+  returns the JSON rollup (per-source freshness, merged counters,
+  fleet percentiles). A process-local exporter also answers ``/fleet``
+  when this process hosts an aggregator (monitor/export.py routes it
+  here).
+
+Cost discipline: nothing in this module runs until a telemetry dir is
+armed — no thread, no file I/O, no hot-path check anywhere. The
+publisher's only steady-state cost is one ``export_snapshot()`` +
+atomic file write per ``interval_s`` (its cumulative write time is
+tracked in :func:`publisher_stats` — the telemetry smoke gate holds it
+under 1% of wall time).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from .registry import Registry, SNAPSHOT_FORMAT_VERSION
+
+__all__ = [
+    "SNAPSHOT_PREFIX", "snapshot_path", "write_snapshot",
+    "read_snapshots", "merge_histograms", "histogram_percentile",
+    "FleetAggregator", "SnapshotPublisher", "start_publisher",
+    "stop_publisher", "publisher_active", "publisher_stats",
+    "serve", "stop_server", "active_aggregator",
+    "DEFAULT_PUBLISH_INTERVAL_S", "DEFAULT_STALENESS_TTL_S",
+]
+
+SNAPSHOT_PREFIX = "snap-"
+DEFAULT_PUBLISH_INTERVAL_S = 1.0
+DEFAULT_STALENESS_TTL_S = 15.0
+
+
+# ---------------------------------------------------------------------------
+# snapshot files
+
+def snapshot_path(telemetry_dir, source):
+    """Where one process's snapshot lives. ``source`` must be filename
+    safe; the default (``pid-<pid>``) is."""
+    safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                   for c in str(source))
+    return os.path.join(telemetry_dir, f"{SNAPSHOT_PREFIX}{safe}.json")
+
+
+def write_snapshot(telemetry_dir, source=None, registry=None):
+    """Atomically publish one snapshot: serialize to ``.tmp`` in the
+    same directory, then ``os.replace`` over the final name — a
+    concurrent scrape sees either the old complete snapshot or the new
+    complete one, never a torn write. Returns the final path."""
+    from .. import monitor as _mon
+    reg = registry if registry is not None else _mon.registry()
+    os.makedirs(telemetry_dir, exist_ok=True)
+    snap = reg.export_snapshot(source=source)
+    path = snapshot_path(telemetry_dir, snap["source"])
+    tmp = f"{path}.tmp.{os.getpid()}"
+    body = json.dumps(snap, default=str)
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(body)
+    os.replace(tmp, path)
+    return path
+
+
+def read_snapshots(telemetry_dir):
+    """Every parseable, format-compatible snapshot in the directory.
+    Unparseable files (a writer killed pre-replace never leaves one,
+    but a foreign file might) and other format generations are skipped,
+    not raised — the aggregator must keep serving through one bad
+    source."""
+    out = []
+    try:
+        names = sorted(os.listdir(telemetry_dir))
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(SNAPSHOT_PREFIX)
+                and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(telemetry_dir, name),
+                      encoding="utf-8") as fh:
+                snap = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if snap.get("format_version") != SNAPSHOT_FORMAT_VERSION:
+            continue
+        out.append(snap)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+
+def merge_histograms(a, b):
+    """Bucket-wise merge of two ``Histogram.export()`` dicts. Exact —
+    the merged ladder is what one histogram observing the union of both
+    event streams would hold — and only legal when the bounds agree,
+    which is asserted, not assumed."""
+    if list(a["bounds"]) != list(b["bounds"]):
+        raise ValueError(
+            "histogram merge with mismatched bucket bounds: "
+            f"{len(a['bounds'])} vs {len(b['bounds'])} bounds "
+            f"({a['bounds'][:3]}... vs {b['bounds'][:3]}...)")
+    mins = [m for m in (a["min"], b["min"]) if m is not None]
+    maxs = [m for m in (a["max"], b["max"]) if m is not None]
+    return {"bounds": list(a["bounds"]),
+            "counts": [x + y for x, y in zip(a["counts"], b["counts"])],
+            "count": a["count"] + b["count"],
+            "sum": a["sum"] + b["sum"],
+            "min": min(mins) if mins else None,
+            "max": max(maxs) if maxs else None}
+
+
+def histogram_percentile(export, q):
+    """Percentile estimate off a bucket ladder: the upper bound of the
+    bucket where the cumulative count crosses ``q * count`` (overflow
+    bucket reports the observed max). Always within one bucket width of
+    the true population percentile — the resolution guarantee the
+    telemetry smoke gate checks against its union-of-events oracle."""
+    total = export["count"]
+    if not total:
+        return None
+    # same nearest-rank convention as serving.metrics._percentile, so
+    # the fleet estimate and the union-of-events oracle pick the same
+    # sample's bucket
+    target = min(total - 1, int(round(q * (total - 1)))) + 1
+    cum = 0
+    for i, c in enumerate(export["counts"]):
+        cum += c
+        if cum >= target:
+            if i < len(export["bounds"]):
+                return float(export["bounds"][i])
+            return float(export["max"]) if export["max"] is not None \
+                else None
+    return float(export["max"]) if export["max"] is not None else None
+
+
+def _merge_snapshots(snaps, now=None, staleness_ttl_s=None):
+    """Fold snapshots into (merged dict, per-source meta). Stale
+    sources (snapshot ``ts`` older than the TTL) are listed in the meta
+    but contribute nothing to the merge."""
+    now = time.time() if now is None else now
+    counters, histograms = {}, {}
+    gauges = {}           # name -> (ts, value)
+    sources = []
+    for snap in sorted(snaps, key=lambda s: s.get("ts", 0.0)):
+        ts = float(snap.get("ts", 0.0))
+        age = now - ts
+        stale = (staleness_ttl_s is not None
+                 and age > float(staleness_ttl_s))
+        sources.append({"source": snap.get("source"),
+                        "pid": snap.get("pid"),
+                        "ts": ts, "age_s": round(age, 3),
+                        "stale": stale})
+        if stale:
+            continue
+        for name, v in snap.get("counters", {}).items():
+            counters[name] = counters.get(name, 0) + v
+        for name, v in snap.get("gauges", {}).items():
+            prev = gauges.get(name)
+            if prev is None or ts >= prev[0]:
+                gauges[name] = (ts, v)
+        for name, h in snap.get("histograms", {}).items():
+            histograms[name] = (merge_histograms(histograms[name], h)
+                                if name in histograms else
+                                {"bounds": list(h["bounds"]),
+                                 "counts": list(h["counts"]),
+                                 "count": h["count"], "sum": h["sum"],
+                                 "min": h["min"], "max": h["max"]})
+    merged = {"counters": counters,
+              "gauges": {n: v for n, (_, v) in gauges.items()},
+              "histograms": histograms}
+    return merged, sources
+
+
+class FleetAggregator:
+    """Scrape-and-merge over a telemetry directory. ``scrape()``
+    refreshes the merged view; ``registry()`` materializes it as a
+    plain :class:`Registry` (what the merged ``/metrics`` endpoint
+    renders); ``payload()`` is the ``/fleet`` JSON body."""
+
+    def __init__(self, telemetry_dir,
+                 staleness_ttl_s=DEFAULT_STALENESS_TTL_S):
+        self.telemetry_dir = str(telemetry_dir)
+        self.staleness_ttl_s = float(staleness_ttl_s)
+        self._lock = threading.Lock()
+        self._merged = {"counters": {}, "gauges": {}, "histograms": {}}
+        self._sources = []
+        self._snaps = []
+        self._last_scrape = None
+        self.scrapes = 0
+
+    def scrape(self, now=None):
+        """Read every snapshot and rebuild the merged view. Returns the
+        merged dict. Cheap enough to call per poll tick — the cost is
+        one ``json.load`` per live source."""
+        now = time.time() if now is None else now
+        snaps = read_snapshots(self.telemetry_dir)
+        merged, sources = _merge_snapshots(
+            snaps, now=now, staleness_ttl_s=self.staleness_ttl_s)
+        fresh = [s for s in snaps
+                 if now - float(s.get("ts", 0.0)) <= self.staleness_ttl_s]
+        with self._lock:
+            self._merged = merged
+            self._sources = sources
+            self._snaps = fresh
+            self._last_scrape = time.time()
+            self.scrapes += 1
+        return merged
+
+    def source_snapshots(self):
+        """The raw fresh (non-stale) snapshots from the last scrape —
+        the per-source view the anomaly detector diffs tick-over-tick
+        (a merged rollup can say the fleet got slower; only per-source
+        data can say *which replica*)."""
+        with self._lock:
+            return list(self._snaps)
+
+    def merged(self):
+        with self._lock:
+            return self._merged
+
+    def sources(self):
+        """Per-source freshness meta from the last scrape (stale
+        sources included, flagged)."""
+        with self._lock:
+            return list(self._sources)
+
+    def value(self, name, default=0):
+        """Merged scalar for one counter/gauge (counters win on a name
+        collision, which the dotted naming scheme never produces)."""
+        with self._lock:
+            m = self._merged
+            if name in m["counters"]:
+                return m["counters"][name]
+            return m["gauges"].get(name, default)
+
+    def histogram(self, name):
+        """The merged export dict for one histogram, or None."""
+        with self._lock:
+            return self._merged["histograms"].get(name)
+
+    def percentile(self, name, q):
+        h = self.histogram(name)
+        return histogram_percentile(h, q) if h is not None else None
+
+    def registry(self):
+        """The merged view as a Registry (for OpenMetrics rendering).
+        Rebuilt per call — the merge is the source of truth, not this
+        materialization."""
+        with self._lock:
+            merged = self._merged
+            reg = Registry()
+            for name, v in merged["counters"].items():
+                reg.counter(name).inc(v)
+            for name, v in merged["gauges"].items():
+                try:
+                    reg.gauge(name).set(v)
+                except (TypeError, ValueError):
+                    continue
+            for name, h in merged["histograms"].items():
+                hist = reg.histogram(name, buckets=h["bounds"])
+                hist._counts = list(h["counts"])
+                hist.count = h["count"]
+                hist.sum = h["sum"]
+                hist.min = h["min"]
+                hist.max = h["max"]
+        return reg
+
+    def payload(self):
+        """The ``/fleet`` body: source freshness + merged series, with
+        fleet p50/p99 precomputed for every merged histogram."""
+        with self._lock:
+            merged = self._merged
+            sources = list(self._sources)
+            last = self._last_scrape
+        percentiles = {
+            name: {"p50": histogram_percentile(h, 0.50),
+                   "p99": histogram_percentile(h, 0.99),
+                   "count": h["count"], "sum": h["sum"],
+                   "min": h["min"], "max": h["max"]}
+            for name, h in merged["histograms"].items()}
+        return {"ts": time.time(), "last_scrape": last,
+                "telemetry_dir": self.telemetry_dir,
+                "staleness_ttl_s": self.staleness_ttl_s,
+                "sources": sources,
+                "live_sources": sum(1 for s in sources
+                                    if not s["stale"]),
+                "counters": merged["counters"],
+                "gauges": merged["gauges"],
+                "percentiles": percentiles}
+
+
+# ---------------------------------------------------------------------------
+# the publisher daemon (worker side)
+
+class SnapshotPublisher:
+    """Daemon thread writing this process's snapshot every
+    ``interval_s``, plus once at ``stop()`` so a clean shutdown always
+    leaves the final counter values on disk. Tracks its own cumulative
+    write time — the overhead ledger the smoke gate reads."""
+
+    def __init__(self, telemetry_dir, source=None,
+                 interval_s=DEFAULT_PUBLISH_INTERVAL_S):
+        self.telemetry_dir = str(telemetry_dir)
+        self.source = source
+        self.interval_s = float(interval_s)
+        self.writes = 0
+        self.write_s = 0.0       # wall span (includes GIL/sched waits)
+        self.write_cpu_s = 0.0   # CPU actually burned publishing — the
+        self._stop = threading.Event()   # overhead the smoke gate bills
+        self._thread = None
+
+    def publish_once(self):
+        t0 = time.perf_counter()
+        c0 = time.thread_time()
+        path = write_snapshot(self.telemetry_dir, source=self.source)
+        self.write_cpu_s += time.thread_time() - c0
+        self.write_s += time.perf_counter() - t0
+        self.writes += 1
+        return path
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="paddle_tpu-fleet-publish",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0, final=True):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+            self._thread = None
+        if final:
+            try:
+                self.publish_once()
+            except OSError:
+                pass
+
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self):
+        while True:
+            try:
+                self.publish_once()
+            except OSError:
+                pass  # a full disk must not kill the worker
+            if self._stop.wait(self.interval_s):
+                return
+
+
+_lock = threading.Lock()
+_publisher = None
+_aggregator = None
+_server = None
+
+
+def start_publisher(telemetry_dir, source=None, interval_s=None):
+    """Arm (or return) the process publisher singleton — called by
+    ``monitor.enable(telemetry_dir=...)``. Re-arming with a different
+    directory replaces the publisher."""
+    global _publisher
+    if interval_s is None:
+        env = os.environ.get("PADDLE_TPU_TELEMETRY_INTERVAL_S", "")
+        interval_s = float(env) if env else DEFAULT_PUBLISH_INTERVAL_S
+    if source is None:
+        source = os.environ.get("PADDLE_TPU_TELEMETRY_SOURCE") or None
+    with _lock:
+        pub = _publisher
+        if (pub is not None
+                and pub.telemetry_dir == str(telemetry_dir)
+                and pub.running()):
+            return pub
+        if pub is not None:
+            pub.stop(final=False)
+        _publisher = SnapshotPublisher(
+            telemetry_dir, source=source,
+            interval_s=interval_s).start()
+        return _publisher
+
+
+def stop_publisher(timeout=5.0):
+    """Stop + join the publisher (idempotent), writing one final
+    snapshot so the aggregator sees the run's end state."""
+    global _publisher
+    with _lock:
+        pub, _publisher = _publisher, None
+    if pub is not None:
+        pub.stop(timeout=timeout)
+
+
+def publisher_active():
+    pub = _publisher
+    return pub is not None and pub.running()
+
+
+def publisher_stats():
+    """{"writes", "write_s", "interval_s"} for the live publisher, or
+    None — the aggregation-overhead evidence the smoke gate banks."""
+    pub = _publisher
+    if pub is None:
+        return None
+    return {"writes": pub.writes, "write_s": round(pub.write_s, 6),
+            "write_cpu_s": round(pub.write_cpu_s, 6),
+            "interval_s": pub.interval_s}
+
+
+# ---------------------------------------------------------------------------
+# the aggregator HTTP plane
+
+def active_aggregator():
+    """The aggregator this process hosts (via :func:`serve`), or None —
+    monitor/export.py routes its ``/fleet`` endpoint here."""
+    return _aggregator
+
+
+def serve(telemetry_dir, port=0, host="127.0.0.1",
+          staleness_ttl_s=DEFAULT_STALENESS_TTL_S, scrape_interval_s=1.0):
+    """Start the fleet aggregation server: a FleetAggregator scraping
+    ``telemetry_dir`` every ``scrape_interval_s`` plus an HTTP server
+    whose ``/metrics`` is the *merged* registry rendered as OpenMetrics
+    and whose ``/fleet`` is the JSON rollup. Returns (aggregator,
+    server). Idempotent per process."""
+    global _aggregator, _server
+    with _lock:
+        if _server is not None:
+            return _aggregator, _server
+        agg = FleetAggregator(telemetry_dir,
+                              staleness_ttl_s=staleness_ttl_s)
+        agg.scrape()
+        srv = _FleetServer(agg, port=port, host=host,
+                           scrape_interval_s=scrape_interval_s)
+        srv.start()
+        _aggregator, _server = agg, srv
+    from .. import monitor as _mon
+    _mon.emit(kind="fleet", action="serve", dir=str(telemetry_dir),
+              host=srv.host, port=srv.port)
+    return agg, srv
+
+
+def stop_server(timeout=5.0):
+    """Tear down the fleet server + its scrape loop (idempotent)."""
+    global _aggregator, _server
+    with _lock:
+        srv, _server = _server, None
+        _aggregator = None
+    if srv is not None:
+        srv.stop(timeout=timeout)
+
+
+class _FleetServer:
+    """ThreadingHTTPServer on a daemon thread serving the merged view,
+    with a sidecar scrape loop keeping the aggregator fresh."""
+
+    def __init__(self, aggregator, port=0, host="127.0.0.1",
+                 scrape_interval_s=1.0):
+        import http.server
+        from . import export as _export
+        agg = aggregator
+
+        class Handler(_export._Handler):
+            def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    if path == "/metrics":
+                        self._send(200, _export.render_openmetrics(
+                            registry=agg.registry()),
+                            _export.OPENMETRICS_CONTENT_TYPE)
+                    elif path == "/fleet":
+                        self._send(200, json.dumps(agg.payload(),
+                                                   default=str),
+                                   "application/json")
+                    elif path == "/":
+                        self._send(200, "paddle_tpu fleet telemetry: "
+                                        "/metrics /fleet\n",
+                                   "text/plain; charset=utf-8")
+                    else:
+                        self._send(404, "not found\n",
+                                   "text/plain; charset=utf-8")
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                except Exception as e:  # noqa: BLE001 - scrape must not crash
+                    try:
+                        self._send(500, f"fleet telemetry error: {e!r}\n",
+                                   "text/plain; charset=utf-8")
+                    except Exception:
+                        pass
+
+        self.aggregator = aggregator
+        self.scrape_interval_s = float(scrape_interval_s)
+        self._httpd = http.server.ThreadingHTTPServer(
+            (host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = int(self._httpd.server_address[1])
+        self._thread = None
+        self._scraper = None
+        self._stop = threading.Event()
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}"
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name="paddle_tpu-fleet", daemon=True)
+            self._thread.start()
+            self._scraper = threading.Thread(
+                target=self._scrape_loop,
+                name="paddle_tpu-fleet-scrape", daemon=True)
+            self._scraper.start()
+        return self
+
+    def _scrape_loop(self):
+        while not self._stop.wait(self.scrape_interval_s):
+            try:
+                self.aggregator.scrape()
+            except Exception:
+                pass  # one bad snapshot file must not kill the plane
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        try:
+            self._httpd.shutdown()
+        finally:
+            self._httpd.server_close()
+        for t in (self._thread, self._scraper):
+            if t is not None:
+                t.join(timeout=timeout)
+        self._thread = self._scraper = None

@@ -78,6 +78,11 @@ class Gauge:
 # default bounds cover ns-scale timings through multi-GB byte counts
 _DEFAULT_BUCKETS = tuple(4.0 ** e for e in range(-10, 18))
 
+#: version stamp on every cross-process snapshot (Registry.export_snapshot);
+#: the fleet aggregator skips snapshots from a different format generation
+#: instead of mis-merging them
+SNAPSHOT_FORMAT_VERSION = 1
+
 
 class Histogram:
     """Bucketed distribution: count/sum/min/max plus cumulative-style
@@ -138,6 +143,18 @@ class Histogram:
             for i, c in enumerate(self._counts) if c}
         return out
 
+    def export(self):
+        """Mergeable full-fidelity view for the fleet telemetry plane:
+        EVERY bound (not just populated ones — two exports merge
+        bucket-wise only when their bounds align) plus per-bucket raw
+        (non-cumulative) counts, read atomically under the lock. The
+        inverse/merge helpers live in monitor/fleet.py."""
+        with self._lock:
+            return {"bounds": list(self.buckets),
+                    "counts": list(self._counts),
+                    "count": self.count, "sum": self.sum,
+                    "min": self.min, "max": self.max}
+
 
 class Registry:
     """Name → metric store. One RLock guards creation and every
@@ -169,6 +186,24 @@ class Registry:
     def get(self, name):
         return self._metrics.get(name)
 
+    def remove(self, name):
+        """Drop one metric by exact name (stale-gauge hygiene: a closed
+        replica's per-replica gauges must not linger in rollups forever).
+        Returns True when something was removed."""
+        with self._lock:
+            return self._metrics.pop(name, None) is not None
+
+    def clear_prefix(self, prefix):
+        """Drop every metric under a dotted prefix (a replica's whole
+        per-source series family in one call). Returns how many went."""
+        if not prefix:
+            return 0
+        with self._lock:
+            doomed = [n for n in self._metrics if n.startswith(prefix)]
+            for n in doomed:
+                del self._metrics[n]
+        return len(doomed)
+
     def value(self, name, default=0):
         """Current scalar for a counter/gauge; a histogram (which has no
         single value) returns its snapshot dict. Missing -> default."""
@@ -188,6 +223,31 @@ class Registry:
         with self._lock:
             return {n: m.snapshot() for n, m in sorted(self._metrics.items())
                     if n.startswith(prefix)}
+
+    def export_snapshot(self, source=None, prefix=""):
+        """The versioned cross-process snapshot body the fleet
+        aggregation plane ships between processes: counters and gauges
+        as scalars, histograms as full-bounds :meth:`Histogram.export`
+        dicts (mergeable). ``source`` labels the producing process;
+        the aggregator trusts ``ts`` for gauge last-write-wins and
+        staleness aging. See monitor/fleet.py for the file protocol."""
+        with self._lock:
+            items = sorted((n, m) for n, m in self._metrics.items()
+                           if n.startswith(prefix))
+        counters, gauges, histograms = {}, {}, {}
+        for name, m in items:
+            if isinstance(m, Histogram):
+                histograms[name] = m.export()
+            elif isinstance(m, Counter):
+                counters[name] = m.value
+            elif m.value is not None:
+                gauges[name] = m.value
+        return {"format_version": SNAPSHOT_FORMAT_VERSION,
+                "source": str(source) if source is not None
+                else f"pid-{os.getpid()}",
+                "pid": os.getpid(), "ts": time.time(),
+                "counters": counters, "gauges": gauges,
+                "histograms": histograms}
 
     def collect(self):
         """Exporter feed: ``[(name, kind, payload), ...]`` sorted by
@@ -211,18 +271,44 @@ class Registry:
             self._metrics.clear()
 
 
+#: how many rotated generations a size-capped JsonlSink keeps
+#: (events.jsonl -> events.jsonl.1 -> events.jsonl.2 -> dropped)
+SINK_ROTATIONS = 2
+
+
 class JsonlSink:
     """Append-only JSONL event writer. Every record gets a wall-clock
     ``ts``; writes are line-atomic under a lock and flushed eagerly so a
-    killed run keeps everything emitted before the kill."""
+    killed run keeps everything emitted before the kill.
 
-    def __init__(self, path):
+    ``max_bytes`` caps the live file: once an emit pushes it past the
+    cap the file rotates (``path`` -> ``path.1`` -> ``path.2``, oldest
+    dropped) and ``path`` reopens fresh. ``self.path`` never changes
+    across a rotation — the flight recorder and ``jsonl_path()`` keep
+    pointing at the live file, so a soak-length chaos run rotates
+    underneath them instead of growing without bound."""
+
+    def __init__(self, path, max_bytes=None):
         self.path = os.path.abspath(path)
+        self.max_bytes = int(max_bytes) if max_bytes else None
+        self.rotations = 0
         parent = os.path.dirname(self.path)
         if parent:
             os.makedirs(parent, exist_ok=True)
         self._lock = threading.Lock()
         self._fh = open(self.path, "a", encoding="utf-8")
+        self._size = self._fh.tell()
+
+    def _rotate_locked(self):
+        self._fh.close()
+        for gen in range(SINK_ROTATIONS, 1, -1):
+            older = f"{self.path}.{gen - 1}"
+            if os.path.exists(older):
+                os.replace(older, f"{self.path}.{gen}")
+        os.replace(self.path, f"{self.path}.1")
+        self._fh = open(self.path, "a", encoding="utf-8")
+        self._size = 0
+        self.rotations += 1
 
     def emit(self, record: dict):
         record.setdefault("ts", time.time())
@@ -232,6 +318,10 @@ class JsonlSink:
                 return
             self._fh.write(line + "\n")
             self._fh.flush()
+            if self.max_bytes is not None:
+                self._size += len(line) + 1
+                if self._size > self.max_bytes:
+                    self._rotate_locked()
 
     def close(self):
         with self._lock:

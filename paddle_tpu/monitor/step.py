@@ -112,6 +112,107 @@ def mfu(flops_per_step, step_time_s, peak_flops=None):
     return flops_per_step / step_time_s / peak
 
 
+#: the goodput ledger's loss categories: every second of a run that is
+#: NOT compute, attributed from series the subsystems already emit
+#: (counter values or histogram sums, all in seconds). ``compute`` is
+#: the residual — wall time no category claims — so by construction
+#: compute + losses reconcile to wall time exactly (the telemetry
+#: smoke gate still checks the reconciliation end-to-end, which catches
+#: a category double-counting overlapped time).
+GOODPUT_CATEGORIES = (
+    ("input_stall", ("prefetch.stall_seconds",)),
+    ("comm_exposed", ("comm.exposed_wait_s_total",)),
+    ("offload_wait", ("mem.offload.exposed_wait_s_total",)),
+    ("compile", ("executor.compile_s", "jit.compile_s")),
+    ("checkpoint", ("ckpt.save_s",)),
+    ("restart_rollback", ("ckpt.restore_s",)),
+)
+
+
+def _series_seconds(reg, name):
+    """Seconds held by one series right now: a counter's value, a
+    histogram's sum, 0.0 when the series doesn't exist (the subsystem
+    never ran)."""
+    m = reg.get(name)
+    if m is None:
+        return 0.0
+    if m.kind == "histogram":
+        return float(m.sum)
+    v = m.value
+    return float(v) if v is not None else 0.0
+
+
+class GoodputLedger:
+    """Attributes a run's wall time across :data:`GOODPUT_CATEGORIES`.
+
+    ``begin()`` snapshots every input series; ``finish()`` diffs them
+    against the snapshot, subtracts the per-category losses from wall
+    time, and reports ``goodput_fraction`` (= compute ÷ wall) plus the
+    ranked time-loss table. StepMonitor runs one per monitored loop;
+    it is also usable standalone around any timed region::
+
+        ledger = monitor.GoodputLedger().begin()
+        ... run ...
+        print(ledger.finish()["goodput_fraction"])
+    """
+
+    def __init__(self, registry=None):
+        if registry is None:
+            from .. import monitor as _mon
+            registry = _mon.registry()
+        self._reg = registry
+        self._t0 = None
+        self._base = None
+
+    def _read(self):
+        return {name: _series_seconds(self._reg, name)
+                for _, series in GOODPUT_CATEGORIES for name in series}
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        self._base = self._read()
+        return self
+
+    def finish(self, wall_s=None):
+        """The ledger dict: wall/compute seconds, goodput fraction, and
+        ``lost`` — one row per category with attributed seconds, ranked
+        worst-first (zero-loss categories included, at the tail: "this
+        was measured and clean" reads differently from "not measured")."""
+        if self._t0 is None:
+            raise RuntimeError("GoodputLedger.finish() before begin()")
+        wall = (time.perf_counter() - self._t0
+                if wall_s is None else float(wall_s))
+        cur = self._read()
+        base = self._base
+        lost = []
+        for category, series in GOODPUT_CATEGORIES:
+            seconds = sum(cur[n] - base[n] for n in series)
+            seconds = max(0.0, seconds)
+            lost.append({"category": category,
+                         "seconds": round(seconds, 6),
+                         "fraction": (round(seconds / wall, 4)
+                                      if wall > 0 else None),
+                         "series": list(series)})
+        lost.sort(key=lambda row: -row["seconds"])
+        total_lost = sum(row["seconds"] for row in lost)
+        compute = max(0.0, wall - total_lost)
+        out = {"wall_s": round(wall, 6),
+               "compute_s": round(compute, 6),
+               "lost_s": round(total_lost, 6),
+               "goodput_fraction": (round(compute / wall, 4)
+                                    if wall > 0 else None),
+               "lost": lost}
+        from . import emit, enabled, gauge
+        if enabled():
+            if out["goodput_fraction"] is not None:
+                gauge("goodput.fraction").set(out["goodput_fraction"])
+            for row in lost:
+                gauge(f"goodput.lost_s.{row['category']}").set(
+                    row["seconds"])
+            emit(kind="goodput", **out)
+        return out
+
+
 _mem_stats_warned = False
 
 
@@ -176,7 +277,7 @@ class StepMonitor:
     def __init__(self, items_per_step=None, flops_per_step=None,
                  peak_flops=None, item="items", label="train", window=1,
                  memory_every=10, measured_flops_per_step=None,
-                 xla_label=None):
+                 xla_label=None, goodput=True):
         self.items_per_step = items_per_step
         self.flops_per_step = flops_per_step
         self.peak_flops = (peak_flops if peak_flops is not None
@@ -196,6 +297,10 @@ class StepMonitor:
         self._last = None
         self._divergence_warned = False
         self._mem_peaks = {}     # device id -> last seen peak watermark
+        # the goodput ledger (category definitions above): armed at
+        # start(), settled at report() — two registry reads per run
+        self._goodput = GoodputLedger() if goodput else None
+        self._goodput_report = None
 
     def __enter__(self):
         self.start()
@@ -206,6 +311,8 @@ class StepMonitor:
 
     def start(self):
         self._last = time.perf_counter()
+        if self._goodput is not None:
+            self._goodput.begin()
         return self
 
     def step(self, items=None, loss=None, **extra):
@@ -213,6 +320,10 @@ class StepMonitor:
         now = time.perf_counter()
         if self._last is None:
             self._last = now
+            # a loop that skipped start() still gets a ledger window
+            # (first step marks its opening edge)
+            if self._goodput is not None and self._goodput._t0 is None:
+                self._goodput.begin()
             return None
         dt = now - self._last
         self._last = now
@@ -299,6 +410,14 @@ class StepMonitor:
         from . import xla as _xla
         return _xla.flops(self.xla_label)
 
+    def _settle_goodput(self):
+        """Finish the ledger exactly once (summary() and report() both
+        want it; a second finish would re-window nothing)."""
+        if (self._goodput is not None and self._goodput._t0 is not None
+                and self._goodput_report is None):
+            self._goodput_report = self._goodput.finish()
+        return self._goodput_report
+
     # -- summary ------------------------------------------------------------
     def summary(self):
         if not self.steps:
@@ -322,6 +441,9 @@ class StepMonitor:
             if m is not None:
                 out["mfu_measured"] = round(m, 4)
             out["flops_per_step_measured"] = measured
+        g = self._settle_goodput()
+        if g is not None:
+            out["goodput"] = g
         return out
 
     def report(self, print_table=True):
@@ -338,6 +460,14 @@ class StepMonitor:
             if s.get("mfu_measured") is not None:
                 rows.append(("mfu (xla-measured)",
                              f"{s['mfu_measured']:.1%}"))
+            g = s.get("goodput")
+            if g is not None and g.get("goodput_fraction") is not None:
+                rows.append(("goodput", f"{g['goodput_fraction']:.1%}"))
+                for row in g["lost"][:3]:
+                    if row["seconds"] > 0:
+                        rows.append((f"  lost: {row['category']}",
+                                     f"{row['seconds'] * 1e3:.1f} ms "
+                                     f"({row['fraction']:.1%})"))
             width = max(len(k) for k, _ in rows)
             print(f"[paddle_tpu.monitor] {self.label}")
             for k, v in rows:

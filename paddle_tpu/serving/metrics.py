@@ -417,6 +417,51 @@ def record_breaker_transition(name, old, new, reason=""):
                       old=old, new=new, reason=reason)
 
 
+def clear_replica_series(replica):
+    """Source-scoped stale-gauge hygiene: drop the per-replica gauges a
+    closed or restarted replica left behind (``serving.breaker_state.
+    <replica>`` and anything under ``serving.replica.<replica>.``) so a
+    dead replica's last breaker state can't linger in rollups forever.
+    The fleet aggregator's staleness TTL handles the cross-process
+    copy; this handles the in-process registry. Returns how many
+    metrics were dropped."""
+    if not _monitor.enabled():
+        return 0
+    reg = _monitor.registry()
+    removed = int(reg.remove(f"serving.breaker_state.{replica}"))
+    removed += reg.clear_prefix(f"serving.replica.{replica}.")
+    if removed:
+        _monitor.emit(kind="serving", event="replica_series_cleared",
+                      replica=replica, removed=removed)
+    return removed
+
+
+def assert_mergeable_latency_histograms(registry=None):
+    """Every ``*_ms`` serving/slo histogram in the registry must carry
+    exactly :data:`LATENCY_BUCKETS_MS` bounds — the invariant that
+    makes fleet bucket-wise merge legal. Raises AssertionError naming
+    the offender; returns the checked names (mergeability is asserted,
+    not assumed — tests/test_fleet.py and the telemetry smoke both
+    call this)."""
+    reg = registry if registry is not None else _monitor.registry()
+    checked = []
+    for name in reg.names():
+        if not (name.startswith(("serving.", "slo."))
+                and name.endswith("_ms")):
+            continue
+        m = reg.get(name)
+        if m is None or m.kind != "histogram":
+            continue
+        if tuple(m.buckets) != tuple(LATENCY_BUCKETS_MS):
+            raise AssertionError(
+                f"histogram {name!r} registered with "
+                f"{len(m.buckets)} non-standard bounds — fleet merge "
+                f"needs LATENCY_BUCKETS_MS ({len(LATENCY_BUCKETS_MS)} "
+                "bounds)")
+        checked.append(name)
+    return checked
+
+
 def record_hedge(replica=None):
     if _monitor.enabled():
         _monitor.counter("serving.hedged").inc()

@@ -724,6 +724,10 @@ class MultiDeviceEngine:
         replica.engine = fresh.engine
         replica.restarts += 1
         replica.restart_token = None
+        # drop the dead engine's per-replica gauges: the next sampler
+        # tick re-mints them from the live breaker, so a stale "open"
+        # from before the restart can't linger in rollups
+        metrics.clear_replica_series(replica.index)
         metrics.record_replica_restart(replica.index)
         threading.Thread(
             target=lambda: old_engine.close(drain=False, timeout=1.0),
@@ -791,6 +795,8 @@ class MultiDeviceEngine:
             if t is None and drain:
                 t = 10.0
             r.engine.close(drain=drain, timeout=t)
+            # closed replicas leave no stale per-replica gauges behind
+            metrics.clear_replica_series(r.index)
 
     def __enter__(self):
         self.start()

@@ -74,6 +74,7 @@ class ServingSupervisor:
         self._stop = threading.Event()
         self._thread = None
         self.decisions = []     # bounded local history (snapshot block)
+        self._seen_anomalies = set()  # finding names already noted
         if start:
             self.start()
 
@@ -109,10 +110,31 @@ class ServingSupervisor:
     def _decide(self, decision, **fields):
         global _LAST_DECISION
         entry = {"decision": decision, "t": time.time(), **fields}
+        # cite the anomaly board: a drain/scale verdict issued while the
+        # detector has findings in force carries WHICH anomaly was live
+        # (the "why" an operator reads off the decision ledger)
+        anomalies = self._active_anomalies()
+        if anomalies and "anomalies" not in entry:
+            entry["anomalies"] = anomalies
+            fields = dict(fields, anomalies=anomalies)
         _LAST_DECISION = entry
         self.decisions.append(entry)
         del self.decisions[:-50]
         metrics.record_supervisor(decision, **fields)
+
+    @staticmethod
+    def _active_anomalies():
+        """Names of the findings currently on the anomaly board
+        (monitor/alerts.py), lazily — supervision must not drag the
+        alerting plane in when nobody armed it."""
+        import sys
+        _alerts = sys.modules.get("paddle_tpu.monitor.alerts")
+        if _alerts is None:
+            return []
+        try:
+            return [f["name"] for f in _alerts.active_findings()]
+        except Exception:
+            return []
 
     def last_decision(self):
         return self.decisions[-1] if self.decisions else None
@@ -129,11 +151,21 @@ class ServingSupervisor:
         rollup = metrics.slo_rollup(now)
         decode = metrics.decode_rollup(now)
         owner._refresh_hedge_delay(rollup.get("p99_ms"))
+        self._note_anomalies()
         busy = False
         for replica in list(owner._replicas):
             busy |= self._supervise_replica(owner, replica, now)
         if self.scale:
             self._autoscale(owner, rollup, busy, decode)
+
+    def _note_anomalies(self):
+        """A finding newly on the anomaly board becomes a first-class
+        ``anomaly`` decision — the detector's verdict enters the same
+        ledger as drains and scale moves, once per finding edge."""
+        current = set(self._active_anomalies())
+        for name in sorted(current - self._seen_anomalies):
+            self._decide("anomaly", anomaly=name)
+        self._seen_anomalies = current
 
     def _supervise_replica(self, owner, replica, now):
         hb = replica.engine.heartbeat(now)

@@ -573,6 +573,8 @@ class Executor:
         new_key = key not in self._cache
         if new_key:
             self._seen_base.add(base_key)
+            import time as _time
+            _t0_compile = _time.perf_counter()
             with _monitor.trace.span("executor.compile",
                                      program=program.id,
                                      version=program.version):
@@ -580,6 +582,11 @@ class Executor:
                     program, fetch_names, sorted(feed_arrays),
                     param_names, slot_names,
                     nan_guard=nan_guard is not None, remat=mem_remat)
+            if _monitor.enabled():
+                # wall seconds spent minting executables — the compile
+                # category of the goodput ledger (monitor/step.py)
+                _monitor.counter("executor.compile_s").inc(
+                    _time.perf_counter() - _t0_compile)
         compiled = self._cache[key]
 
         param_vals = [program.param_vars[n].data for n in param_names]

@@ -214,6 +214,10 @@ def main():
                          "self = target drafts for itself (accept ~1)")
     ap.add_argument("--out-dir", default=None,
                     help="enable the monitor JSONL sink here")
+    ap.add_argument("--telemetry-dir", default=None,
+                    help="publish fleet-aggregator-compatible metric "
+                         "snapshots here (monitor/fleet.py) — the "
+                         "loadgen as a fleet telemetry source")
     args = ap.parse_args()
 
     from paddle_tpu import monitor, serving
@@ -221,13 +225,14 @@ def main():
     record_path = None
     if args.out_dir:
         os.makedirs(args.out_dir, exist_ok=True)
-        monitor.enable(os.path.join(args.out_dir, "decode_loadgen.jsonl"))
+        monitor.enable(os.path.join(args.out_dir, "decode_loadgen.jsonl"),
+                       telemetry_dir=args.telemetry_dir)
         record_path = os.path.join(args.out_dir,
                                    "decode_loadgen_requests.jsonl")
     else:
         # in-memory monitor (no sink): per-request traces still mint, so
         # the TTFT/TPOT table works without an artifact directory
-        monitor.enable()
+        monitor.enable(telemetry_dir=args.telemetry_dir)
 
     sampling = None
     if args.sampling:

@@ -177,6 +177,19 @@ bash scripts/lifecycle_smoke.sh "$MONITOR_DIR/lifecycle_smoke"
 lcy=$?
 [ $lcy -ne 0 ] && rc=$((rc == 0 ? lcy : rc))
 
+# fleet telemetry: a 4-process decode fleet publishes snapshots into a
+# shared directory; the aggregator's merged counters/percentiles must
+# match the per-worker oracle, exactly the two injected anomalies
+# (straggler + compile storm) must fire and resolve as alerts citing
+# source and series — and land in the supervisor's decision ledger —
+# the goodput ledger must reconcile to wall time, and publishing must
+# cost <= 1% of worker wall (zero files with the monitor disabled)
+echo ""
+echo "-- telemetry smoke gate --"
+bash scripts/telemetry_smoke.sh "$MONITOR_DIR/telemetry_smoke"
+tlm=$?
+[ $tlm -ne 0 ] && rc=$((rc == 0 ? tlm : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)

@@ -1,0 +1,474 @@
+"""Fleet telemetry plane: snapshot protocol, histogram merge laws,
+aggregator semantics (counters / gauges / staleness), sink rotation,
+burn-rate alert state machine, anomaly detection shapes, the goodput
+ledger, and the supervisor's anomaly decision context."""
+import json
+import math
+import os
+import random
+import time
+
+import pytest
+
+from paddle_tpu import monitor
+from paddle_tpu.monitor import alerts, fleet
+from paddle_tpu.monitor.registry import (JsonlSink, Registry, read_jsonl,
+                                         SNAPSHOT_FORMAT_VERSION)
+from paddle_tpu.serving.metrics import LATENCY_BUCKETS_MS
+
+
+@pytest.fixture(autouse=True)
+def _clean_monitor():
+    """The monitor and the findings board are process-global: every
+    test starts disabled/empty and leaves nothing for its neighbours."""
+    monitor.disable(flush_counters=False)
+    monitor.reset()
+    alerts.clear_findings()
+    yield
+    monitor.disable(flush_counters=False)
+    monitor.reset()
+    alerts.clear_findings()
+
+
+def _hist_export(values, buckets=LATENCY_BUCKETS_MS):
+    r = Registry()
+    h = r.histogram("h", buckets=buckets)
+    for v in values:
+        h.observe(v)
+    return h.export()
+
+
+def _nearest_rank(values, q):
+    s = sorted(values)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def _bucket_index(bounds, v):
+    for i, b in enumerate(bounds):
+        if v <= b:
+            return i
+    return len(bounds)
+
+
+# ---------------------------------------------------------------------------
+# histogram export + merge laws
+
+
+def test_histogram_export_full_bounds():
+    ex = _hist_export([0.5, 3.0, 250.0])
+    assert ex["bounds"] == list(LATENCY_BUCKETS_MS)
+    assert len(ex["counts"]) == len(LATENCY_BUCKETS_MS) + 1
+    assert ex["count"] == 3 and sum(ex["counts"]) == 3
+    assert ex["min"] == 0.5 and ex["max"] == 250.0
+    assert math.isclose(ex["sum"], 253.5)
+
+
+def test_merge_commutative_and_associative():
+    rng = random.Random(7)
+    parts = [[rng.lognormvariate(2.0, 1.5) for _ in range(rng.randint(5, 80))]
+             for _ in range(3)]
+    a, b, c = (_hist_export(p) for p in parts)
+    ab = fleet.merge_histograms(a, b)
+    ba = fleet.merge_histograms(b, a)
+    assert ab == ba
+    left = fleet.merge_histograms(fleet.merge_histograms(a, b), c)
+    right = fleet.merge_histograms(a, fleet.merge_histograms(b, c))
+    assert left == right
+    whole = _hist_export([v for p in parts for v in p])
+    assert left["counts"] == whole["counts"]
+    assert left["count"] == whole["count"]
+    assert math.isclose(left["sum"], whole["sum"], rel_tol=1e-9)
+
+
+def test_merge_bounds_mismatch_raises():
+    a = _hist_export([1.0])
+    b = _hist_export([1.0], buckets=(1.0, 10.0, 100.0))
+    with pytest.raises(ValueError):
+        fleet.merge_histograms(a, b)
+
+
+def test_merged_percentile_within_one_bucket_of_population():
+    rng = random.Random(11)
+    parts = [[rng.lognormvariate(1.5, 1.2) for _ in range(200)]
+             for _ in range(4)]
+    merged = None
+    for p in parts:
+        ex = _hist_export(p)
+        merged = ex if merged is None else fleet.merge_histograms(
+            merged, ex)
+    union = [v for p in parts for v in p]
+    for q in (0.50, 0.90, 0.99):
+        est = fleet.histogram_percentile(merged, q)
+        true = _nearest_rank(union, q)
+        d = abs(_bucket_index(list(LATENCY_BUCKETS_MS), est)
+                - _bucket_index(list(LATENCY_BUCKETS_MS), true))
+        assert d <= 1, (q, est, true)
+
+
+def test_latency_bucket_identity_asserted():
+    from paddle_tpu.serving import metrics as smetrics
+    monitor.enable()
+    smetrics.record_request_slo(ttft_ms=12.0, tpot_ms=3.0)
+    checked = smetrics.assert_mergeable_latency_histograms()
+    assert "serving.ttft_ms" in checked
+    monitor.histogram("serving.rogue_ms", buckets=(1.0, 10.0)).observe(2)
+    with pytest.raises(AssertionError, match="serving.rogue_ms"):
+        smetrics.assert_mergeable_latency_histograms()
+
+
+# ---------------------------------------------------------------------------
+# snapshot protocol + aggregator
+
+
+def test_snapshot_write_read_roundtrip(tmp_path):
+    r = Registry()
+    r.counter("req").inc(5)
+    r.gauge("depth").set(3.0)
+    r.histogram("lat", buckets=LATENCY_BUCKETS_MS).observe(4.2)
+    path = fleet.write_snapshot(str(tmp_path), source="w0", registry=r)
+    assert os.path.basename(path) == "snap-w0.json"
+    assert not [p for p in os.listdir(tmp_path) if ".tmp" in p]
+    snaps = fleet.read_snapshots(str(tmp_path))
+    assert len(snaps) == 1
+    snap = snaps[0]
+    assert snap["format_version"] == SNAPSHOT_FORMAT_VERSION
+    assert snap["source"] == "w0" and snap["pid"] == os.getpid()
+    assert snap["counters"]["req"] == 5
+    assert snap["gauges"]["depth"] == 3.0
+    assert snap["histograms"]["lat"]["count"] == 1
+
+
+def test_read_snapshots_skips_junk_and_foreign_versions(tmp_path):
+    r = Registry()
+    r.counter("c").inc()
+    fleet.write_snapshot(str(tmp_path), source="good", registry=r)
+    (tmp_path / "snap-torn.json").write_text("{not json")
+    (tmp_path / "snap-future.json").write_text(
+        json.dumps({"format_version": 999, "source": "future",
+                    "counters": {}, "gauges": {}, "histograms": {}}))
+    (tmp_path / "notes.txt").write_text("ignore me")
+    snaps = fleet.read_snapshots(str(tmp_path))
+    assert [s["source"] for s in snaps] == ["good"]
+
+
+def test_aggregator_merges_counters_gauges_histograms(tmp_path):
+    rngs = {"a": [1.0, 5.0, 40.0], "b": [2.0, 9.0, 300.0]}
+    for src, vals in rngs.items():
+        r = Registry()
+        r.counter("tokens").inc(10 if src == "a" else 32)
+        r.gauge("queue_depth").set(2.0 if src == "a" else 7.0)
+        h = r.histogram("lat_ms", buckets=LATENCY_BUCKETS_MS)
+        for v in vals:
+            h.observe(v)
+        fleet.write_snapshot(str(tmp_path), source=src, registry=r)
+        time.sleep(0.01)        # distinct snapshot ts: b is newest
+    agg = fleet.FleetAggregator(str(tmp_path))
+    agg.scrape()
+    assert agg.value("tokens") == 42
+    assert agg.value("queue_depth") == 7.0     # last write wins
+    h = agg.histogram("lat_ms")
+    assert h["count"] == 6
+    assert sorted(s["source"] for s in agg.sources()) == ["a", "b"]
+    union = sorted(rngs["a"] + rngs["b"])
+    est = agg.percentile("lat_ms", 0.5)
+    assert est is not None
+    d = abs(_bucket_index(list(LATENCY_BUCKETS_MS), est)
+            - _bucket_index(list(LATENCY_BUCKETS_MS),
+                            _nearest_rank(union, 0.5)))
+    assert d <= 1
+
+
+def test_aggregator_staleness_ttl_drops_source(tmp_path):
+    for src, tok in (("live", 1), ("dead", 100)):
+        r = Registry()
+        r.counter("tokens").inc(tok)
+        r.gauge(f"replica.{src}.depth").set(9.0)
+        fleet.write_snapshot(str(tmp_path), source=src, registry=r)
+    # age the dead source's snapshot far past the TTL
+    p = fleet.snapshot_path(str(tmp_path), "dead")
+    snap = json.loads(open(p).read())
+    snap["ts"] -= 3600.0
+    with open(p, "w") as fh:
+        json.dump(snap, fh)
+    agg = fleet.FleetAggregator(str(tmp_path), staleness_ttl_s=30.0)
+    agg.scrape()
+    assert agg.value("tokens") == 1            # stale counters excluded
+    assert agg.value("replica.dead.depth", default=None) is None
+    meta = {s["source"]: s["stale"] for s in agg.sources()}
+    assert meta == {"live": False, "dead": True}
+
+
+def test_publisher_lifecycle_and_final_snapshot(tmp_path):
+    monitor.enable(telemetry_dir=str(tmp_path))
+    assert fleet.publisher_active()
+    monitor.counter("work").inc(3)
+    stats = fleet.publisher_stats()
+    assert stats is not None and stats["interval_s"] > 0
+    monitor.disable(flush_counters=False)
+    assert not fleet.publisher_active()
+    snaps = fleet.read_snapshots(str(tmp_path))   # the stop() snapshot
+    assert len(snaps) == 1 and snaps[0]["counters"]["work"] == 3
+
+
+def test_disabled_monitor_publishes_nothing(tmp_path):
+    monitor.counter("noop").inc()
+    assert not fleet.publisher_active()
+    assert fleet.publisher_stats() is None
+    assert os.listdir(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# sink rotation
+
+
+def test_jsonl_sink_rotation(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    sink = JsonlSink(path, max_bytes=400)
+    for i in range(60):
+        sink.emit({"kind": "x", "i": i, "pad": "p" * 20})
+    sink.close()
+    assert os.path.exists(path)
+    assert os.path.exists(path + ".1")
+    assert os.path.exists(path + ".2")
+    assert os.path.getsize(path + ".1") <= 400 + 80
+    # every retained file is intact JSONL and the newest record
+    # survived (in `path`, or in `.1` if the last emit rotated)
+    rows = read_jsonl(path) + read_jsonl(path + ".1")
+    assert any(r["i"] == 59 for r in rows)
+    assert all(r["kind"] == "x" for r in rows)
+
+
+def test_enable_max_bytes_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_MONITOR_MAX_BYTES", "300")
+    path = monitor.enable(str(tmp_path))
+    for i in range(80):
+        monitor.emit(kind="spam", i=i, pad="p" * 20)
+    monitor.disable(flush_counters=False)
+    assert os.path.exists(path + ".1")
+    rows = read_jsonl(path) + read_jsonl(path + ".1")
+    assert any(r.get("i") == 79 for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# burn-rate alerts
+
+
+def _mk_rule(**kw):
+    kw.setdefault("fast_window_s", 10.0)
+    kw.setdefault("slow_window_s", 60.0)
+    kw.setdefault("budget", 0.1)
+    kw.setdefault("burn_threshold", 2.0)
+    return alerts.BurnRateRule("slo-ttft", "slo.ttft_p99_ms", 100.0,
+                               direction="above", **kw)
+
+
+def test_burn_rate_walks_pending_firing_resolved():
+    rule = _mk_rule()
+    mgr = alerts.AlertManager(rules=[rule], source=lambda s: None)
+    t0 = 1000.0
+    # seed the slow window clean enough that the first breach burst
+    # ignites only the fast window (50 clean + 8 hot = 14% < 20%)
+    for i in range(50):
+        mgr.feed("slo-ttft", 50.0, now=t0 + i)
+    for i in range(8):
+        mgr.feed("slo-ttft", 500.0, now=t0 + 50 + i)
+    mgr.tick(now=t0 + 57)
+    states = [a["state"] for a in mgr.alerts()]
+    assert states == ["pending"]       # fast hot, slow not yet
+    for i in range(30):
+        mgr.feed("slo-ttft", 500.0, now=t0 + 58 + i)
+    mgr.tick(now=t0 + 88)
+    assert [a["state"] for a in mgr.alerts()] == ["firing"]
+    # recovery: fast window all-clean resolves
+    for i in range(15):
+        mgr.feed("slo-ttft", 50.0, now=t0 + 89 + i)
+    mgr.tick(now=t0 + 103)
+    assert [a["state"] for a in mgr.alerts()] == ["resolved"]
+    seq = [h["state"] for h in mgr.history]
+    assert seq == ["pending", "firing", "resolved"]
+
+
+def test_burn_rate_blip_dissolves_silently():
+    rule = _mk_rule()
+    mgr = alerts.AlertManager(rules=[rule], source=lambda s: None)
+    t0 = 2000.0
+    for i in range(30):
+        mgr.feed("slo-ttft", 50.0, now=t0 + i)
+    for i in range(4):
+        mgr.feed("slo-ttft", 500.0, now=t0 + 30 + i)
+    mgr.tick(now=t0 + 34)
+    assert [a["state"] for a in mgr.alerts()] == ["pending"]
+    for i in range(12):
+        mgr.feed("slo-ttft", 50.0, now=t0 + 35 + i)
+    mgr.tick(now=t0 + 47)
+    assert mgr.alerts() == []          # dissolved, never fired
+    assert [h["state"] for h in mgr.history] == ["pending"]
+
+
+def test_default_rules_directions():
+    rules = {r.name: r for r in alerts.default_rules()}
+    assert rules["slo-ttft-p99"].direction == "above"
+    assert rules["slo-tokens-per-s"].direction == "below"
+    assert rules["slo-goodput"].direction == "below"
+    assert rules["slo-ttft-p99"].breaches(1e9)
+    assert rules["slo-tokens-per-s"].breaches(0.0)
+
+
+# ---------------------------------------------------------------------------
+# anomaly shapes
+
+
+def _snap(src, ts, compiles=0, step_sum=0.0, step_count=0,
+          accept=None, depth=None):
+    gauges = {}
+    if accept is not None:
+        gauges["serving.decode.accept_rate"] = accept
+    if depth is not None:
+        gauges["serving.queue_depth"] = depth
+    hists = {}
+    if step_count:
+        hists["serving.decode.step_ms"] = {
+            "bounds": list(LATENCY_BUCKETS_MS),
+            "counts": [0] * (len(LATENCY_BUCKETS_MS) + 1),
+            "count": step_count, "sum": step_sum,
+            "min": 0.0, "max": step_sum}
+    return {"format_version": 1, "source": src, "pid": 1, "ts": ts,
+            "counters": {"jit.compile": compiles}, "gauges": gauges,
+            "histograms": hists}
+
+
+def test_detector_straggler_leave_one_out():
+    det = alerts.AnomalyDetector(warmup_ticks=0, min_sources=3)
+    t = 100.0
+    base = [_snap(f"w{i}", t, step_sum=50.0, step_count=10)
+            for i in range(4)]
+    det.update(base, now=t)
+    nxt = []
+    for i in range(4):
+        slow = 400.0 if i == 3 else 100.0
+        nxt.append(_snap(f"w{i}", t + 1, step_sum=50.0 + slow,
+                         step_count=20))
+    found = det.update(nxt, now=t + 1)
+    names = [f["name"] for f in found]
+    assert names == ["straggler(w3)"]
+    f = found[0]
+    assert f["source"] == "w3"
+    assert f["series"] == "serving.decode.step_ms"
+    assert f["z"] > 3.0
+    assert [x["name"] for x in alerts.active_findings()] == names
+
+
+def test_detector_compile_storm_windowed():
+    det = alerts.AnomalyDetector(warmup_ticks=0,
+                                 compile_delta_threshold=6,
+                                 compile_window_s=5.0, min_sources=3)
+    t = 200.0
+    det.update([_snap(f"w{i}", t, compiles=10) for i in range(3)],
+               now=t)
+    # the burst lands spread across ticks: 3 + 4 within the window
+    det.update([_snap("w0", t + 1, compiles=13),
+                _snap("w1", t + 1, compiles=10),
+                _snap("w2", t + 1, compiles=10)], now=t + 1)
+    found = det.update([_snap("w0", t + 2, compiles=17),
+                        _snap("w1", t + 2, compiles=10),
+                        _snap("w2", t + 2, compiles=11)], now=t + 2)
+    assert [f["name"] for f in found] == ["compile_storm(w0)"]
+    assert found[0]["delta"] == 7
+    # the window drains: far enough in the future it stops reporting
+    later = det.update([_snap("w0", t + 60, compiles=17),
+                        _snap("w1", t + 60, compiles=10),
+                        _snap("w2", t + 60, compiles=11)], now=t + 60)
+    assert later == []
+
+
+def test_detector_findings_drive_alerts_and_age_out():
+    mgr = alerts.AlertManager(rules=[], finding_resolve_after_s=5.0)
+    det = alerts.AnomalyDetector(manager=mgr, warmup_ticks=0,
+                                 min_sources=3)
+    t = 300.0
+    det.update([_snap(f"w{i}", t, step_sum=50.0, step_count=10)
+                for i in range(3)], now=t)
+    det.update([_snap("w0", t + 1, step_sum=550.0, step_count=20),
+                _snap("w1", t + 1, step_sum=150.0, step_count=20),
+                _snap("w2", t + 1, step_sum=150.0, step_count=20)],
+               now=t + 1)
+    firing = mgr.tick(now=t + 1)
+    assert [a["name"] for a in firing] == ["straggler(w0)"]
+    # detector goes quiet -> the alert resolves after the grace window
+    mgr.tick(now=t + 20)
+    assert [a["state"] for a in mgr.alerts()] == ["resolved"]
+
+
+def test_supervisor_cites_anomalies_in_decisions():
+    from paddle_tpu.serving.supervisor import ServingSupervisor
+
+    class Owner:
+        inflight_timeout_s = 1.0
+        _replicas = ()
+
+        def _refresh_hedge_delay(self, p99):
+            pass
+
+    owner = Owner()
+    sup = ServingSupervisor(owner, start=False, scale=False)
+    alerts.set_active_findings([
+        {"name": "straggler(w1)", "kind": "straggler", "source": "w1",
+         "series": "serving.decode.step_ms"}])
+    sup.tick(owner)
+    anomaly = [d for d in sup.decisions if d["decision"] == "anomaly"]
+    assert [d["anomaly"] for d in anomaly] == ["straggler(w1)"]
+    assert anomaly[0]["anomalies"] == ["straggler(w1)"]
+    sup.tick(owner)     # same finding: one decision per edge
+    assert len([d for d in sup.decisions
+                if d["decision"] == "anomaly"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger + replica series hygiene
+
+
+def test_goodput_ledger_reconciles():
+    monitor.enable()
+    ledger = monitor.GoodputLedger()
+    ledger.begin()
+    monitor.counter("prefetch.stall_seconds").inc(0.2)
+    monitor.counter("ckpt.save_s").inc(0.1)
+    out = ledger.finish(wall_s=1.0)
+    assert math.isclose(out["wall_s"], 1.0)
+    assert math.isclose(out["lost_s"], 0.3, rel_tol=1e-6)
+    assert math.isclose(out["compute_s"], 0.7, rel_tol=1e-6)
+    assert math.isclose(out["goodput_fraction"], 0.7, rel_tol=1e-3)
+    # wall == compute + sum(losses) by construction
+    assert math.isclose(
+        out["wall_s"], out["compute_s"] + out["lost_s"], rel_tol=1e-9)
+    rows = {r["category"]: r["seconds"] for r in out["lost"]}
+    assert set(rows) == {c for c, _ in monitor.GOODPUT_CATEGORIES}
+    assert math.isclose(rows["input_stall"], 0.2, rel_tol=1e-6)
+    assert math.isclose(rows["checkpoint"], 0.1, rel_tol=1e-6)
+    assert out["lost"][0]["category"] == "input_stall"   # ranked
+
+
+def test_goodput_only_counts_deltas_after_begin():
+    monitor.enable()
+    monitor.counter("prefetch.stall_seconds").inc(5.0)   # pre-history
+    ledger = monitor.GoodputLedger()
+    ledger.begin()
+    monitor.counter("prefetch.stall_seconds").inc(0.25)
+    out = ledger.finish(wall_s=1.0)
+    rows = {r["category"]: r["seconds"] for r in out["lost"]}
+    assert math.isclose(rows["input_stall"], 0.25, rel_tol=1e-6)
+
+
+def test_clear_replica_series_scoped(tmp_path):
+    from paddle_tpu.serving import metrics as smetrics
+    monitor.enable(str(tmp_path))
+    monitor.gauge("serving.breaker_state.2").set(1.0)
+    monitor.gauge("serving.replica.2.inflight_age_s").set(0.4)
+    monitor.gauge("serving.breaker_state.3").set(0.0)
+    removed = smetrics.clear_replica_series(2)
+    assert removed == 2
+    reg = monitor.registry()
+    assert reg.value("serving.breaker_state.2", default=None) is None
+    assert reg.value("serving.replica.2.inflight_age_s",
+                     default=None) is None
+    assert reg.value("serving.breaker_state.3") == 0.0
