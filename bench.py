@@ -741,6 +741,47 @@ def bench_fleet_telemetry(timeout_s=600):
     }
 
 
+def bench_disagg(timeout_s=900):
+    """Disaggregated-serving stage: runs scripts/disagg_smoke.py (a
+    prefill pool and a decode pool split across 2 virtual CPU devices,
+    KV handed off over the PR 12 comm model, with a shared-prefix
+    cache in front of prefill) and banks the split's headline numbers:
+    the prefix-cache hit rate at 50% structured reuse, the hit-vs-miss
+    TTFT split the cache buys (a hit skips prefill entirely, so hit
+    p50 must stay well under miss p50), the per-request KV handoff
+    cost, and the split topology's end-to-end tokens/s. Wall-clock
+    series band wide in the sentinel (shared box); the gates_pass bit
+    is exact: bit-parity with the single-engine oracle through a
+    mid-stream drain, handoff bytes == plan, per-pool SLO autoscale,
+    and goodput >= 0.90 with one prefill replica hung."""
+    import os
+    import subprocess
+    import sys
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    here = os.path.dirname(os.path.abspath(__file__))
+    smoke = os.path.join(here, "scripts", "disagg_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, smoke, "--out-dir",
+         "/tmp/paddle_tpu_bench_disagg"],
+        capture_output=True, text=True, timeout=timeout_s, env=env)
+    line = next((ln for ln in reversed(proc.stdout.splitlines())
+                 if ln.startswith("{")), None)
+    if proc.returncode != 0 or line is None:
+        raise RuntimeError(
+            f"disagg_smoke rc={proc.returncode}: "
+            f"{(proc.stderr or proc.stdout)[-400:]}")
+    r = json.loads(line)
+    return {
+        "disagg_prefix_hit_rate": r["prefix_hit_rate"],
+        "disagg_ttft_hit_p50_ms": r["ttft_hit_p50_ms"],
+        "disagg_ttft_miss_p50_ms": r["ttft_miss_p50_ms"],
+        "disagg_handoff_ms": r["handoff_p50_ms"],
+        "disagg_tokens_per_s": r["tokens_per_s"],
+        "disagg_gates_pass": bool(r["ok"]),
+    }
+
+
 def bench_hotspot(label=None, top_k=5):
     """Hotspot stage: parse the newest captured step executable's HLO
     into the per-op cost ledger (monitor.profile) and bank the ranked
@@ -1257,6 +1298,18 @@ def main():
                   f"alert_latency_s="
                   f"{tlm['alert_detection_latency_s']}", flush=True)
             _RESULTS.update(tlm)
+        try:
+            dsg = bench_disagg()
+        except Exception as e:
+            print(f"disagg bench failed: "
+                  f"{type(e).__name__}: {e}", flush=True)
+        else:
+            print(f"partial disagg_prefix_hit_rate="
+                  f"{dsg['disagg_prefix_hit_rate']} "
+                  f"ttft_hit_p50={dsg['disagg_ttft_hit_p50_ms']} "
+                  f"tokens_per_s={dsg['disagg_tokens_per_s']}",
+                  flush=True)
+            _RESULTS.update(dsg)
     # ONE output schema: everything was banked into _RESULTS as its
     # stage finished (the same dict _fail_json reports from)
     result = {"metric": "bert_base_tokens/sec/chip", "unit": "tokens/s",

@@ -83,9 +83,14 @@ class FaultSpec:
     devices for ``host_loss``)."""
 
     def __init__(self, kind, step=None, probability=1.0, times=1,
-                 exc=None, delay=0.0, seed=0, lost=1, replica=None):
+                 exc=None, delay=0.0, seed=0, lost=1, replica=None,
+                 site=None):
         self.kind = kind
         self.lost = int(lost)
+        # a disaggregated topology reuses replica ids across pools:
+        # "replica 0" alone is ambiguous, so a spec may also require
+        # the injection site's pool label ("prefill", ...)
+        self.site = site
         if step is None:
             self.steps = None
         elif isinstance(step, (list, tuple, set, frozenset)):
@@ -105,7 +110,7 @@ class FaultSpec:
         self._rng = random.Random(seed)
         self.fired = 0
 
-    def should_fire(self, step, replica=None):
+    def should_fire(self, step, replica=None, site=None):
         if self.times is not None and self.fired >= self.times:
             return False
         if self.steps is not None and (
@@ -113,6 +118,8 @@ class FaultSpec:
             return False
         if self.replicas is not None and (
                 replica is None or int(replica) not in self.replicas):
+            return False
+        if self.site is not None and site != self.site:
             return False
         if self.probability >= 1.0:
             return True
@@ -139,12 +146,12 @@ _specs = {}   # kind -> [FaultSpec]
 
 
 def inject(kind, step=None, probability=1.0, times=1, exc=None,
-           delay=0.0, seed=0, lost=1, replica=None):
+           delay=0.0, seed=0, lost=1, replica=None, site=None):
     """Register a fault. Returns the spec (its ``.fired`` counter is the
     test-side evidence the injection actually happened)."""
     spec = FaultSpec(kind, step=step, probability=probability, times=times,
                      exc=exc, delay=delay, seed=seed, lost=lost,
-                     replica=replica)
+                     replica=replica, site=site)
     with _lock:
         _specs.setdefault(kind, []).append(spec)
     return spec
@@ -165,7 +172,7 @@ def enabled():
     return bool(_specs)
 
 
-def fire(kind, step=None, replica=None):
+def fire(kind, step=None, replica=None, site=None):
     """Consume one firing of `kind` at `step` if a spec matches.
     Returns the spec (or None). Emits ``resilience.fault_injected``."""
     specs = _specs.get(kind)
@@ -173,7 +180,7 @@ def fire(kind, step=None, replica=None):
         return None
     with _lock:
         for spec in specs:
-            if spec.should_fire(step, replica=replica):
+            if spec.should_fire(step, replica=replica, site=site):
                 spec.fired += 1
                 record("fault_injected", fault=kind, step=step,
                        replica=replica, fire=spec.fired)
@@ -198,18 +205,20 @@ def maybe_sleep(kind, step=None, replica=None):
     return spec is not None
 
 
-def maybe_serving_fault(replica, step=None):
+def maybe_serving_fault(replica, step=None, site=None):
     """The one injection site inside a serving replica's batch
     execution: ``replica_error`` raises, ``replica_hang`` sleeps a long
     default (30s — long enough that only supervision, never patience,
-    resolves it), ``replica_slow`` sleeps its ``delay`` (straggler)."""
-    spec = fire("replica_error", step, replica=replica)
+    resolves it), ``replica_slow`` sleeps its ``delay`` (straggler).
+    ``site`` names the pool in a disaggregated topology (``"prefill"``)
+    so a spec can target one pool's replica 0 and not the other's."""
+    spec = fire("replica_error", step, replica=replica, site=site)
     if spec is not None:
         raise spec.make_exc()
-    spec = fire("replica_hang", step, replica=replica)
+    spec = fire("replica_hang", step, replica=replica, site=site)
     if spec is not None:
         time.sleep(spec.delay if spec.delay > 0 else 30.0)
-    spec = fire("replica_slow", step, replica=replica)
+    spec = fire("replica_slow", step, replica=replica, site=site)
     if spec is not None and spec.delay > 0:
         time.sleep(spec.delay)
 

@@ -49,6 +49,13 @@ traffic into them.
   jit-safe filter + Gumbel-max sampler, the counter-based PRNG keys
   that make streams bit-reproducible across replicas, and the
   speculative accept-prefix rule
+* :mod:`~paddle_tpu.serving.prefix_cache` — :class:`PrefixCache`:
+  ref-counted, byte-budgeted LRU over prefill KV segments keyed on the
+  full prompt hash — a hit skips prefill entirely
+* :mod:`~paddle_tpu.serving.disagg`    — disaggregated serving:
+  :class:`PrefillPool` / :class:`DecodePool` as independently-scaled
+  fleets and :class:`DisaggServer`, the priced prefill→decode KV
+  handoff between them (bit-identical to the single-engine stream)
 * :mod:`~paddle_tpu.serving.reqtrace`  — request-scoped tracing: one
   ``serving.request`` record per logical request with the blame-
   assigned stage waterfall (queue/assemble/execute/prefill/decode/
@@ -84,6 +91,8 @@ from . import kv_cache  # noqa: F401
 from . import reqtrace  # noqa: F401
 from . import sampling  # noqa: F401
 from . import generate  # noqa: F401
+from . import prefix_cache  # noqa: F401
+from . import disagg  # noqa: F401
 from .admission import (AdmissionController, QueueFullError,  # noqa: F401
                         DeadlineExpired, ShedError, PRIORITIES)
 from .batcher import DynamicBatcher, Request  # noqa: F401
@@ -98,6 +107,9 @@ from .multi import (MultiDeviceEngine, NoHealthyReplicaError,  # noqa: F401
                     replicate)
 from .reqtrace import RequestTrace  # noqa: F401
 from .supervisor import ServingSupervisor  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
+from .disagg import (PrefillEngine, PrefillPool, DecodePool,  # noqa: F401
+                     DisaggServer)
 
 __all__ = [
     "batcher", "admission", "metrics", "engine", "multi", "breaker",
@@ -109,4 +121,6 @@ __all__ = [
     "GenerateEngine", "MultiDecodeEngine", "DecodeRequest", "KVCachePool",
     "replicate_decode", "demo_model", "demo_spec_pair", "sampling",
     "SamplingParams",
+    "prefix_cache", "disagg", "PrefixCache", "PrefillEngine",
+    "PrefillPool", "DecodePool", "DisaggServer",
 ]

@@ -135,7 +135,7 @@ class DecodeRequest:
 
     __slots__ = ("prompt", "max_new_tokens", "eos_token", "n",
                  "future", "deadline", "t_enqueue", "priority", "trace",
-                 "sampling")
+                 "sampling", "preset")
 
     def __init__(self, prompt, max_new_tokens, eos_token=None,
                  deadline=None, priority=1, trace=None, sampling=None):
@@ -155,6 +155,13 @@ class DecodeRequest:
         # set_* race below — and only the winner — finalizes it, so a
         # hedge shadow and its primary emit one record between them
         self.trace = trace
+        # disaggregated-serving payload: a dict of {"segment" (the
+        # KVCachePool transport format), "tokens" emitted so far,
+        # "last_token", "prompt_len"}. When set, the engine seats the
+        # sequence by importing the segment instead of running prefill
+        # — the handoff landing AND the KV-carrying drain-migration
+        # path. None on the ordinary single-engine path.
+        self.preset = None
 
     def age(self, now=None):
         return (now if now is not None else time.monotonic()) \
@@ -232,11 +239,18 @@ class GenerateEngine:
                  prompt_buckets=None, queue_depth=256, deadline_ms=None,
                  refill="continuous", shed=True, slo_goodput_floor=0.90,
                  start=True, replica_id=None, on_outcome=None,
-                 sampling=None, draft_model=None, spec_k=4):
+                 sampling=None, draft_model=None, spec_k=4,
+                 kv_import=False):
         import jax
         self._jax = jax
         self.model = model
         self.replica_id = replica_id
+        # kv_import: this engine receives KV segments (disaggregated
+        # handoff landings / KV-carrying drain migration), so warmup
+        # must mint insert executables for every CAPACITY-family pad
+        # too, not just the prompt buckets — a mid-stream migration's
+        # segment is padded to a capacity bucket
+        self.kv_import = bool(kv_import)
         # served weights version: bumped by the fleet's rolling
         # hot-swap and stamped into every request's reqtrace record
         self.weights_version = 0
@@ -313,7 +327,8 @@ class GenerateEngine:
                        "ticks": 0, "tokens": 0, "prefills": 0,
                        "prefill_tokens": 0, "compiles": 0, "grows": 0,
                        "draft_steps": 0, "verify_steps": 0,
-                       "spec_proposed": 0, "spec_accepted": 0}
+                       "spec_proposed": 0, "spec_accepted": 0,
+                       "kv_imports": 0}
         self._occupancy_sum = 0.0
         self._running = False
         self._closed = False
@@ -385,13 +400,17 @@ class GenerateEngine:
                                  replica=self.replica_id,
                                  version=self.weights_version))
 
-    def submit_request(self, req):
+    def submit_request(self, req, admit=True):
         """Admit + enqueue; returns the future. Raises ``ShedError`` /
-        ``QueueFullError`` from the admission ladder."""
+        ``QueueFullError`` from the admission ladder. ``admit=False``
+        skips the ladder — for a disaggregated handoff the request was
+        admitted once at the prefill pool's front door and must not be
+        double-charged (or shed after its prefill already ran)."""
         with self._cond:
             if self._closed:
                 raise RuntimeError("decode engine is closed")
-            self.admission.admit(req, len(self._queue))
+            if admit:
+                self.admission.admit(req, len(self._queue))
             self._queue.append(req)
             depth = len(self._queue)
             self._cond.notify()
@@ -501,7 +520,9 @@ class GenerateEngine:
                                               top_ks, top_ps)
             first = sampling_mod.sample_from_filtered(filt, seeds,
                                                       positions)
-            return kv, first
+            # last_logits ride out for the disaggregated prefix cache
+            # (a later hit re-samples its own first token from them)
+            return kv, first, last_logits
 
         fn = jax.jit(prefill)
         self._exec[key] = fn
@@ -716,12 +737,15 @@ class GenerateEngine:
 
         with _monitor.trace.span("serving.warmup",
                                  buckets=len(family)):
+            insert_pads = set(self.prompt_buckets)
+            if self.kv_import:
+                insert_pads |= set(family)
             for cap in family:
                 nxt, out = self._get_decode(cap)(
                     state, zeros_arena(spec, cap), tokens_s, ones_s,
                     active, *samp_s)
                 self._jax.block_until_ready(nxt)
-                for lb in self.prompt_buckets:
+                for lb in sorted(insert_pads):
                     if lb > cap:
                         continue
                     chunk = {name: jnp.zeros((1, lb) + tail, dt)
@@ -762,7 +786,7 @@ class GenerateEngine:
                     self._jax.block_until_ready(
                         self._get_grow(old, new, kind="dgrow")(dbufs))
             for lb in self.prompt_buckets:
-                kv, first = self._get_prefill(lb)(
+                kv, first, _logits = self._get_prefill(lb)(
                     state, jnp.zeros((1, lb), jnp.int32),
                     jnp.ones((1,), jnp.int32), *samp_1)
                 self._jax.block_until_ready(first)
@@ -906,19 +930,37 @@ class GenerateEngine:
         metrics.record_queue_depth(0)
         return taken
 
-    def disown_inflight(self):
+    def disown_inflight(self, export_kv=False):
         """Failover: evict every live sequence and hand its request
         over. Partial output is discarded — decode is a pure function
         of the request (greedy argmax, or counter-based sampling keys
         derived from the request's own ``(seed, generation_index)``),
         so the adopting replica's re-prefill regenerates a
         bit-identical stream from the prompt, speculative or not
-        (first resolution wins either way)."""
+        (first resolution wins either way).
+
+        ``export_kv=True`` (the disaggregated decode pool's drain path)
+        instead carries each sequence's resident KV off the arena via
+        :meth:`KVCachePool.export_slot` — padded to its capacity-family
+        bucket so the adopter lands it on a warmed insert executable —
+        along with the tokens emitted so far, so the adopting replica
+        resumes mid-stream (same ledger length, same generation index:
+        bit-identical continuation) instead of re-running prefill."""
         taken = []
         evicted = []
         with self._lock:
             for s, slot in enumerate(self._slots):
                 if slot.req is not None:
+                    if export_kv and slot.length > 0:
+                        seg = self.pool.export_slot(
+                            s, pad_to=self.pool.capacity_for(
+                                slot.length))
+                        slot.req.preset = {
+                            "segment": seg,
+                            "tokens": list(slot.tokens),
+                            "last_token": slot.last_token,
+                            "prompt_len": int(slot.req.prompt.size),
+                        }
                     taken.append(slot.req)
                     evicted.append((s, slot.t_seat))
                     slot.req = None
@@ -1090,8 +1132,13 @@ class GenerateEngine:
     def _prefill_into_slot(self, req):
         """Prompt ingest: run the bucketed prefill executable, write the
         KV pages into a freed slot's arena rows, seat the sequence. The
-        first generated token falls out of the prefill itself."""
+        first generated token falls out of the prefill itself. A
+        request carrying a ``preset`` payload (disaggregated handoff /
+        KV-carrying migration) seats by segment import instead — no
+        prefill executable runs."""
         import jax.numpy as jnp
+        if getattr(req, "preset", None) is not None:
+            return self._seat_preset(req)
         p = int(req.prompt.size)
         bucket = next_bucket(p, self.prompt_buckets)
         tr = req.trace
@@ -1113,7 +1160,7 @@ class GenerateEngine:
             sp = req.sampling
             # generation index 0: the prefill's sampled token — the
             # same counter key a failover re-prefill will derive
-            kv, first = self._get_prefill(bucket)(
+            kv, first, _logits = self._get_prefill(bucket)(
                 self.model.state, jnp.asarray(tokens),
                 jnp.asarray([p], jnp.int32),
                 jnp.asarray([sp.temperature], jnp.float32),
@@ -1170,6 +1217,66 @@ class GenerateEngine:
             slot.length = p
             slot.tokens = [first]
             slot.last_token = first
+            slot.t_seat = pc_seat
+
+    def _seat_preset(self, req):
+        """Seat a sequence whose KV history already exists as a host
+        segment (``req.preset``): a disaggregated prefill→decode
+        handoff (segment = the prompt's KV, tokens = [first]) or a
+        KV-carrying drain migration (segment = prompt + generated KV,
+        tokens = everything emitted so far). The segment lands through
+        :meth:`KVCachePool.import_slot` on the pre-compiled insert
+        executable for its pad bucket — zero fresh compiles — and the
+        ``note_length`` ledger restores the generation index, so the
+        continued stream is bit-identical to one that never moved."""
+        import jax.numpy as jnp
+        preset = req.preset
+        seg = preset["segment"]
+        pad = int(seg["pad"])
+        L = int(seg["length"])
+        toks = list(preset["tokens"])
+        last = int(preset["last_token"])
+        tr = req.trace
+        self._ensure_capacity(max(L + 1, pad))
+        s = self.pool.alloc()
+        if s is None:
+            raise RuntimeError("no free slot after free_slots() > 0")
+        pc_seat = time.perf_counter()
+        try:
+            if _faults.enabled():
+                _faults.maybe_serving_fault(self.replica_id)
+            fn = self._get_insert(pad, self.pool.capacity)
+            self.pool.import_slot(s, seg, insert_fn=fn)
+            with self._stats_lock:
+                self._stats["kv_imports"] = \
+                    self._stats.get("kv_imports", 0) + 1
+        except BaseException:
+            self.pool.free(s)
+            raise
+        self._note_outcome(True)
+        # the first token was stamped where it was produced (the
+        # prefill pool / the original replica); entering "decode" here
+        # closes the handoff (or requeue-wait) stage
+        if tr is not None:
+            tr.to("decode")
+        trc = _monitor.trace
+        rid = tr.ctx.rid if tr is not None else None
+        if trc.enabled():
+            trc.lane_complete(f"{self._lane}.slot{s}", "kv import",
+                              pc_seat, time.perf_counter(),
+                              rid=rid, tokens=L, pad=pad)
+        done = (req.eos_token is not None and last == req.eos_token) \
+            or len(toks) >= req.max_new_tokens
+        if done:
+            self.pool.free(s)
+            self._complete(req, toks)
+            return
+        slot = self._slots[s]
+        with self._lock:
+            slot.req = req
+            slot.length = L
+            slot.tokens = toks
+            slot.last_token = last
             slot.t_seat = pc_seat
 
     # -- the fused decode step ---------------------------------------------

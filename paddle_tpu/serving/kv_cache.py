@@ -178,6 +178,100 @@ class KVCachePool:
         with self._lock:
             return self.slots - len(self._free)
 
+    # -- slot transport (handoff / drain migration) ------------------------
+
+    def export_slot(self, slot, pad_to=None):
+        """Copy one slot's resident K/V history off the arena as a
+        host-side *segment* — the one transport format shared by the
+        disaggregated prefill→decode handoff and the drain-migration
+        path (one tested copy primitive instead of ad-hoc tree maps).
+
+        The segment is padded to ``pad_to`` arena positions (default:
+        the slot's live length; pass a bucket so the receiving side can
+        land it on a pre-compiled insert executable). Byte accounting
+        is exact and asserted: the segment's payload must equal
+        ``bytes_per_token(spec) × pad`` to the byte.
+
+        Returns ``{"length", "pad", "bytes", "leaves"}`` where
+        ``leaves[name]`` is a ``[pad, *tail]`` numpy array."""
+        slot = int(slot)
+        with self._lock:
+            length = self._lengths[slot]
+        pad = int(pad_to) if pad_to is not None else length
+        if pad < length:
+            raise ValueError(
+                f"export pad {pad} < live length {length} of slot {slot}")
+        if pad > self.capacity:
+            raise ValueError(
+                f"export pad {pad} exceeds arena capacity "
+                f"{self.capacity}")
+        leaves = {name: np.asarray(self.buffers[name][slot, :pad])
+                  for name, _tail, _dt in self._leaf_list}
+        seg_bytes = sum(int(a.nbytes) for a in leaves.values())
+        expected = bytes_per_token(self.spec) * pad
+        if seg_bytes != expected:
+            raise AssertionError(
+                f"export_slot byte accounting drifted: segment holds "
+                f"{seg_bytes} bytes, spec arithmetic says {expected} "
+                f"({pad} positions × {bytes_per_token(self.spec)} B/tok)")
+        return {"length": length, "pad": pad, "bytes": seg_bytes,
+                "leaves": leaves}
+
+    def import_slot(self, slot, segment, insert_fn=None):
+        """Land an exported segment into ``slot``: write the leaves at
+        arena positions ``[0, pad)`` and record the live length through
+        the :meth:`note_length` ledger (so a migrated stream's counter-
+        PRNG indexing continues bit-identically).
+
+        ``insert_fn(buffers, chunk, slot) -> buffers`` is the engine's
+        pre-compiled insert executable for ``(pad, capacity)`` — the
+        zero-compile path every serving import must use. Without it the
+        write falls back to per-leaf ``dynamic_update_slice`` (tests,
+        offline tools). Asserts the byte arithmetic on entry and that
+        ``allocated_bytes()`` is unchanged by the import (a slot write
+        must never resize the arena). Returns the segment bytes."""
+        import jax
+        import jax.numpy as jnp
+        slot = int(slot)
+        pad = int(segment["pad"])
+        length = int(segment["length"])
+        if pad > self.capacity:
+            raise ValueError(
+                f"segment pad {pad} exceeds arena capacity "
+                f"{self.capacity} — grow first")
+        leaves = segment["leaves"]
+        names = {name for name, _t, _d in self._leaf_list}
+        if set(leaves) != names:
+            raise ValueError(
+                f"segment leaves {sorted(leaves)} != spec leaves "
+                f"{sorted(names)}")
+        seg_bytes = sum(int(np.asarray(a).nbytes)
+                        for a in leaves.values())
+        expected = bytes_per_token(self.spec) * pad
+        if seg_bytes != expected:
+            raise AssertionError(
+                f"import_slot byte accounting drifted: segment holds "
+                f"{seg_bytes} bytes, spec arithmetic says {expected}")
+        before = self.allocated_bytes()
+        if insert_fn is not None:
+            chunk = {name: jnp.asarray(np.asarray(leaves[name])[None])
+                     for name, _t, _d in self._leaf_list}
+            self.buffers = insert_fn(self.buffers, chunk,
+                                     jnp.int32(slot))
+        else:
+            for name, tail, _dt in self._leaf_list:
+                start = (slot, 0) + (0,) * len(tail)
+                self.buffers[name] = jax.lax.dynamic_update_slice(
+                    self.buffers[name], jnp.asarray(leaves[name])[None],
+                    start)
+        after = self.allocated_bytes()
+        if after != before:
+            raise AssertionError(
+                f"import_slot changed the arena footprint: "
+                f"{before} -> {after} bytes")
+        self.note_length(slot, length)
+        return seg_bytes
+
     # -- capacity schedule -------------------------------------------------
 
     def capacity_for(self, needed_len):

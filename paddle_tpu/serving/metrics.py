@@ -112,6 +112,25 @@ inflate ``serving.decode.tokens`` or ``slo.tokens_per_s``):
   combined; the draft arena's footprint publishes under
   ``serving.decode.draft_cache_bytes`` / ``..draft_cache_capacity``
 
+Disaggregated-serving series (prefill pool → decode pool; PR 20):
+
+* ``serving.handoff.bytes`` — gauge: the last planned KV transfer's
+  exact payload (``bytes_per_token(spec) × prompt bucket``);
+  ``serving.handoff.bytes_total`` accumulates them
+* ``serving.handoff.ms`` — histogram: measured handoff latency
+  (transfer + decode-slot queueing); ``serving.handoff.planned_ms``
+  gauge is the link-model prediction (``bytes / link_bandwidth()``)
+* ``serving.handoff.queue_depth`` — gauge: segments waiting for a
+  decode slot at plan time
+* ``serving.prefix.hits`` / ``serving.prefix.misses`` — prefix-cache
+  verdicts; ``serving.prefix.hit_rate`` gauge over the rolling
+  :data:`TOKENS_WINDOW_S` window
+* ``serving.prefix.lookup_ms`` — histogram: cache probe latency
+* ``serving.prefix.bytes`` / ``serving.prefix.entries`` /
+  ``serving.prefix.budget_bytes`` — resident cache footprint vs its
+  ``fits_budget``-style byte budget; ``serving.prefix.evictions``
+  counts LRU victims
+
 Span sites (``monitor.trace``): ``serving.enqueue``,
 ``serving.batch_assemble``, ``serving.execute``, ``serving.scatter``,
 ``serving.warmup`` — the Perfetto view of queue→batch→MXU.
@@ -341,6 +360,7 @@ def reset_windows():
         _decode_steps.clear()
         _prefill_steps.clear()
         _spec_window.clear()
+        _prefix_window.clear()
 
 
 def record_compiles(n=1):
@@ -536,6 +556,7 @@ _tokens_window = collections.deque()   # (t_monotonic, n_tokens)
 _decode_steps = collections.deque()    # (t, step_ms)
 _prefill_steps = collections.deque()   # (t, prefill_ms)
 _spec_window = collections.deque()     # (t, proposed, accepted, emitted)
+_prefix_window = collections.deque()   # (t, hit: bool)
 
 
 def record_decode_tick(active_slots, total_slots, n_tokens, step_ms):
@@ -713,3 +734,80 @@ def decode_rollup(now=None):
             _monitor.gauge("serving.decode.prefill_ratio").set(
                 round(ratio, 4))
     return out
+
+
+# -- disaggregated serving series (handoff + prefix cache) ------------------
+
+
+def record_handoff(n_bytes, planned_ms, actual_ms, queue_depth=0):
+    """One planned prefill→decode KV transfer: ``n_bytes`` is the exact
+    spec arithmetic (``bytes_per_token × bucket``), ``planned_ms`` the
+    link-model prediction, ``actual_ms`` the measured transfer +
+    decode-slot wait."""
+    if not _monitor.enabled():
+        return
+    _monitor.counter("serving.handoff.transfers").inc()
+    _monitor.counter("serving.handoff.bytes_total").inc(int(n_bytes))
+    _monitor.gauge("serving.handoff.bytes").set(int(n_bytes))
+    _monitor.gauge("serving.handoff.planned_ms").set(
+        round(float(planned_ms), 6))
+    _monitor.gauge("serving.handoff.queue_depth").set(int(queue_depth))
+    _monitor.histogram("serving.handoff.ms",
+                       buckets=LATENCY_BUCKETS_MS).observe(
+        float(actual_ms))
+    _monitor.emit(kind="serving", event="handoff", bytes=int(n_bytes),
+                  planned_ms=round(float(planned_ms), 6),
+                  ms=round(float(actual_ms), 3),
+                  queue_depth=int(queue_depth))
+
+
+def record_prefix_lookup(hit, lookup_ms):
+    """One prefix-cache probe. Fills the rolling hit-rate window
+    whether or not the monitor is enabled — it's a control signal,
+    like :func:`spec_window`."""
+    now = time.monotonic()
+    with _decode_lock:
+        _prefix_window.append((now, bool(hit)))
+        _sweep(_prefix_window, now, TOKENS_WINDOW_S)
+    if not _monitor.enabled():
+        return
+    _monitor.counter("serving.prefix.hits" if hit
+                     else "serving.prefix.misses").inc()
+    _monitor.histogram("serving.prefix.lookup_ms",
+                       buckets=LATENCY_BUCKETS_MS).observe(
+        float(lookup_ms))
+    rate = prefix_window(now)
+    if rate is not None:
+        _monitor.gauge("serving.prefix.hit_rate").set(round(rate, 4))
+
+
+def prefix_window(now=None):
+    """Rolling prefix hit rate over the last :data:`TOKENS_WINDOW_S`
+    seconds, or None with no lookups in the window."""
+    now = time.monotonic() if now is None else now
+    with _decode_lock:
+        _sweep(_prefix_window, now, TOKENS_WINDOW_S)
+        if not _prefix_window:
+            return None
+        hits = sum(1 for _, h in _prefix_window if h)
+        total = len(_prefix_window)
+    return hits / total
+
+
+def record_prefix_cache(cache_bytes, entries, budget_bytes=None):
+    """Resident prefix-cache footprint gauges (published by the cache
+    on every insert/evict edge)."""
+    if not _monitor.enabled():
+        return
+    _monitor.gauge("serving.prefix.bytes").set(int(cache_bytes))
+    _monitor.gauge("serving.prefix.entries").set(int(entries))
+    if budget_bytes is not None:
+        _monitor.gauge("serving.prefix.budget_bytes").set(
+            int(budget_bytes))
+
+
+def record_prefix_evict(n=1, freed_bytes=0):
+    if _monitor.enabled():
+        _monitor.counter("serving.prefix.evictions").inc(int(n))
+        _monitor.emit(kind="serving", event="prefix_evict", n=int(n),
+                      freed_bytes=int(freed_bytes))

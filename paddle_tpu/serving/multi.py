@@ -434,7 +434,7 @@ class MultiDeviceEngine:
         wins and the other is swallowed — exactly once, either way.
         Decode requests regenerate bit-identically on the adopting
         replica (counter-based sampling — see ``disown_inflight``)."""
-        moved = replica.engine.disown_inflight()
+        moved = self._disown(replica)
         moved += replica.engine.steal_pending()
         moved = [r for r in moved if not r.future.done()]
         if not moved:
@@ -451,6 +451,14 @@ class MultiDeviceEngine:
             return len(moved)
         target.engine.requeue(moved)
         return len(moved)
+
+    def _disown(self, replica):
+        """Seam: how in-flight work leaves a replica during migration.
+        The disaggregated decode pool overrides this to carry each
+        sequence's KV segment along (``disown_inflight(export_kv=True)``)
+        so a drained sequence resumes mid-stream instead of
+        re-prefilling."""
+        return replica.engine.disown_inflight()
 
     def _failover(self, replica, reason=""):
         """Move a tripped replica's work to healthy peers and count it."""

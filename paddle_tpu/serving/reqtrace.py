@@ -16,6 +16,8 @@ request's lifetime into blame-assigned stages:
 * ``retry_backoff_ms``— sleeping between transient-fault retries
 * ``scatter_ms``      — host transfer + row split + future resolution
 * ``prefill_ms``      — decode-engine prompt prefill
+* ``prefix_lookup_ms``— disaggregated serving's prefix-cache probe
+* ``handoff_ms``      — prefill→decode KV transfer + decode-slot wait
 * ``decode_ms``       — wall time from first token to completion
 * ``hedge_ms``        — lag between the primary submit and the winning
                         hedge shadow's dispatch
@@ -183,7 +185,7 @@ class Attempt:
 
     __slots__ = ("ctx", "origin", "replica", "version", "t_start", "stage",
                  "t_mark", "stages", "t_first", "n_tokens", "spec_proposed",
-                 "spec_accepted")
+                 "spec_accepted", "prefix_hit")
 
     def __init__(self, ctx, origin, replica, version=None):
         now = _MONO()
@@ -199,6 +201,9 @@ class Attempt:
         self.n_tokens = None
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # None = request never consulted a prefix cache (single-engine
+        # path); True/False = disaggregated lookup verdict
+        self.prefix_hit = None
 
     # -- stage machine ------------------------------------------------------
 
@@ -238,6 +243,12 @@ class Attempt:
         offered vs accepted by the verify step."""
         self.spec_proposed += int(proposed)
         self.spec_accepted += int(accepted)
+
+    def note_prefix(self, hit):
+        """Disaggregated prefill's prefix-cache verdict for this
+        request (stamped once at lookup; rides to the terminal
+        record's ``prefix_hit`` field)."""
+        self.prefix_hit = bool(hit)
 
     def shed(self, level=None, retry_after_ms=None):
         self.ctx.note_shed(level, retry_after_ms)
@@ -307,6 +318,8 @@ class Attempt:
         }
         if self.version is not None:
             rec["weights_version"] = self.version
+        if self.prefix_hit is not None:
+            rec["prefix_hit"] = self.prefix_hit
         for stage, secs in self.stages.items():
             rec[f"{stage}_ms"] = round(secs * 1e3, 3)
         if self.spec_proposed:

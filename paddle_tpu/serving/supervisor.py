@@ -54,7 +54,8 @@ class ServingSupervisor:
     def __init__(self, owner, interval_s=0.25, probe_timeout_s=1.0,
                  goodput_floor=0.90, restart_after_s=None,
                  idle_ticks_down=120, scale=True, start=True,
-                 tokens_floor=None):
+                 tokens_floor=None, ttft_ceiling_ms=None,
+                 queue_depth_ceiling=None):
         self._owner = weakref.ref(owner)
         self.interval_s = float(interval_s)
         self.probe_timeout_s = float(probe_timeout_s)
@@ -63,6 +64,15 @@ class ServingSupervisor:
         # window sits below this (None = goodput-only scaling)
         self.tokens_floor = (float(tokens_floor)
                              if tokens_floor is not None else None)
+        # prefill SLO ceilings (disaggregated pools): scale up while the
+        # rolling slo.ttft_p99_ms window sits ABOVE ttft_ceiling_ms, or
+        # the pool's aggregate queue depth above queue_depth_ceiling —
+        # TTFT is prefill's SLO the way tokens/s is decode's
+        self.ttft_ceiling_ms = (float(ttft_ceiling_ms)
+                                if ttft_ceiling_ms is not None else None)
+        self.queue_depth_ceiling = (int(queue_depth_ceiling)
+                                    if queue_depth_ceiling is not None
+                                    else None)
         # default: a hung replica gets 3 supervision timeouts of grace
         # after failover before the heavyweight rebuild
         self.restart_after_s = (float(restart_after_s)
@@ -246,6 +256,31 @@ class ServingSupervisor:
                              goodput=round(goodput, 4),
                              active=owner._active_count(), **slo_ctx)
             return
+        # prefill SLO (disaggregated pools): TTFT p99 over the ceiling
+        # or a backed-up prefill queue means prompt ingest is the
+        # bottleneck — add a prefill replica. An idle window reads as
+        # None, never as a breach.
+        if self.ttft_ceiling_ms is not None \
+                or self.queue_depth_ceiling is not None:
+            ttft = rollup.get("ttft_p99_ms")
+            depth = sum(r.engine.depth() for r in owner._replicas
+                        if r.active and hasattr(r.engine, "depth"))
+            breach_ttft = (self.ttft_ceiling_ms is not None
+                           and ttft is not None
+                           and ttft > self.ttft_ceiling_ms)
+            breach_depth = (self.queue_depth_ceiling is not None
+                            and depth > self.queue_depth_ceiling)
+            if breach_ttft or breach_depth:
+                self._idle_ticks = 0
+                rep = owner._activate_one()
+                if rep is not None:
+                    self._decide(
+                        "scale_up", replica=rep.index,
+                        queue_depth=depth,
+                        ttft_ceiling_ms=self.ttft_ceiling_ms,
+                        queue_depth_ceiling=self.queue_depth_ceiling,
+                        active=owner._active_count(), **slo_ctx)
+                return
         # decode SLO: rolling token throughput below the floor means the
         # fleet is slot-starved — add a replica. An idle engine reads as
         # None (no decode traffic in the window), never as a breach.

@@ -28,12 +28,21 @@ decode on the SAME sampled traffic and slot count — the tokens/s ratio
 is the draft-verify win, and the result line carries the measured
 accept rate.
 
+With ``--prefix-reuse FRAC`` the workload turns head-heavy — FRAC of
+requests carry one of ``--prefix-heads`` shared system-prompt heads —
+and runs against the disaggregated topology
+(:class:`~paddle_tpu.serving.disagg.DisaggServer`: prefill pool +
+prefix cache + priced handoff + decode pool). The result reports the
+measured prefix hit rate and the TTFT distribution split by hit/miss —
+the cache's latency win, measured rather than asserted.
+
 Usage::
 
     python scripts/decode_loadgen.py --requests 64 --slots 8
     python scripts/decode_loadgen.py --mode continuous --rate 200
     python scripts/decode_loadgen.py --sampling temperature=1.0,top_k=8
     python scripts/decode_loadgen.py --spec --spec-k 8 --draft pair
+    python scripts/decode_loadgen.py --prefix-reuse 0.6 --prefix-heads 3
 """
 import argparse
 import json
@@ -70,6 +79,27 @@ def make_workload(n, prompt_buckets, max_len, seed=0):
         prompt = rng.randint(1, 31, size=plen).tolist()
         reqs.append((prompt, new))
     return reqs
+
+
+def make_prefix_workload(n, reuse_frac, heads, prompt_buckets, max_len,
+                         seed=0):
+    """Head-heavy traffic: ``reuse_frac`` of requests carry one of
+    ``heads`` shared system-prompt heads (the FULL prompt repeats —
+    the prefix cache is keyed on the whole prompt), the rest are the
+    ragged unique prompts of :func:`make_workload`. Output lengths keep
+    the same bimodal skew."""
+    rng = np.random.RandomState(seed)
+    base = make_workload(n, prompt_buckets, max_len, seed=seed)
+    head_len = int(prompt_buckets[-1])
+    head_prompts = [rng.randint(1, 31, size=head_len).tolist()
+                    for _ in range(max(1, int(heads)))]
+    out = []
+    for prompt, new in base:
+        if rng.rand() < reuse_frac:
+            prompt = head_prompts[int(rng.randint(len(head_prompts)))]
+            new = min(new, max_len - head_len)
+        out.append((prompt, new))
+    return out
 
 
 def _pct(sorted_vals, q):
@@ -189,6 +219,93 @@ def run_load(model, mode, workload, slots, max_len, prompt_buckets,
     }
 
 
+def run_disagg_load(model, workload, slots, max_len, prompt_buckets,
+                    rate=None, seed=0, record_path=None, sampling=None,
+                    seed_base=None, prefill_replicas=1,
+                    decode_replicas=1):
+    """Drive the disaggregated topology over the workload. Returns the
+    measurement dict with the prefix hit rate and TTFT split by
+    hit/miss (from each request's ``serving.request`` record — the
+    ``prefix_hit`` field the reqtrace satellite added)."""
+    from paddle_tpu import serving
+    from paddle_tpu.serving import metrics, reqtrace
+
+    metrics.reset_windows()
+    reqtrace.reset()
+    srv = serving.DisaggServer(
+        model, prefill_replicas=prefill_replicas,
+        decode_replicas=decode_replicas, slots=slots, page=32,
+        factor=2.0, max_len=max_len, prompt_buckets=prompt_buckets,
+        queue_depth=len(workload) + 8, supervise=False)
+    srv.warmup()
+
+    def execs():
+        pools = (srv.prefill_pool, srv.decode_pool)
+        return tuple(r.engine.executables()
+                     for pool in pools for r in pool._replicas)
+
+    ex0 = execs()
+    rng = np.random.RandomState(seed + 1)
+    futs = []
+    t0 = time.perf_counter()
+    for i, (prompt, new) in enumerate(workload):
+        if rate:
+            time.sleep(float(rng.exponential(1.0 / rate)))
+        futs.append(srv.submit(
+            prompt, max_new_tokens=new, sampling=sampling,
+            seed=(seed_base + i) if seed_base is not None else None))
+    outs = [f.result(timeout=120) for f in futs]
+    wall_s = time.perf_counter() - t0
+    ex1 = execs()
+
+    stats = srv.stats()
+    records = [r for r in reqtrace.recent() if r["outcome"] == "ok"]
+    srv.close()
+
+    slo = {}
+    if records:
+        if record_path:
+            with open(record_path, "a") as fh:
+                for rec in records:
+                    fh.write(json.dumps({"mode": "disagg", **rec}) + "\n")
+        hits = [r for r in records if r.get("prefix_hit") is True]
+        misses = [r for r in records if r.get("prefix_hit") is False]
+        rnd = lambda v: round(v, 3) if v is not None else None  # noqa: E731
+
+        def ttfts(rs):
+            return sorted(r["ttft_ms"] for r in rs
+                          if r.get("ttft_ms") is not None)
+
+        t_hit, t_miss = ttfts(hits), ttfts(misses)
+        handoffs = sorted(r["handoff_ms"] for r in records
+                          if r.get("handoff_ms") is not None)
+        slo = {
+            "records": len(records),
+            "prefix_hit_rate": round(len(hits) / len(records), 4),
+            "ttft_hit_p50_ms": rnd(_pct(t_hit, 0.50)),
+            "ttft_hit_p99_ms": rnd(_pct(t_hit, 0.99)),
+            "ttft_miss_p50_ms": rnd(_pct(t_miss, 0.50)),
+            "ttft_miss_p99_ms": rnd(_pct(t_miss, 0.99)),
+            "handoff_p50_ms": rnd(_pct(handoffs, 0.50)),
+            "handoff_p99_ms": rnd(_pct(handoffs, 0.99)),
+        }
+
+    tokens = int(sum(len(o) for o in outs))
+    return {
+        **slo,
+        "mode": "disagg",
+        "requests": len(workload),
+        "tokens": tokens,
+        "wall_s": round(wall_s, 3),
+        "tokens_per_s": round(tokens / wall_s, 1),
+        "handoffs": stats["handoffs"],
+        "handoff_bytes": stats["handoff_bytes"],
+        "prefix_cache": stats.get("prefix"),
+        "post_warmup_compiles": sum(
+            (b[0] - a[0]) + (b[1] - a[1]) for a, b in zip(ex0, ex1)),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=96)
@@ -212,6 +329,13 @@ def main():
     ap.add_argument("--draft", choices=["pair", "self"], default="pair",
                     help="pair = distilled demo draft/target pair; "
                          "self = target drafts for itself (accept ~1)")
+    ap.add_argument("--prefix-reuse", type=float, default=0.0,
+                    help="fraction of requests sharing one of "
+                         "--prefix-heads system-prompt heads; >0 runs "
+                         "the disaggregated topology and splits TTFT "
+                         "by prefix hit/miss")
+    ap.add_argument("--prefix-heads", type=int, default=4,
+                    help="number of distinct shared prompt heads")
     ap.add_argument("--out-dir", default=None,
                     help="enable the monitor JSONL sink here")
     ap.add_argument("--telemetry-dir", default=None,
@@ -247,7 +371,31 @@ def main():
     result = {"requests": args.requests, "slots": args.slots,
               "rate": args.rate or None, "sampling": sampling}
 
-    if args.spec:
+    if args.prefix_reuse > 0.0:
+        # disaggregated topology under head-heavy traffic: the point
+        # is the hit/miss TTFT split, so the workload repeats whole
+        # prompts (the cache keys the full sequence)
+        model = serving.demo_model(vocab=64, dim=256, heads=4, layers=2,
+                                   max_len=args.max_len, seed=1)
+        workload = make_prefix_workload(
+            args.requests, args.prefix_reuse, args.prefix_heads,
+            prompt_buckets, args.max_len, seed=args.seed)
+        result["prefix_reuse"] = args.prefix_reuse
+        result["prefix_heads"] = args.prefix_heads
+        result["disagg"] = run_disagg_load(
+            model, workload, args.slots, args.max_len, prompt_buckets,
+            rate=args.rate or None, seed=args.seed,
+            record_path=record_path, sampling=sampling,
+            seed_base=args.seed_base if sampling else None)
+        r = result["disagg"]
+        print(f"[    disagg] {r['tokens_per_s']:>8} tok/s | "
+              f"hit rate {r.get('prefix_hit_rate')} | "
+              f"ttft hit p50 {r.get('ttft_hit_p50_ms')} ms vs "
+              f"miss p50 {r.get('ttft_miss_p50_ms')} ms | "
+              f"handoff p50 {r.get('handoff_p50_ms')} ms "
+              f"({r.get('records', 0)} records)", file=sys.stderr)
+        modes = []
+    elif args.spec:
         # speculative A/B: same sampled traffic, same slots, draft
         # on/off. The pair's deep target amortises each verify over
         # spec_k drafted tokens; "self" isolates the loop's overhead

@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Disaggregated serving gate: prefill/decode split bit-parity through a
+# mid-stream drain, prefix-cache TTFT split, per-pool SLO autoscale,
+# and goodput under a hung prefill replica.
+# Forces the 2-device CPU topology before any jax import.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+OUT_DIR="${1:-/tmp/paddle_tpu_disagg_smoke}"
+
+JAX_PLATFORMS=cpu \
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+python scripts/disagg_smoke.py --out-dir "$OUT_DIR"

@@ -235,6 +235,24 @@ METRICS = [
     ("alert_detection_latency_s",
      ("alert_detection_latency_s",), ("alert_detection_latency_s",),
      "lower", 1.00),
+    # disaggregated-serving stage (bench_disagg / disagg_smoke): the
+    # prefix hit rate is pure workload arithmetic on fixed seeds —
+    # tight band, a drop means the full-prompt keying or the insert
+    # path regressed, not the weather; TTFT/handoff/tokens-per-s are
+    # shared-box wall-clock (very wide bands) — the hard bars (hit
+    # TTFT <= 0.5x miss, handoff bytes == plan, bit-parity) live in
+    # the smoke's gates, folded into disagg_gates_pass
+    ("disagg_prefix_hit_rate",
+     ("disagg_prefix_hit_rate",), ("disagg_prefix_hit_rate",),
+     "higher", 0.10),
+    ("disagg_ttft_hit_p50_ms",
+     ("disagg_ttft_hit_p50_ms",), ("disagg_ttft_hit_p50_ms",),
+     "lower", 1.00),
+    ("disagg_handoff_ms",
+     ("disagg_handoff_ms",), ("disagg_handoff_ms",), "lower", 1.00),
+    ("disagg_tokens_per_s",
+     ("disagg_tokens_per_s",), ("disagg_tokens_per_s",),
+     "higher", 1.00),
 ]
 
 

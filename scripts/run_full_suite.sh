@@ -190,6 +190,19 @@ bash scripts/telemetry_smoke.sh "$MONITOR_DIR/telemetry_smoke"
 tlm=$?
 [ $tlm -ne 0 ] && rc=$((rc == 0 ? tlm : rc))
 
+# disaggregated-serving gate: prefill/decode split streams bit-identical
+# to the single-engine oracle through a mid-stream decode drain, handoff
+# bytes exactly equal the comm-model prediction, prefix hits skip
+# prefill with hit TTFT <= 0.5x miss and zero new executables, each
+# pool's supervisor scales on its own SLO (prefill: queue depth / TTFT
+# ceiling; decode: tokens/s floor), and goodput holds >= 0.90 with one
+# prefill replica hung
+echo ""
+echo "-- disagg smoke gate --"
+bash scripts/disagg_smoke.sh "$MONITOR_DIR/disagg_smoke"
+dsg=$?
+[ $dsg -ne 0 ] && rc=$((rc == 0 ? dsg : rc))
+
 # final gate: the perf regression sentinel over the repo's banked bench
 # artifacts — nonzero iff a real measurement fell out of its tolerance
 # band (outage-shaped zero/error lines are skipped, not failed)
